@@ -1,0 +1,59 @@
+"""lightgbmv1_tpu — a TPU-native gradient-boosted decision tree framework.
+
+A from-scratch re-design of LightGBM (the reference at
+dreaming-panda/LightGBMv1) for TPU hardware: histograms on the MXU via
+one-hot matmuls and Pallas kernels, on-device leaf-wise tree growth under
+jit, and multi-chip data/feature parallelism via jax.sharding + shard_map
+with XLA collectives over ICI — no sockets, no MPI.
+
+The Python API mirrors the reference's python-package (Dataset / Booster /
+train / cv / sklearn wrappers) so existing LightGBM scripts port with an
+import change.
+"""
+
+from .config import Config
+from .utils.log import LightGBMError, register_callback, set_verbosity
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "Config",
+    "LightGBMError",
+    "register_callback",
+    "set_verbosity",
+    "Dataset",
+    "Booster",
+    "train",
+    "cv",
+    "CVBooster",
+    "LGBMModel",
+    "LGBMRegressor",
+    "LGBMClassifier",
+    "LGBMRanker",
+    "early_stopping",
+    "log_evaluation",
+    "record_evaluation",
+    "reset_parameter",
+]
+
+
+def __getattr__(name):
+    # lazy imports keep `import lightgbmv1_tpu` light and avoid cycles
+    if name in ("Dataset", "Booster"):
+        from . import basic
+
+        return getattr(basic, name)
+    if name in ("train", "cv", "CVBooster"):
+        from . import engine
+
+        return getattr(engine, name)
+    if name in ("LGBMModel", "LGBMRegressor", "LGBMClassifier", "LGBMRanker"):
+        from . import sklearn
+
+        return getattr(sklearn, name)
+    if name in ("early_stopping", "log_evaluation", "print_evaluation",
+                "record_evaluation", "reset_parameter"):
+        from . import callback
+
+        return getattr(callback, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,0 +1,470 @@
+"""Training configuration.
+
+TPU-native re-design of the reference config system (reference:
+``include/LightGBM/config.h`` declares ~240 parameters; ``src/io/config_auto.cpp``
+holds the generated alias table and parser; ``Config::KV2Map`` at ``config.h:80``
+parses CLI ``key=value`` pairs).
+
+Here the config is a plain Python dataclass covering the parameters the TPU
+framework implements, with the same names, defaults, and aliases as the
+reference so that reference-style param dicts and ``train.conf`` files work
+unchanged.  Unknown keys warn (reference behavior: ``Config::Set`` ignores
+unknowns with a warning).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple, Union
+
+from .utils.log import log_warning
+
+# ---------------------------------------------------------------------------
+# Alias table (reference: src/io/config_auto.cpp GetAliasTable / docs/Parameters.rst)
+# ---------------------------------------------------------------------------
+_ALIASES: Dict[str, str] = {
+    # core
+    "config_file": "config",
+    "task_type": "task",
+    "objective_type": "objective",
+    "app": "objective",
+    "application": "objective",
+    "boosting_type": "boosting",
+    "boost": "boosting",
+    "train": "data",
+    "train_data": "data",
+    "train_data_file": "data",
+    "data_filename": "data",
+    "test": "valid",
+    "valid_data": "valid",
+    "valid_data_file": "valid",
+    "test_data": "valid",
+    "test_data_file": "valid",
+    "valid_filenames": "valid",
+    "num_trees": "num_iterations",
+    "num_iteration": "num_iterations",
+    "num_tree": "num_iterations",
+    "num_round": "num_iterations",
+    "num_rounds": "num_iterations",
+    "num_boost_round": "num_iterations",
+    "n_iter": "num_iterations",
+    "n_estimators": "num_iterations",
+    "shrinkage_rate": "learning_rate",
+    "eta": "learning_rate",
+    "num_leaf": "num_leaves",
+    "max_leaves": "num_leaves",
+    "max_leaf": "num_leaves",
+    "tree": "tree_learner",
+    "tree_type": "tree_learner",
+    "tree_learner_type": "tree_learner",
+    "num_thread": "num_threads",
+    "nthread": "num_threads",
+    "nthreads": "num_threads",
+    "n_jobs": "num_threads",
+    "device": "device_type",
+    "random_seed": "seed",
+    "random_state": "seed",
+    # learning control
+    "min_data_per_leaf": "min_data_in_leaf",
+    "min_data": "min_data_in_leaf",
+    "min_child_samples": "min_data_in_leaf",
+    "min_sum_hessian_per_leaf": "min_sum_hessian_in_leaf",
+    "min_sum_hessian": "min_sum_hessian_in_leaf",
+    "min_hessian": "min_sum_hessian_in_leaf",
+    "min_child_weight": "min_sum_hessian_in_leaf",
+    "sub_row": "bagging_fraction",
+    "subsample": "bagging_fraction",
+    "bagging": "bagging_fraction",
+    "pos_sub_row": "pos_bagging_fraction",
+    "pos_subsample": "pos_bagging_fraction",
+    "pos_bagging": "pos_bagging_fraction",
+    "neg_sub_row": "neg_bagging_fraction",
+    "neg_subsample": "neg_bagging_fraction",
+    "neg_bagging": "neg_bagging_fraction",
+    "subsample_freq": "bagging_freq",
+    "bagging_fraction_seed": "bagging_seed",
+    "sub_feature": "feature_fraction",
+    "colsample_bytree": "feature_fraction",
+    "sub_feature_bynode": "feature_fraction_bynode",
+    "colsample_bynode": "feature_fraction_bynode",
+    "early_stopping_rounds": "early_stopping_round",
+    "early_stopping": "early_stopping_round",
+    "n_iter_no_change": "early_stopping_round",
+    "max_tree_output": "max_delta_step",
+    "max_leaf_output": "max_delta_step",
+    "reg_alpha": "lambda_l1",
+    "l1_regularization": "lambda_l1",
+    "reg_lambda": "lambda_l2",
+    "lambda": "lambda_l2",
+    "l2_regularization": "lambda_l2",
+    "min_split_gain": "min_gain_to_split",
+    "rate_drop": "drop_rate",
+    "topk": "top_k",
+    "mc": "monotone_constraints",
+    "monotone_constraint": "monotone_constraints",
+    "cegb_penalty_feature_lazy": "cegb_penalty_feature_lazy",
+    "fc": "forcedsplits_filename",
+    "forced_splits_filename": "forcedsplits_filename",
+    "forced_splits_file": "forcedsplits_filename",
+    "forced_splits": "forcedsplits_filename",
+    "verbose": "verbosity",
+    # IO
+    "max_bins": "max_bin",
+    "subsample_for_bin": "bin_construct_sample_cnt",
+    "data_seed": "data_random_seed",
+    "model_output": "output_model",
+    "model_out": "output_model",
+    "model_input": "input_model",
+    "model_in": "input_model",
+    "predict_result": "output_result",
+    "prediction_result": "output_result",
+    "predict_name": "output_result",
+    "prediction_name": "output_result",
+    "pred_name": "output_result",
+    "name_pred": "output_result",
+    "is_sparse": "is_enable_sparse",
+    "enable_sparse": "is_enable_sparse",
+    "sparse": "is_enable_sparse",
+    "is_enable_bundle": "enable_bundle",
+    "bundle": "enable_bundle",
+    "is_pre_partition": "pre_partition",
+    "two_round_loading": "two_round",
+    "use_two_round_loading": "two_round",
+    "is_save_binary": "save_binary",
+    "is_save_binary_file": "save_binary",
+    "has_header": "header",
+    "label": "label_column",
+    "weight": "weight_column",
+    "group": "group_column",
+    "group_id": "group_column",
+    "query_column": "group_column",
+    "query": "group_column",
+    "query_id": "group_column",
+    "ignore_feature": "ignore_column",
+    "blacklist": "ignore_column",
+    "categorical_column": "categorical_feature",
+    "cat_column": "categorical_feature",
+    "categorical_columns": "categorical_feature",
+    "cat_feature": "categorical_feature",
+    "cat_features": "categorical_feature",
+    "is_predict_raw_score": "predict_raw_score",
+    "predict_rawscore": "predict_raw_score",
+    "raw_score": "predict_raw_score",
+    "is_predict_leaf_index": "predict_leaf_index",
+    "leaf_index": "predict_leaf_index",
+    "is_predict_contrib": "predict_contrib",
+    "contrib": "predict_contrib",
+    # objective
+    "num_classes": "num_class",
+    "unbalance": "is_unbalance",
+    "unbalanced_sets": "is_unbalance",
+    "sigmoid_": "sigmoid",
+    # metric
+    "metrics": "metric",
+    "metric_types": "metric",
+    "output_freq": "metric_freq",
+    "training_metric": "is_provide_training_metric",
+    "is_training_metric": "is_provide_training_metric",
+    "train_metric": "is_provide_training_metric",
+    "ndcg_eval_at": "eval_at",
+    "ndcg_at": "eval_at",
+    "map_eval_at": "eval_at",
+    "map_at": "eval_at",
+    # network
+    "num_machine": "num_machines",
+    "local_port": "local_listen_port",
+    "port": "local_listen_port",
+    "machine_list_file": "machine_list_filename",
+    "machine_list": "machine_list_filename",
+    "mlist": "machine_list_filename",
+    "workers": "machines",
+    "nodes": "machines",
+}
+
+_OBJECTIVE_ALIASES: Dict[str, str] = {
+    "regression_l2": "regression",
+    "l2": "regression",
+    "mean_squared_error": "regression",
+    "mse": "regression",
+    "l2_root": "regression",
+    "root_mean_squared_error": "regression",
+    "rmse": "regression",
+    "l1": "regression_l1",
+    "mean_absolute_error": "regression_l1",
+    "mae": "regression_l1",
+    "mean_absolute_percentage_error": "mape",
+    "multiclassova": "multiclassova",
+    "multiclass_ova": "multiclassova",
+    "ova": "multiclassova",
+    "ovr": "multiclassova",
+    "xentropy": "cross_entropy",
+    "xentlambda": "cross_entropy_lambda",
+    "mean_average_precision": "map",
+    "rank_xendcg": "rank_xendcg",
+    "xendcg": "rank_xendcg",
+    "xe_ndcg": "rank_xendcg",
+    "xe_ndcg_mart": "rank_xendcg",
+    "xendcg_mart": "rank_xendcg",
+}
+
+
+def canonical_objective(name: str) -> str:
+    return _OBJECTIVE_ALIASES.get(name, name)
+
+
+_BOOL_TRUE = {"true", "1", "yes", "on", "+", "t", "y"}
+_BOOL_FALSE = {"false", "0", "no", "off", "-", "f", "n"}
+
+
+@dataclass
+class Config:
+    """Parameters. Names/defaults mirror reference ``include/LightGBM/config.h``."""
+
+    # -- core ---------------------------------------------------------------
+    task: str = "train"
+    objective: str = "regression"
+    boosting: str = "gbdt"
+    data: str = ""
+    valid: List[str] = field(default_factory=list)
+    num_iterations: int = 100
+    learning_rate: float = 0.1
+    num_leaves: int = 31
+    tree_learner: str = "serial"
+    num_threads: int = 0
+    device_type: str = "tpu"
+    seed: int = 0
+    deterministic: bool = False
+
+    # -- learning control ---------------------------------------------------
+    max_depth: int = -1
+    min_data_in_leaf: int = 20
+    min_sum_hessian_in_leaf: float = 1e-3
+    bagging_fraction: float = 1.0
+    pos_bagging_fraction: float = 1.0
+    neg_bagging_fraction: float = 1.0
+    bagging_freq: int = 0
+    bagging_seed: int = 3
+    feature_fraction: float = 1.0
+    feature_fraction_bynode: float = 1.0
+    feature_fraction_seed: int = 2
+    extra_trees: bool = False
+    extra_seed: int = 6
+    early_stopping_round: int = 0
+    first_metric_only: bool = False
+    max_delta_step: float = 0.0
+    lambda_l1: float = 0.0
+    lambda_l2: float = 0.0
+    min_gain_to_split: float = 0.0
+    drop_rate: float = 0.1
+    max_drop: int = 50
+    skip_drop: float = 0.5
+    xgboost_dart_mode: bool = False
+    uniform_drop: bool = False
+    drop_seed: int = 4
+    top_rate: float = 0.2
+    other_rate: float = 0.1
+    min_data_per_group: int = 100
+    max_cat_threshold: int = 32
+    cat_l2: float = 10.0
+    cat_smooth: float = 10.0
+    max_cat_to_onehot: int = 4
+    top_k: int = 20
+    monotone_constraints: List[int] = field(default_factory=list)
+    monotone_constraints_method: str = "basic"
+    monotone_penalty: float = 0.0
+    feature_contri: List[float] = field(default_factory=list)
+    forcedsplits_filename: str = ""
+    forcedbins_filename: str = ""
+    refit_decay_rate: float = 0.9
+    cegb_tradeoff: float = 1.0
+    cegb_penalty_split: float = 0.0
+    cegb_penalty_feature_lazy: List[float] = field(default_factory=list)
+    cegb_penalty_feature_coupled: List[float] = field(default_factory=list)
+    path_smooth: float = 0.0
+    verbosity: int = 1
+
+    # -- TPU-specific (new; no reference equivalent) ------------------------
+    tree_growth: str = "leafwise"  # leafwise (reference semantics) | levelwise (batched)
+    hist_method: str = "auto"      # auto | scatter | onehot | pallas
+    hist_dtype: str = "bf16x2"     # bf16 | bf16x2 | f32 : histogram matmul precision
+    num_shards: int = 0            # devices for data-parallel (0 = all available)
+
+    # -- IO -----------------------------------------------------------------
+    max_bin: int = 255
+    max_bin_by_feature: List[int] = field(default_factory=list)
+    min_data_in_bin: int = 3
+    bin_construct_sample_cnt: int = 200000
+    data_random_seed: int = 1
+    output_model: str = "LightGBM_model.txt"
+    snapshot_freq: int = -1
+    input_model: str = ""
+    output_result: str = "LightGBM_predict_result.txt"
+    initscore_filename: str = ""
+    valid_data_initscores: List[str] = field(default_factory=list)
+    pre_partition: bool = False
+    enable_bundle: bool = True
+    use_missing: bool = True
+    zero_as_missing: bool = False
+    two_round: bool = False
+    save_binary: bool = False
+    header: bool = False
+    label_column: str = ""
+    weight_column: str = ""
+    group_column: str = ""
+    ignore_column: str = ""
+    categorical_feature: str = ""
+    predict_raw_score: bool = False
+    predict_leaf_index: bool = False
+    predict_contrib: bool = False
+    num_iteration_predict: int = -1
+    pred_early_stop: bool = False
+    pred_early_stop_freq: int = 10
+    pred_early_stop_margin: float = 10.0
+    convert_model_language: str = ""
+    convert_model: str = "gbdt_prediction.cpp"
+
+    # -- objective ----------------------------------------------------------
+    num_class: int = 1
+    is_unbalance: bool = False
+    scale_pos_weight: float = 1.0
+    sigmoid: float = 1.0
+    boost_from_average: bool = True
+    reg_sqrt: bool = False
+    alpha: float = 0.9
+    fair_c: float = 1.0
+    poisson_max_delta_step: float = 0.7
+    tweedie_variance_power: float = 1.5
+    lambdarank_truncation_level: int = 20
+    lambdarank_norm: bool = True
+    label_gain: List[float] = field(default_factory=list)
+
+    # -- metric -------------------------------------------------------------
+    metric: List[str] = field(default_factory=list)
+    metric_freq: int = 1
+    is_provide_training_metric: bool = False
+    eval_at: List[int] = field(default_factory=lambda: [1, 2, 3, 4, 5])
+    multi_error_top_k: int = 1
+    auc_mu_weights: List[float] = field(default_factory=list)
+
+    # -- network ------------------------------------------------------------
+    num_machines: int = 1
+    local_listen_port: int = 12400
+    time_out: int = 120
+    machine_list_filename: str = ""
+    machines: str = ""
+
+    # ------------------------------------------------------------------
+    def __post_init__(self):
+        from .utils.log import set_verbosity
+
+        set_verbosity(self.verbosity)
+        self.objective = canonical_objective(self.objective)
+        if self.objective in ("multiclass", "multiclassova") and self.num_class <= 1:
+            raise ValueError("num_class must be >1 for multiclass objectives")
+
+    # ------------------------------------------------------------------
+    @property
+    def num_tree_per_iteration(self) -> int:
+        if self.objective in ("multiclass", "multiclassova"):
+            return self.num_class
+        return 1
+
+    @property
+    def label_gain_or_default(self) -> List[float]:
+        if self.label_gain:
+            return list(self.label_gain)
+        return [float((1 << i) - 1) for i in range(31)]
+
+    # ------------------------------------------------------------------
+    @classmethod
+    def from_dict(cls, params: Dict[str, Any]) -> "Config":
+        cfg = cls.__new__(cls)
+        # set defaults first
+        for f in dataclasses.fields(cls):
+            if f.default is not dataclasses.MISSING:
+                setattr(cfg, f.name, f.default)
+            else:
+                setattr(cfg, f.name, f.default_factory())  # type: ignore
+        cfg.update(params)
+        cfg.__post_init__()
+        return cfg
+
+    def update(self, params: Dict[str, Any]) -> None:
+        resolved: Dict[str, Any] = {}
+        for key, value in params.items():
+            name = _ALIASES.get(key, key)
+            if name in resolved and key != name:
+                continue  # explicit name beats alias (reference: KeyAliasTransform)
+            resolved[name] = value
+        fields = {f.name: f for f in dataclasses.fields(self)}
+        for name, value in resolved.items():
+            if name not in fields:
+                log_warning(f"Unknown parameter: {name}")
+                continue
+            setattr(self, name, _coerce(value, fields[name], name))
+
+    def to_dict(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self)
+
+    # reference: Config::KV2Map config.h:80 — parse "key=value" strings
+    @staticmethod
+    def kv2map(args: List[str]) -> Dict[str, str]:
+        out: Dict[str, str] = {}
+        for arg in args:
+            arg = arg.split("#", 1)[0].strip()
+            if not arg:
+                continue
+            if "=" not in arg:
+                log_warning(f"Unknown option: {arg}")
+                continue
+            k, v = arg.split("=", 1)
+            out[k.strip()] = v.strip()
+        return out
+
+    @classmethod
+    def from_cli(cls, argv: List[str]) -> "Config":
+        kv = cls.kv2map(argv)
+        config_file = kv.get("config", kv.get("config_file", ""))
+        file_kv: Dict[str, str] = {}
+        if config_file:
+            with open(config_file) as fh:
+                file_kv = cls.kv2map(fh.read().splitlines())
+        # CLI args override config-file values (reference: application.cpp:49-82)
+        file_kv.update(kv)
+        file_kv.pop("config", None)
+        file_kv.pop("config_file", None)
+        return cls.from_dict(file_kv)
+
+
+def _coerce(value: Any, f: dataclasses.Field, name: str) -> Any:
+    ftype = f.type if isinstance(f.type, str) else getattr(f.type, "__name__", str(f.type))
+    is_list = "List" in str(ftype)
+    if is_list:
+        if isinstance(value, (list, tuple)):
+            items = list(value)
+        elif isinstance(value, str):
+            items = [s for s in value.replace(",", " ").split() if s]
+        else:
+            items = [value]
+        if "int" in str(ftype):
+            return [int(float(x)) for x in items]
+        if "float" in str(ftype):
+            return [float(x) for x in items]
+        return [str(x) for x in items]
+    default = f.default
+    if isinstance(default, bool):
+        if isinstance(value, str):
+            lv = value.strip().lower()
+            if lv in _BOOL_TRUE:
+                return True
+            if lv in _BOOL_FALSE:
+                return False
+            raise ValueError(f"Cannot parse bool parameter {name}={value}")
+        return bool(value)
+    if isinstance(default, int):
+        return int(float(value))
+    if isinstance(default, float):
+        return float(value)
+    return str(value)

@@ -1,0 +1,4 @@
+from .binning import BinMapper
+from .dataset import BinnedDataset, Metadata
+
+__all__ = ["BinMapper", "BinnedDataset", "Metadata"]

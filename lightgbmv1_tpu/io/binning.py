@@ -1,0 +1,337 @@
+"""Feature binning (host-side preprocessing).
+
+TPU-native re-design of the reference BinMapper (reference:
+``src/io/bin.cpp`` — ``BinMapper::FindBin`` bin.cpp:325, ``GreedyFindBin``
+bin.cpp:78, ``FindBinWithZeroAsOneBin`` bin.cpp:256, ``ValueToBin``
+include/LightGBM/bin.h:457-495).
+
+Differences from the reference, by design (SURVEY.md §7 "Hard parts"):
+
+* **Full bins, no most-frequent-bin elision.**  The reference reserves bin 0
+  for the most frequent bin per feature group and recovers it later via
+  ``FixHistogram`` (dataset.cpp:1410).  On TPU the histogram for every bin is
+  free (dense MXU matmul), so we store every bin explicitly and never need
+  FixHistogram.  This also removes the per-group ``bin_offsets`` bookkeeping.
+* **No exclusive feature bundling (EFB).**  EFB (dataset.cpp:97-235) is a
+  sparsity compression; the TPU layout is a dense ``(num_features, num_data)``
+  integer matrix, so bundling would only complicate addressing.
+
+Semantics preserved: greedy equal-count bin boundary search on a sample,
+zero-straddling bins, missing handling (None/Zero/NaN with a trailing NaN
+bin), categorical binning by descending frequency, trivial-feature detection.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+# reference: include/LightGBM/bin.h kZeroThreshold
+K_ZERO_THRESHOLD = 1e-35
+# missing types (reference: enum MissingType, bin.h)
+MISSING_NONE = 0
+MISSING_ZERO = 1
+MISSING_NAN = 2
+
+BIN_NUMERICAL = 0
+BIN_CATEGORICAL = 1
+
+
+def _greedy_find_bin(
+    distinct_values: np.ndarray,
+    counts: np.ndarray,
+    max_bin: int,
+    total_cnt: int,
+    min_data_in_bin: int,
+) -> List[float]:
+    """Greedy equal-count boundary search (behavioral port of GreedyFindBin,
+    reference src/io/bin.cpp:78-254). Returns list of bin upper bounds, the
+    last being +inf."""
+    bounds: List[float] = []
+    num_distinct = len(distinct_values)
+    if num_distinct == 0:
+        return [math.inf]
+    if num_distinct <= max_bin:
+        # each distinct value its own bin, merging tiny bins forward
+        acc = 0
+        for i in range(num_distinct - 1):
+            acc += int(counts[i])
+            if acc >= min_data_in_bin:
+                bounds.append((distinct_values[i] + distinct_values[i + 1]) / 2.0)
+                acc = 0
+        bounds.append(math.inf)
+        return bounds
+    # more distinct values than bins: aim for equal-count bins, giving
+    # heavy values ("big" counts) their own bin first
+    max_bin = max(1, max_bin)
+    mean_size = total_cnt / max_bin
+    is_big = counts >= mean_size * 4.0
+    rest_cnt = total_cnt - int(counts[is_big].sum())
+    rest_bins = max_bin - int(is_big.sum())
+    rest_mean = rest_cnt / max(rest_bins, 1)
+    acc = 0.0
+    for i in range(num_distinct - 1):
+        if is_big[i]:
+            # close current bin before and after a big value
+            if acc > 0:
+                bounds.append((distinct_values[i - 1] + distinct_values[i]) / 2.0
+                              if i > 0 else distinct_values[i] - 1.0)
+            bounds.append((distinct_values[i] + distinct_values[i + 1]) / 2.0)
+            acc = 0.0
+            continue
+        acc += float(counts[i])
+        if acc >= rest_mean and len(bounds) < max_bin - 1:
+            bounds.append((distinct_values[i] + distinct_values[i + 1]) / 2.0)
+            acc = 0.0
+    # dedupe and sort
+    bounds = sorted(set(b for b in bounds if math.isfinite(b)))
+    if len(bounds) > max_bin - 1:
+        idx = np.linspace(0, len(bounds) - 1, max_bin - 1).round().astype(int)
+        bounds = [bounds[i] for i in idx]
+    bounds.append(math.inf)
+    return bounds
+
+
+def _find_bin_with_zero_as_one_bin(
+    values: np.ndarray,
+    counts: np.ndarray,
+    max_bin: int,
+    total_sample_cnt: int,
+    min_data_in_bin: int,
+) -> List[float]:
+    """Ensure one bin straddles zero (behavioral port of
+    FindBinWithZeroAsOneBin, reference src/io/bin.cpp:256-323)."""
+    left_mask = values < -K_ZERO_THRESHOLD
+    right_mask = values > K_ZERO_THRESHOLD
+    left_cnt = int(counts[left_mask].sum())
+    right_cnt = int(counts[right_mask].sum())
+    zero_cnt = total_sample_cnt - left_cnt - right_cnt
+    if left_cnt == 0 and right_cnt == 0:
+        return [math.inf]
+    bounds: List[float] = []
+    left_max_bin = 0
+    if left_cnt > 0:
+        left_max_bin = max(
+            1, int((left_cnt / max(total_sample_cnt, 1)) * (max_bin - 1))
+        )
+        lb = _greedy_find_bin(
+            values[left_mask], counts[left_mask], left_max_bin, left_cnt, min_data_in_bin
+        )
+        lb[-1] = -K_ZERO_THRESHOLD  # close the negative range at ~zero
+        bounds.extend(lb)
+    if right_cnt > 0:
+        bounds.append(K_ZERO_THRESHOLD)  # the zero bin's upper bound
+        right_max_bin = max_bin - 1 - len([b for b in bounds if b < K_ZERO_THRESHOLD])
+        right_max_bin = max(1, right_max_bin)
+        rb = _greedy_find_bin(
+            values[right_mask], counts[right_mask], right_max_bin, right_cnt, min_data_in_bin
+        )
+        bounds.extend(rb)
+    else:
+        bounds.append(math.inf)
+    bounds = sorted(set(bounds))
+    return bounds
+
+
+@dataclass
+class BinMapper:
+    """Maps raw feature values to small integer bins (one per feature)."""
+
+    bin_upper_bound: np.ndarray = field(default_factory=lambda: np.array([np.inf]))
+    num_bin: int = 1
+    missing_type: int = MISSING_NONE
+    bin_type: int = BIN_NUMERICAL
+    is_trivial: bool = True
+    sparse_rate: float = 0.0
+    min_value: float = 0.0
+    max_value: float = 0.0
+    # categorical
+    categorical_2_bin: Dict[int, int] = field(default_factory=dict)
+    bin_2_categorical: List[int] = field(default_factory=list)
+
+    # ------------------------------------------------------------------
+    @property
+    def nan_bin(self) -> int:
+        """Bin index holding NaN values; -1 if none."""
+        if self.bin_type == BIN_CATEGORICAL:
+            return self.num_bin - 1  # the "other/unseen" bin also takes NaN
+        return self.num_bin - 1 if self.missing_type == MISSING_NAN else -1
+
+    @property
+    def zero_bin(self) -> int:
+        if self.bin_type == BIN_CATEGORICAL:
+            return int(self.categorical_2_bin.get(0, self.num_bin - 1))
+        return int(np.searchsorted(self.bin_upper_bound, 0.0, side="left"))
+
+    @property
+    def default_bin(self) -> int:
+        """Bin that missing values fall into during training."""
+        if self.missing_type == MISSING_NAN:
+            return self.nan_bin
+        return self.zero_bin
+
+    # ------------------------------------------------------------------
+    @classmethod
+    def find_bin(
+        cls,
+        sample_values: np.ndarray,
+        total_sample_cnt: int,
+        max_bin: int,
+        min_data_in_bin: int = 3,
+        bin_type: int = BIN_NUMERICAL,
+        use_missing: bool = True,
+        zero_as_missing: bool = False,
+    ) -> "BinMapper":
+        """Behavioral port of BinMapper::FindBin (reference src/io/bin.cpp:325-...).
+
+        ``sample_values`` are the sampled non-implicit values; rows missing
+        from the sample (sparse zeros) are accounted by
+        ``total_sample_cnt - len(sample_values)`` extra zeros, mirroring the
+        reference's sparse sampling contract.
+        """
+        m = cls()
+        m.bin_type = bin_type
+        vals = np.asarray(sample_values, dtype=np.float64)
+        na_cnt = int(np.isnan(vals).sum())
+        vals = vals[~np.isnan(vals)]
+        implicit_zero_cnt = total_sample_cnt - len(vals) - na_cnt
+
+        if bin_type == BIN_CATEGORICAL:
+            return cls._find_bin_categorical(m, vals, implicit_zero_cnt, max_bin,
+                                             min_data_in_bin, use_missing, na_cnt)
+
+        # resolve missing type (reference bin.cpp:351-380)
+        if not use_missing:
+            m.missing_type = MISSING_NONE
+        elif zero_as_missing:
+            m.missing_type = MISSING_ZERO
+        elif na_cnt > 0:
+            m.missing_type = MISSING_NAN
+        else:
+            m.missing_type = MISSING_NONE
+
+        budget = max_bin - 1 if m.missing_type == MISSING_NAN else max_bin
+        budget = max(budget, 2)
+
+        if len(vals) == 0 and implicit_zero_cnt == 0:
+            # all NaN
+            m.bin_upper_bound = np.array([np.inf])
+            m.num_bin = 2 if m.missing_type == MISSING_NAN else 1
+            m.is_trivial = m.num_bin <= 1
+            return m
+
+        if implicit_zero_cnt > 0:
+            vals = np.concatenate([vals, np.zeros(implicit_zero_cnt)])
+        m.min_value = float(vals.min()) if len(vals) else 0.0
+        m.max_value = float(vals.max()) if len(vals) else 0.0
+
+        distinct, counts = np.unique(vals, return_counts=True)
+        bounds = _find_bin_with_zero_as_one_bin(
+            distinct, counts, budget, len(vals), min_data_in_bin
+        )
+        m.bin_upper_bound = np.asarray(bounds, dtype=np.float64)
+        m.num_bin = len(bounds)
+        if m.missing_type == MISSING_NAN:
+            m.num_bin += 1  # trailing NaN bin
+        zero_total = int(counts[np.abs(distinct) <= K_ZERO_THRESHOLD].sum())
+        m.sparse_rate = zero_total / max(len(vals), 1)
+        m.is_trivial = m.num_bin <= 1 or (len(distinct) <= 1 and na_cnt == 0)
+        return m
+
+    @staticmethod
+    def _find_bin_categorical(m, vals, implicit_zero_cnt, max_bin,
+                              min_data_in_bin, use_missing, na_cnt):
+        cats = np.round(vals).astype(np.int64)
+        neg = cats < 0
+        if neg.any():
+            # reference warns and treats negatives as missing-ish; fold into "other"
+            cats = cats[~neg]
+        if implicit_zero_cnt > 0:
+            cats = np.concatenate([cats, np.zeros(implicit_zero_cnt, dtype=np.int64)])
+        distinct, counts = np.unique(cats, return_counts=True)
+        order = np.argsort(-counts, kind="stable")
+        distinct, counts = distinct[order], counts[order]
+        # keep top max_bin-1 categories (reserve 1 bin for other/NaN/unseen),
+        # dropping ultra-rare ones (reference uses min_data_in_bin-like cut)
+        keep = min(len(distinct), max_bin - 1)
+        m.bin_2_categorical = [int(c) for c in distinct[:keep]]
+        m.categorical_2_bin = {int(c): i for i, c in enumerate(m.bin_2_categorical)}
+        m.num_bin = keep + 1  # + other/unseen/NaN bin
+        m.missing_type = MISSING_NAN if (use_missing and na_cnt > 0) else MISSING_NONE
+        m.is_trivial = keep <= 1
+        m.min_value = float(distinct.min()) if len(distinct) else 0.0
+        m.max_value = float(distinct.max()) if len(distinct) else 0.0
+        m.bin_upper_bound = np.array([np.inf])
+        return m
+
+    # ------------------------------------------------------------------
+    def value_to_bin(self, values: np.ndarray) -> np.ndarray:
+        """Vectorized ValueToBin (reference include/LightGBM/bin.h:457-495)."""
+        v = np.asarray(values, dtype=np.float64)
+        if self.bin_type == BIN_CATEGORICAL:
+            out = np.full(v.shape, self.num_bin - 1, dtype=np.int32)  # other bin
+            nan_mask = np.isnan(v)
+            cats = np.round(np.where(nan_mask, -1, v)).astype(np.int64)
+            for c, b in self.categorical_2_bin.items():
+                out[cats == c] = b
+            return out
+        nan_mask = np.isnan(v)
+        # NaN routed to the zero bin here; for MISSING_NAN it is overwritten
+        # with the trailing NaN bin below
+        v = np.where(nan_mask, 0.0, v)
+        out = np.searchsorted(self.bin_upper_bound, v, side="left").astype(np.int32)
+        n_real = len(self.bin_upper_bound)
+        np.clip(out, 0, n_real - 1, out=out)
+        if self.missing_type == MISSING_NAN:
+            out[nan_mask] = self.num_bin - 1
+        return out
+
+    def bin_to_threshold(self, bin_idx: int) -> float:
+        """Real-valued threshold stored in the model for a bin split
+        (reference stores bin upper bound as the double threshold)."""
+        n_real = len(self.bin_upper_bound)
+        b = min(int(bin_idx), n_real - 1)
+        ub = self.bin_upper_bound[b]
+        if math.isinf(ub):
+            ub = self.max_value + 1.0
+        return float(ub)
+
+    def feature_info_str(self) -> str:
+        """feature_infos entry for the model text (reference gbdt_model_text.cpp)."""
+        if self.is_trivial:
+            return "none"
+        if self.bin_type == BIN_CATEGORICAL:
+            return ":".join(str(c) for c in self.bin_2_categorical)
+        return f"[{self.min_value:g}:{self.max_value:g}]"
+
+    # serialization used by the distributed bin-finding allgather
+    def to_arrays(self):
+        return {
+            "bin_upper_bound": self.bin_upper_bound,
+            "num_bin": self.num_bin,
+            "missing_type": self.missing_type,
+            "bin_type": self.bin_type,
+            "is_trivial": self.is_trivial,
+            "sparse_rate": self.sparse_rate,
+            "min_value": self.min_value,
+            "max_value": self.max_value,
+            "bin_2_categorical": list(self.bin_2_categorical),
+        }
+
+    @classmethod
+    def from_arrays(cls, d) -> "BinMapper":
+        m = cls()
+        m.bin_upper_bound = np.asarray(d["bin_upper_bound"], dtype=np.float64)
+        m.num_bin = int(d["num_bin"])
+        m.missing_type = int(d["missing_type"])
+        m.bin_type = int(d["bin_type"])
+        m.is_trivial = bool(d["is_trivial"])
+        m.sparse_rate = float(d["sparse_rate"])
+        m.min_value = float(d["min_value"])
+        m.max_value = float(d["max_value"])
+        m.bin_2_categorical = [int(c) for c in d.get("bin_2_categorical", [])]
+        m.categorical_2_bin = {c: i for i, c in enumerate(m.bin_2_categorical)}
+        return m

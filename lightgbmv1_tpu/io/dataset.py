@@ -1,0 +1,214 @@
+"""Binned dataset: the device-resident training representation.
+
+TPU-native re-design of the reference Dataset/Metadata
+(reference: ``include/LightGBM/dataset.h:332-713`` class Dataset,
+``dataset.h:40-248`` class Metadata, ``src/io/dataset.cpp``).
+
+Representation decisions (SURVEY.md §7):
+
+* Binned matrix lives in HBM as ``(num_features, num_data)`` integer bins
+  (uint8 when max bin count <= 256 else int16 — the analog of the reference's
+  ``DenseBin<uint8_t>/DenseBin<uint16_t>`` family, src/io/dense_bin.hpp:52).
+  There are no feature groups, no EFB, no sparse bins: density is what the
+  MXU wants.
+* Per-feature bin metadata is carried as small arrays (num_bins, missing
+  type, nan/zero/default bin) consumed by the jitted split finder.
+* The histogram-construction dispatch (the reference's col-wise vs row-wise
+  auto-benchmark, dataset.cpp:590-684) becomes the ``hist_method`` config
+  switch: scatter-add (CPU oracle) vs one-hot matmul vs Pallas kernel.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Union
+
+import numpy as np
+
+from ..config import Config
+from ..utils.log import log_fatal, log_info, log_warning
+from .binning import (
+    BIN_CATEGORICAL,
+    BIN_NUMERICAL,
+    MISSING_NAN,
+    MISSING_NONE,
+    MISSING_ZERO,
+    BinMapper,
+)
+
+
+def _next_pow2(x: int) -> int:
+    return 1 << max(0, (x - 1)).bit_length()
+
+
+@dataclass
+class Metadata:
+    """Labels, weights, query boundaries, init scores
+    (reference: class Metadata, include/LightGBM/dataset.h:40-248)."""
+
+    label: Optional[np.ndarray] = None
+    weight: Optional[np.ndarray] = None
+    group: Optional[np.ndarray] = None          # per-query sizes
+    query_boundaries: Optional[np.ndarray] = None  # cumulative, len num_queries+1
+    init_score: Optional[np.ndarray] = None
+
+    def set_group(self, group: Optional[np.ndarray]) -> None:
+        if group is None:
+            self.group = None
+            self.query_boundaries = None
+            return
+        group = np.asarray(group, dtype=np.int64).ravel()
+        self.group = group
+        self.query_boundaries = np.concatenate([[0], np.cumsum(group)])
+
+    def num_queries(self) -> int:
+        return 0 if self.group is None else len(self.group)
+
+
+class BinnedDataset:
+    """Feature-binned training data + metadata.
+
+    ``binned``: (num_features, num_data) np.uint8/np.int16 — bin indices.
+    """
+
+    def __init__(
+        self,
+        binned: np.ndarray,
+        bin_mappers: List[BinMapper],
+        metadata: Metadata,
+        feature_names: Optional[List[str]] = None,
+        max_bin: int = 255,
+    ):
+        self.binned = binned
+        self.bin_mappers = bin_mappers
+        self.metadata = metadata
+        self.num_features = binned.shape[0]
+        self.num_data = binned.shape[1]
+        self.max_bin = max_bin
+        self.feature_names = feature_names or [
+            f"Column_{i}" for i in range(self.num_features)
+        ]
+        self._build_feature_meta()
+
+    # ------------------------------------------------------------------
+    def _build_feature_meta(self) -> None:
+        F = self.num_features
+        self.num_bins = np.array([m.num_bin for m in self.bin_mappers], dtype=np.int32)
+        self.missing_types = np.array(
+            [m.missing_type for m in self.bin_mappers], dtype=np.int32
+        )
+        self.nan_bins = np.array([m.nan_bin for m in self.bin_mappers], dtype=np.int32)
+        self.zero_bins = np.array([m.zero_bin for m in self.bin_mappers], dtype=np.int32)
+        self.default_bins = np.array(
+            [m.default_bin for m in self.bin_mappers], dtype=np.int32
+        )
+        self.is_categorical = np.array(
+            [m.bin_type == BIN_CATEGORICAL for m in self.bin_mappers], dtype=bool
+        )
+        self.is_trivial = np.array([m.is_trivial for m in self.bin_mappers], dtype=bool)
+        # padded bin-axis size for histogram arrays (TPU lane alignment)
+        max_nb = int(self.num_bins.max()) if F else 2
+        self.num_total_bin = max(2, max_nb)
+        self.padded_bin = max(8, _next_pow2(self.num_total_bin))
+
+    # ------------------------------------------------------------------
+    @classmethod
+    def from_numpy(
+        cls,
+        X: np.ndarray,
+        label: Optional[np.ndarray] = None,
+        weight: Optional[np.ndarray] = None,
+        group: Optional[np.ndarray] = None,
+        init_score: Optional[np.ndarray] = None,
+        config: Optional[Config] = None,
+        categorical_features: Optional[Sequence[int]] = None,
+        feature_names: Optional[List[str]] = None,
+        reference: Optional["BinnedDataset"] = None,
+        bin_finder=None,
+    ) -> "BinnedDataset":
+        """Build a binned dataset from a dense float matrix (rows, features).
+
+        ``reference``: reuse another dataset's bin mappers (validation sets
+        must share the training bins — reference basic.py Dataset reference
+        alignment semantics).
+        ``bin_finder``: optional callable(list-of-sample-arrays, config) ->
+        list[BinMapper] used by the distributed loader to sync mappers.
+        """
+        config = config or Config()
+        X = np.asarray(X)
+        if X.ndim != 2:
+            raise ValueError("X must be 2-D (rows, features)")
+        num_data, num_features = X.shape
+        categorical = set(categorical_features or [])
+
+        if reference is not None:
+            mappers = reference.bin_mappers
+            feature_names = feature_names or reference.feature_names
+        else:
+            # sampling (reference: bin_construct_sample_cnt, dataset_loader.cpp:823)
+            sample_cnt = min(num_data, config.bin_construct_sample_cnt)
+            rng = np.random.RandomState(config.data_random_seed)
+            if sample_cnt < num_data:
+                sample_idx = rng.choice(num_data, size=sample_cnt, replace=False)
+            else:
+                sample_idx = np.arange(num_data)
+            max_bins = list(config.max_bin_by_feature) or [config.max_bin] * num_features
+            if len(max_bins) != num_features:
+                log_fatal("max_bin_by_feature length must equal number of features")
+            samples = [np.asarray(X[sample_idx, j], dtype=np.float64) for j in range(num_features)]
+            if bin_finder is not None:
+                mappers = bin_finder(samples, sample_cnt, max_bins, categorical, config)
+            else:
+                mappers = [
+                    BinMapper.find_bin(
+                        samples[j],
+                        total_sample_cnt=sample_cnt,
+                        max_bin=max_bins[j],
+                        min_data_in_bin=config.min_data_in_bin,
+                        bin_type=BIN_CATEGORICAL if j in categorical else BIN_NUMERICAL,
+                        use_missing=config.use_missing,
+                        zero_as_missing=config.zero_as_missing,
+                    )
+                    for j in range(num_features)
+                ]
+
+        max_nb = max(m.num_bin for m in mappers) if mappers else 2
+        dtype = np.uint8 if max_nb <= 256 else np.int16
+        binned = np.empty((num_features, num_data), dtype=dtype)
+        for j, m in enumerate(mappers):
+            binned[j] = m.value_to_bin(X[:, j]).astype(dtype)
+
+        meta = Metadata()
+        if label is not None:
+            meta.label = np.asarray(label, dtype=np.float32).ravel()
+            if len(meta.label) != num_data:
+                log_fatal("label length mismatch")
+        if weight is not None:
+            meta.weight = np.asarray(weight, dtype=np.float32).ravel()
+        if init_score is not None:
+            meta.init_score = np.asarray(init_score, dtype=np.float64)
+        meta.set_group(group)
+        ds = cls(binned, mappers, meta, feature_names, max_bin=config.max_bin)
+        n_used = int((~ds.is_trivial).sum())
+        log_info(
+            f"Constructed binned dataset: {num_data} rows, {num_features} features "
+            f"({n_used} informative), max {ds.num_total_bin} bins"
+        )
+        return ds
+
+    # ------------------------------------------------------------------
+    def bin_raw_features(self, X: np.ndarray) -> np.ndarray:
+        """Bin new raw data with this dataset's mappers → (F, N) bins."""
+        X = np.asarray(X)
+        out = np.empty((self.num_features, X.shape[0]), dtype=self.binned.dtype)
+        for j, m in enumerate(self.bin_mappers):
+            out[j] = m.value_to_bin(X[:, j]).astype(self.binned.dtype)
+        return out
+
+    def feature_infos(self) -> List[str]:
+        return [m.feature_info_str() for m in self.bin_mappers]
+
+    @property
+    def num_used_features(self) -> int:
+        return int((~self.is_trivial).sum())

@@ -1,0 +1,15 @@
+from .gbdt import DART, GBDT, GOSS, RF, create_boosting
+from .grower import make_leafwise_grower
+from .tree import HostTree, TreeArrays, empty_tree
+
+__all__ = [
+    "DART",
+    "GBDT",
+    "GOSS",
+    "RF",
+    "create_boosting",
+    "make_leafwise_grower",
+    "HostTree",
+    "TreeArrays",
+    "empty_tree",
+]

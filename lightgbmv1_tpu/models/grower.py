@@ -1,0 +1,249 @@
+"""Leaf-wise (best-first) tree growth, fully on device.
+
+TPU-native re-design of the reference SerialTreeLearner
+(``SerialTreeLearner::Train`` src/treelearner/serial_tree_learner.cpp:152-202,
+``FindBestSplits`` :316, ``SplitInner`` :541-659) and DataPartition
+(src/treelearner/data_partition.hpp:101-120).
+
+Design mapping (SURVEY.md §7):
+
+* The reference's permuted row-index partition becomes a per-row ``leaf_id``
+  array; ``DataPartition::Split``'s parallel scatter becomes a vectorized
+  ``where`` over all rows.
+* The histogram pool with parent-reuse + the smaller/larger-leaf subtraction
+  trick (``BeforeFindBestSplit`` serial_tree_learner.cpp:274-314,
+  ``FeatureHistogram::Subtract`` feature_histogram.hpp:79) is kept exactly:
+  one histogram pass over the smaller child per split, larger child =
+  parent - smaller (a pure vector op).
+* The whole per-tree loop is a ``lax.fori_loop`` of ``num_leaves - 1`` steps
+  under one ``jit``; a latched ``done`` flag reproduces the reference's
+  early stop when no split has positive gain
+  (serial_tree_learner.cpp:192-195).
+* Distribution is injected through ``hist_fn`` (see parallel/): the
+  data-parallel learner wraps it in a psum over the row mesh axis — the
+  analog of DataParallelTreeLearner's ReduceScatter
+  (data_parallel_tree_learner.cpp:155-173) — while this module stays
+  topology-agnostic.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Callable, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..io.binning import MISSING_NAN
+from ..ops.split import (
+    FeatureMeta,
+    SplitParams,
+    find_best_split,
+    leaf_output,
+)
+from .tree import TreeArrays
+
+
+class GrowerState(NamedTuple):
+    leaf_id: jax.Array        # (N,) int32
+    hist_pool: jax.Array      # (L, F, B, 3)
+    leaf_sums: jax.Array      # (L, 3)
+    leaf_depth: jax.Array     # (L,) int32
+    best_gain: jax.Array      # (L,)
+    best_feat: jax.Array      # (L,) int32
+    best_bin: jax.Array       # (L,) int32
+    best_dl: jax.Array        # (L,) bool
+    best_left: jax.Array      # (L, 3)
+    best_right: jax.Array     # (L, 3)
+    tree: TreeArrays
+    leaf_is_left: jax.Array   # (L,) bool
+    num_leaves: jax.Array     # () int32
+    done: jax.Array           # () bool
+
+
+def _node_feature_mask(key, uid, base_mask, fraction: float):
+    """Per-node column sampling (reference: ColSampler bynode,
+    src/treelearner/col_sampler.hpp:20)."""
+    if fraction >= 1.0:
+        return base_mask
+    F = base_mask.shape[0]
+    scores = jax.random.uniform(jax.random.fold_in(key, uid), (F,))
+    scores = jnp.where(base_mask, scores, jnp.inf)
+    n_allowed = jnp.sum(base_mask)
+    k = jnp.maximum(1, jnp.ceil(fraction * n_allowed)).astype(jnp.int32)
+    thresh = jnp.sort(scores)[jnp.maximum(k - 1, 0)]
+    return base_mask & (scores <= thresh)
+
+
+def make_leafwise_grower(
+    *,
+    num_leaves: int,
+    num_bins: int,
+    meta: FeatureMeta,
+    params: SplitParams,
+    max_depth: int = -1,
+    feature_fraction_bynode: float = 1.0,
+    hist_fn: Callable = None,
+    split_fn: Callable = None,
+):
+    """Build the jittable ``grow(binned, g3, base_mask, key)`` function.
+
+    ``hist_fn(binned, g3, leaf_id, target_leaf) -> (F, B, 3)`` — histogram of
+    one leaf's rows (globally summed in distributed mode).
+    ``split_fn(hist, parent_sum, feature_mask, key, uid) -> SplitResult`` —
+    defaults to the local vectorized search; the feature-parallel learner
+    substitutes a sharded search + cross-shard argmax.
+    """
+    L = num_leaves
+    L1 = max(L - 1, 1)
+
+    if split_fn is None:
+        def split_fn(hist, parent, mask, key, uid):
+            return find_best_split(hist, parent, meta, mask, params)
+
+    def apply_decision(binned, leaf_id, leaf, new_leaf, feat, thr, dl):
+        bins_f = binned[feat]                       # (N,) dynamic row gather
+        is_na = (meta.missing_type[feat] == MISSING_NAN) & (
+            bins_f == meta.nan_bin[feat]
+        )
+        go_left = jnp.where(is_na, dl, bins_f <= thr)
+        return jnp.where((leaf_id == leaf) & (~go_left), new_leaf, leaf_id)
+
+    def grow(binned, g3, base_mask, key):
+        N = binned.shape[1]
+        F = binned.shape[0]
+        B = num_bins
+
+        leaf_id = jnp.zeros(N, jnp.int32)
+        hist0 = hist_fn(binned, g3, leaf_id, jnp.asarray(0, jnp.int32))
+        root_sum = hist0[0].sum(axis=0)             # totals from any feature's bins
+        mask0 = _node_feature_mask(key, 0, base_mask, feature_fraction_bynode)
+        res0 = split_fn(hist0, root_sum, mask0, key, 0)
+
+        from ..models.tree import empty_tree
+
+        st = GrowerState(
+            leaf_id=leaf_id,
+            hist_pool=jnp.zeros((L, F, B, 3), jnp.float32).at[0].set(hist0),
+            leaf_sums=jnp.zeros((L, 3), jnp.float32).at[0].set(root_sum),
+            leaf_depth=jnp.zeros(L, jnp.int32),
+            best_gain=jnp.full(L, -jnp.inf, jnp.float32).at[0].set(res0.gain),
+            best_feat=jnp.zeros(L, jnp.int32).at[0].set(res0.feature),
+            best_bin=jnp.zeros(L, jnp.int32).at[0].set(res0.threshold_bin),
+            best_dl=jnp.zeros(L, bool).at[0].set(res0.default_left),
+            best_left=jnp.zeros((L, 3), jnp.float32).at[0].set(res0.left_sum),
+            best_right=jnp.zeros((L, 3), jnp.float32).at[0].set(res0.right_sum),
+            tree=empty_tree(L),
+            leaf_is_left=jnp.zeros(L, bool),
+            num_leaves=jnp.asarray(1, jnp.int32),
+            done=jnp.asarray(L <= 1),
+        )
+
+        def body(s, st: GrowerState) -> GrowerState:
+            leaf = jnp.argmax(st.best_gain).astype(jnp.int32)
+            gain = st.best_gain[leaf]
+            active = (~st.done) & (gain > 0)
+
+            def do_split(st: GrowerState) -> GrowerState:
+                nl = st.num_leaves                    # new (right-child) leaf index
+                node = nl - 1                         # internal node index
+                feat = st.best_feat[leaf]
+                thr = st.best_bin[leaf]
+                dl = st.best_dl[leaf]
+                lsum = st.best_left[leaf]
+                rsum = st.best_right[leaf]
+                parent_sum = st.leaf_sums[leaf]
+
+                leaf_id = apply_decision(binned, st.leaf_id, leaf, nl, feat, thr, dl)
+
+                # histogram-subtraction trick: one pass over the smaller child
+                smaller_is_left = lsum[2] <= rsum[2]
+                smaller = jnp.where(smaller_is_left, leaf, nl)
+                h_small = hist_fn(binned, g3, leaf_id, smaller)
+                h_parent = st.hist_pool[leaf]
+                h_left = jnp.where(smaller_is_left, h_small, h_parent - h_small)
+                h_right = h_parent - h_left
+                pool = st.hist_pool.at[leaf].set(h_left).at[nl].set(h_right)
+
+                d = st.leaf_depth[leaf] + 1
+                depth_ok = (max_depth <= 0) | (d < max_depth)
+
+                mask_l = _node_feature_mask(
+                    key, 2 * s + 1, base_mask, feature_fraction_bynode
+                )
+                mask_r = _node_feature_mask(
+                    key, 2 * s + 2, base_mask, feature_fraction_bynode
+                )
+                res_l = split_fn(h_left, lsum, mask_l, key, 2 * s + 1)
+                res_r = split_fn(h_right, rsum, mask_r, key, 2 * s + 2)
+                gain_l = jnp.where(depth_ok, res_l.gain, -jnp.inf)
+                gain_r = jnp.where(depth_ok, res_r.gain, -jnp.inf)
+
+                t = st.tree
+                # re-wire the parent pointer that pointed at ~leaf
+                p = t.leaf_parent[leaf]
+                p_safe = jnp.maximum(p, 0)
+                was_left = st.leaf_is_left[leaf]
+                lc = t.left_child.at[p_safe].set(
+                    jnp.where((p >= 0) & was_left, node, t.left_child[p_safe])
+                )
+                rc = t.right_child.at[p_safe].set(
+                    jnp.where((p >= 0) & (~was_left), node, t.right_child[p_safe])
+                )
+                lc = lc.at[node].set(-(leaf + 1))
+                rc = rc.at[node].set(-(nl + 1))
+
+                tree = t._replace(
+                    num_leaves=nl + 1,
+                    split_feature=t.split_feature.at[node].set(feat),
+                    threshold_bin=t.threshold_bin.at[node].set(thr),
+                    default_left=t.default_left.at[node].set(dl),
+                    missing_type=t.missing_type.at[node].set(meta.missing_type[feat]),
+                    left_child=lc,
+                    right_child=rc,
+                    split_gain=t.split_gain.at[node].set(gain),
+                    internal_value=t.internal_value.at[node].set(
+                        leaf_output(parent_sum[0], parent_sum[1], params)
+                    ),
+                    internal_weight=t.internal_weight.at[node].set(parent_sum[1]),
+                    internal_count=t.internal_count.at[node].set(parent_sum[2]),
+                    leaf_value=t.leaf_value.at[leaf]
+                    .set(leaf_output(lsum[0], lsum[1], params))
+                    .at[nl]
+                    .set(leaf_output(rsum[0], rsum[1], params)),
+                    leaf_weight=t.leaf_weight.at[leaf].set(lsum[1]).at[nl].set(rsum[1]),
+                    leaf_count=t.leaf_count.at[leaf].set(lsum[2]).at[nl].set(rsum[2]),
+                    leaf_parent=t.leaf_parent.at[leaf].set(node).at[nl].set(node),
+                )
+
+                return GrowerState(
+                    leaf_id=leaf_id,
+                    hist_pool=pool,
+                    leaf_sums=st.leaf_sums.at[leaf].set(lsum).at[nl].set(rsum),
+                    leaf_depth=st.leaf_depth.at[leaf].set(d).at[nl].set(d),
+                    best_gain=st.best_gain.at[leaf].set(gain_l).at[nl].set(gain_r),
+                    best_feat=st.best_feat.at[leaf].set(res_l.feature).at[nl].set(res_r.feature),
+                    best_bin=st.best_bin.at[leaf]
+                    .set(res_l.threshold_bin)
+                    .at[nl]
+                    .set(res_r.threshold_bin),
+                    best_dl=st.best_dl.at[leaf].set(res_l.default_left).at[nl].set(res_r.default_left),
+                    best_left=st.best_left.at[leaf].set(res_l.left_sum).at[nl].set(res_r.left_sum),
+                    best_right=st.best_right.at[leaf].set(res_l.right_sum).at[nl].set(res_r.right_sum),
+                    tree=tree,
+                    leaf_is_left=st.leaf_is_left.at[leaf].set(True).at[nl].set(False),
+                    num_leaves=nl + 1,
+                    done=st.done,
+                )
+
+            def no_split(st: GrowerState) -> GrowerState:
+                return st._replace(done=jnp.asarray(True))
+
+            return lax.cond(active, do_split, no_split, st)
+
+        st = lax.fori_loop(0, L - 1, body, st) if L > 1 else st
+        return st.tree, st.leaf_id, root_sum
+
+    return grow

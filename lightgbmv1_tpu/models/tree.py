@@ -1,0 +1,301 @@
+"""Tree model arrays and vectorized prediction.
+
+TPU-native re-design of the reference flat-array tree
+(reference: ``class Tree``, include/LightGBM/tree.h:25-602, src/io/tree.cpp).
+
+Node encoding follows the reference exactly so the v3 model-text format
+round-trips: internal nodes are numbered in split order; ``left_child`` /
+``right_child`` hold either an internal node index (>= 0) or ``~leaf_index``
+(< 0).  Prediction is a fully vectorized root-to-leaf walk: every row carries
+its current node index and a ``lax.while_loop`` advances all rows together
+(the reference's per-row ``Tree::Predict`` walk, tree.h:132, becomes a
+gather + select per level).
+"""
+
+from __future__ import annotations
+
+from typing import List, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ..io.binning import K_ZERO_THRESHOLD, MISSING_NAN, MISSING_NONE, MISSING_ZERO
+
+
+class TreeArrays(NamedTuple):
+    """One tree (or a stack of trees when arrays carry a leading T axis)."""
+
+    num_leaves: jax.Array       # () int32 — actual leaves (arrays are padded)
+    split_feature: jax.Array    # (L-1,) int32
+    threshold_bin: jax.Array    # (L-1,) int32
+    threshold: jax.Array        # (L-1,) float32 — real-valued threshold
+    default_left: jax.Array     # (L-1,) bool
+    missing_type: jax.Array     # (L-1,) int32 — copied from split feature meta
+    left_child: jax.Array       # (L-1,) int32 (>=0 node, <0 is ~leaf)
+    right_child: jax.Array      # (L-1,) int32
+    split_gain: jax.Array       # (L-1,) float32
+    internal_value: jax.Array   # (L-1,) float32
+    internal_weight: jax.Array  # (L-1,) float32
+    internal_count: jax.Array   # (L-1,) float32
+    leaf_value: jax.Array       # (L,) float32
+    leaf_weight: jax.Array      # (L,) float32
+    leaf_count: jax.Array       # (L,) float32
+    leaf_parent: jax.Array      # (L,) int32
+
+
+def empty_tree(max_leaves: int) -> TreeArrays:
+    L = max_leaves
+    L1 = max(L - 1, 1)
+    return TreeArrays(
+        num_leaves=jnp.asarray(1, jnp.int32),
+        split_feature=jnp.zeros(L1, jnp.int32),
+        threshold_bin=jnp.zeros(L1, jnp.int32),
+        threshold=jnp.zeros(L1, jnp.float32),
+        default_left=jnp.zeros(L1, bool),
+        missing_type=jnp.zeros(L1, jnp.int32),
+        left_child=jnp.full(L1, -1, jnp.int32),
+        right_child=jnp.full(L1, -2, jnp.int32),
+        split_gain=jnp.zeros(L1, jnp.float32),
+        internal_value=jnp.zeros(L1, jnp.float32),
+        internal_weight=jnp.zeros(L1, jnp.float32),
+        internal_count=jnp.zeros(L1, jnp.float32),
+        leaf_value=jnp.zeros(L, jnp.float32),
+        leaf_weight=jnp.zeros(L, jnp.float32),
+        leaf_count=jnp.zeros(L, jnp.float32),
+        leaf_parent=jnp.full(L, -1, jnp.int32),
+    )
+
+
+# ---------------------------------------------------------------------------
+# Binned prediction (training-time: validation data shares the training bins)
+# ---------------------------------------------------------------------------
+
+
+def tree_leaf_index_binned(
+    tree: TreeArrays,
+    binned: jax.Array,        # (F, N)
+    nan_bins: jax.Array,      # (F,) int32
+    missing_types: jax.Array,  # (F,) int32
+) -> jax.Array:               # (N,) int32 leaf index per row
+    N = binned.shape[1]
+
+    def cond(state):
+        node, _ = state
+        return jnp.any(node >= 0)
+
+    def body(state):
+        node, _ = state
+        active = node >= 0
+        nd = jnp.maximum(node, 0)
+        f = tree.split_feature[nd]
+        b = jnp.take_along_axis(binned, f[None, :], axis=0)[0]
+        t = tree.threshold_bin[nd]
+        dl = tree.default_left[nd]
+        is_na = (missing_types[f] == MISSING_NAN) & (b == nan_bins[f])
+        go_left = jnp.where(is_na, dl, b <= t)
+        nxt = jnp.where(go_left, tree.left_child[nd], tree.right_child[nd])
+        node = jnp.where(active, nxt, node)
+        return node, active
+
+    node0 = jnp.where(tree.num_leaves > 1,
+                      jnp.zeros(N, jnp.int32),
+                      jnp.full(N, -1, jnp.int32))
+    node, _ = lax.while_loop(cond, body, (node0, jnp.ones(N, bool)))
+    return -node - 1   # ~node
+
+
+def tree_predict_binned(tree, binned, nan_bins, missing_types):
+    leaf = tree_leaf_index_binned(tree, binned, nan_bins, missing_types)
+    return tree.leaf_value[leaf]
+
+
+# ---------------------------------------------------------------------------
+# Raw-feature prediction (deployment path, reference Tree::Predict)
+# ---------------------------------------------------------------------------
+
+
+def tree_predict_raw(tree: TreeArrays, X: jax.Array) -> jax.Array:
+    """X: (N, F) float; NaN = missing. Mirrors Tree::NumericalDecision
+    (reference include/LightGBM/tree.h:~430) including missing-type handling."""
+    N = X.shape[0]
+
+    def cond(state):
+        return jnp.any(state >= 0)
+
+    def body(node):
+        active = node >= 0
+        nd = jnp.maximum(node, 0)
+        f = tree.split_feature[nd]
+        v = jnp.take_along_axis(X, f[:, None], axis=1)[:, 0]
+        t = tree.threshold[nd]
+        dl = tree.default_left[nd]
+        mtype = tree.missing_type[nd]
+        is_nan = jnp.isnan(v)
+        v0 = jnp.where(is_nan, 0.0, v)
+        is_missing = jnp.where(
+            mtype == MISSING_NAN,
+            is_nan,
+            jnp.where(mtype == MISSING_ZERO,
+                      is_nan | (jnp.abs(v0) <= K_ZERO_THRESHOLD), False),
+        )
+        go_left = jnp.where(is_missing, dl, v0 <= t)
+        nxt = jnp.where(go_left, tree.left_child[nd], tree.right_child[nd])
+        return jnp.where(active, nxt, node)
+
+    node0 = jnp.where(tree.num_leaves > 1,
+                      jnp.zeros(N, jnp.int32),
+                      jnp.full(N, -1, jnp.int32))
+    node = lax.while_loop(cond, body, node0)
+    return tree.leaf_value[-node - 1]
+
+
+def stack_trees(trees: List[TreeArrays]) -> TreeArrays:
+    return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *trees)
+
+
+def ensemble_predict_raw(stacked: TreeArrays, X: jax.Array) -> jax.Array:
+    """Sum of all stacked trees' raw predictions for each row."""
+
+    def step(acc, tree):
+        return acc + tree_predict_raw(tree, X), None
+
+    out, _ = lax.scan(step, jnp.zeros(X.shape[0], jnp.float32), stacked)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Host-side (numpy) tree — exact mirror used by the text model format/CLI
+# ---------------------------------------------------------------------------
+
+
+class HostTree:
+    """Numpy copy of one tree; the object serialized to/from model text."""
+
+    FIELDS = [
+        "split_feature", "threshold_bin", "threshold", "default_left",
+        "missing_type", "left_child", "right_child", "split_gain",
+        "internal_value", "internal_weight", "internal_count",
+        "leaf_value", "leaf_weight", "leaf_count", "leaf_parent",
+    ]
+
+    def __init__(self, arrays: TreeArrays, shrinkage: float = 1.0):
+        self.num_leaves = int(arrays.num_leaves)
+        n_nodes = max(self.num_leaves - 1, 0)
+        as_np = lambda a: np.asarray(a)
+        self.split_feature = as_np(arrays.split_feature)[:n_nodes].astype(np.int32)
+        self.threshold_bin = as_np(arrays.threshold_bin)[:n_nodes].astype(np.int32)
+        self.threshold = as_np(arrays.threshold)[:n_nodes].astype(np.float64)
+        self.default_left = as_np(arrays.default_left)[:n_nodes].astype(bool)
+        self.missing_type = as_np(arrays.missing_type)[:n_nodes].astype(np.int32)
+        self.left_child = as_np(arrays.left_child)[:n_nodes].astype(np.int32)
+        self.right_child = as_np(arrays.right_child)[:n_nodes].astype(np.int32)
+        self.split_gain = as_np(arrays.split_gain)[:n_nodes].astype(np.float64)
+        self.internal_value = as_np(arrays.internal_value)[:n_nodes].astype(np.float64)
+        self.internal_weight = as_np(arrays.internal_weight)[:n_nodes].astype(np.float64)
+        self.internal_count = as_np(arrays.internal_count)[:n_nodes].astype(np.int64)
+        self.leaf_value = as_np(arrays.leaf_value)[: self.num_leaves].astype(np.float64)
+        self.leaf_weight = as_np(arrays.leaf_weight)[: self.num_leaves].astype(np.float64)
+        self.leaf_count = as_np(arrays.leaf_count)[: self.num_leaves].astype(np.int64)
+        self.leaf_parent = as_np(arrays.leaf_parent)[: self.num_leaves].astype(np.int32)
+        self.shrinkage = shrinkage
+
+    def apply_shrinkage(self, rate: float) -> None:
+        """reference: Tree::Shrinkage, tree.h:187-196."""
+        self.leaf_value *= rate
+        self.internal_value *= rate
+        self.shrinkage *= rate
+
+    def set_leaf_values(self, values: np.ndarray) -> None:
+        self.leaf_value = np.asarray(values, dtype=np.float64)[: self.num_leaves]
+
+    # -- numpy prediction (exact, host) ------------------------------------
+    def predict(self, X: np.ndarray) -> np.ndarray:
+        N = X.shape[0]
+        out = np.empty(N, dtype=np.float64)
+        if self.num_leaves <= 1:
+            out[:] = self.leaf_value[0] if self.num_leaves == 1 else 0.0
+            return out
+        node = np.zeros(N, dtype=np.int64)
+        active = np.ones(N, dtype=bool)
+        while active.any():
+            nd = node[active]
+            f = self.split_feature[nd]
+            v = X[active, f].astype(np.float64)
+            t = self.threshold[nd]
+            dl = self.default_left[nd]
+            mt = self.missing_type[nd]
+            isnan = np.isnan(v)
+            v0 = np.where(isnan, 0.0, v)
+            miss = np.where(
+                mt == MISSING_NAN, isnan,
+                np.where(mt == MISSING_ZERO,
+                         isnan | (np.abs(v0) <= K_ZERO_THRESHOLD), False),
+            )
+            go_left = np.where(miss, dl, v0 <= t)
+            nxt = np.where(go_left, self.left_child[nd], self.right_child[nd])
+            node[active] = nxt
+            idx = np.flatnonzero(active)
+            done = nxt < 0
+            out[idx[done]] = self.leaf_value[-nxt[done] - 1]
+            active[idx[done]] = False
+        return out
+
+    def predict_leaf_index(self, X: np.ndarray) -> np.ndarray:
+        N = X.shape[0]
+        if self.num_leaves <= 1:
+            return np.zeros(N, dtype=np.int32)
+        node = np.zeros(N, dtype=np.int64)
+        active = np.ones(N, dtype=bool)
+        leaf = np.zeros(N, dtype=np.int32)
+        while active.any():
+            nd = node[active]
+            f = self.split_feature[nd]
+            v = X[active, f].astype(np.float64)
+            t = self.threshold[nd]
+            dl = self.default_left[nd]
+            mt = self.missing_type[nd]
+            isnan = np.isnan(v)
+            v0 = np.where(isnan, 0.0, v)
+            miss = np.where(
+                mt == MISSING_NAN, isnan,
+                np.where(mt == MISSING_ZERO,
+                         isnan | (np.abs(v0) <= K_ZERO_THRESHOLD), False),
+            )
+            go_left = np.where(miss, dl, v0 <= t)
+            nxt = np.where(go_left, self.left_child[nd], self.right_child[nd])
+            node[active] = nxt
+            idx = np.flatnonzero(active)
+            done = nxt < 0
+            leaf[idx[done]] = -nxt[done] - 1
+            active[idx[done]] = False
+        return leaf
+
+    def to_arrays(self, max_leaves: int) -> TreeArrays:
+        L = max_leaves
+        L1 = max(L - 1, 1)
+
+        def pad(a, n, dtype, fill=0):
+            out = np.full(n, fill, dtype=dtype)
+            out[: len(a)] = a
+            return jnp.asarray(out)
+
+        return TreeArrays(
+            num_leaves=jnp.asarray(self.num_leaves, jnp.int32),
+            split_feature=pad(self.split_feature, L1, np.int32),
+            threshold_bin=pad(self.threshold_bin, L1, np.int32),
+            threshold=pad(self.threshold, L1, np.float32),
+            default_left=pad(self.default_left, L1, bool),
+            missing_type=pad(self.missing_type, L1, np.int32),
+            left_child=pad(self.left_child, L1, np.int32, -1),
+            right_child=pad(self.right_child, L1, np.int32, -1),
+            split_gain=pad(self.split_gain, L1, np.float32),
+            internal_value=pad(self.internal_value, L1, np.float32),
+            internal_weight=pad(self.internal_weight, L1, np.float32),
+            internal_count=pad(self.internal_count, L1, np.float32),
+            leaf_value=pad(self.leaf_value, L, np.float32),
+            leaf_weight=pad(self.leaf_weight, L, np.float32),
+            leaf_count=pad(self.leaf_count, L, np.float32),
+            leaf_parent=pad(self.leaf_parent, L, np.int32, -1),
+        )

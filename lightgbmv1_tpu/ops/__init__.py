@@ -1,0 +1,29 @@
+from .histogram import (
+    default_hist_method,
+    hist_frontier,
+    hist_leaves_onehot,
+    hist_leaves_scatter,
+    hist_one_leaf,
+)
+from .split import (
+    FeatureMeta,
+    SplitParams,
+    SplitResult,
+    find_best_split,
+    find_best_split_batch,
+    make_feature_meta,
+)
+
+__all__ = [
+    "default_hist_method",
+    "hist_frontier",
+    "hist_leaves_onehot",
+    "hist_leaves_scatter",
+    "hist_one_leaf",
+    "FeatureMeta",
+    "SplitParams",
+    "SplitResult",
+    "find_best_split",
+    "find_best_split_batch",
+    "make_feature_meta",
+]

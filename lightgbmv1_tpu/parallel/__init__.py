@@ -1,0 +1,7 @@
+"""Distributed training over jax.sharding meshes (ICI/DCN collectives).
+
+TPU-native replacement for the reference ``src/network`` stack (SURVEY.md §5
+"Distributed communication backend"): the socket/MPI Linkers and hand-rolled
+Bruck/recursive-halving collectives become ``jax.lax.psum`` /
+``psum_scatter`` / ``all_gather`` inside ``shard_map`` over a device mesh.
+"""

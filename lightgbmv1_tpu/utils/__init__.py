@@ -1,0 +1,22 @@
+from .log import (
+    LightGBMError,
+    log_debug,
+    log_fatal,
+    log_info,
+    log_warning,
+    register_callback,
+    set_verbosity,
+)
+from .timer import Timer, global_timer
+
+__all__ = [
+    "LightGBMError",
+    "log_debug",
+    "log_fatal",
+    "log_info",
+    "log_warning",
+    "register_callback",
+    "set_verbosity",
+    "Timer",
+    "global_timer",
+]

@@ -1,0 +1,55 @@
+"""Logging with reference-style levels (reference: include/LightGBM/utils/log.h:71-125).
+
+Fatal raises (the reference throws std::runtime_error); callbacks can be
+registered the way ``LGBM_RegisterLogCallback`` allows (c_api.h:54).
+"""
+
+from __future__ import annotations
+
+import sys
+from typing import Callable, Optional
+
+_LEVELS = {"fatal": -1, "warning": 0, "info": 1, "debug": 2}
+_level = 1
+_callback: Optional[Callable[[str], None]] = None
+
+
+class LightGBMError(RuntimeError):
+    pass
+
+
+def set_verbosity(verbosity: int) -> None:
+    """Map reference ``verbosity`` param: <0 fatal, 0 warning, 1 info, >1 debug."""
+    global _level
+    _level = max(-1, min(2, verbosity))
+
+
+def register_callback(fn: Optional[Callable[[str], None]]) -> None:
+    global _callback
+    _callback = fn
+
+
+def _emit(msg: str) -> None:
+    if _callback is not None:
+        _callback(msg)
+    else:
+        print(msg, file=sys.stderr, flush=True)
+
+
+def log_debug(msg: str) -> None:
+    if _level >= 2:
+        _emit(f"[LightGBM-TPU] [Debug] {msg}")
+
+
+def log_info(msg: str) -> None:
+    if _level >= 1:
+        _emit(f"[LightGBM-TPU] [Info] {msg}")
+
+
+def log_warning(msg: str) -> None:
+    if _level >= 0:
+        _emit(f"[LightGBM-TPU] [Warning] {msg}")
+
+
+def log_fatal(msg: str) -> None:
+    raise LightGBMError(msg)

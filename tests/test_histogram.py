@@ -1,0 +1,83 @@
+"""Histogram implementation equality tests — the analog of the reference's
+GPU/CPU comparator (gpu_tree_learner.cpp:71-98 CompareHistograms)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from lightgbmv1_tpu.ops.histogram import (
+    hist_leaves_onehot,
+    hist_leaves_scatter,
+    hist_one_leaf,
+)
+
+
+def make_inputs(rng, N=1000, F=5, B=16, L=4):
+    binned = jnp.asarray(rng.randint(0, B, size=(F, N)).astype(np.uint8))
+    g3 = jnp.asarray(rng.randn(N, 3).astype(np.float32))
+    leaf_id = jnp.asarray(rng.randint(0, L, size=N).astype(np.int32))
+    return binned, g3, leaf_id
+
+
+def numpy_hist(binned, g3, leaf_id, L, B):
+    binned, g3, leaf_id = map(np.asarray, (binned, g3, leaf_id))
+    F, N = binned.shape
+    out = np.zeros((L, F, B, 3), np.float64)
+    for n in range(N):
+        for f in range(F):
+            out[leaf_id[n], f, binned[f, n]] += g3[n]
+    return out
+
+
+def test_scatter_matches_numpy(rng):
+    binned, g3, leaf_id = make_inputs(rng, N=300, F=3, B=8, L=3)
+    expect = numpy_hist(binned, g3, leaf_id, 3, 8)
+    got = hist_leaves_scatter(binned, g3, leaf_id, 3, 8)
+    np.testing.assert_allclose(np.asarray(got), expect, rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize("precision", ["f32", "bf16x2"])
+def test_onehot_matches_scatter(rng, precision):
+    binned, g3, leaf_id = make_inputs(rng, N=2000, F=6, B=32, L=5)
+    ref = hist_leaves_scatter(binned, g3, leaf_id, 5, 32)
+    got = hist_leaves_onehot(binned, g3, leaf_id, 5, 32, precision=precision,
+                             row_chunk=512)
+    rtol = 1e-4 if precision == "f32" else 3e-3
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=rtol, atol=1e-2)
+
+
+def test_onehot_bf16_precision_hierarchy(rng):
+    """bf16x2 must be strictly more accurate than bf16."""
+    binned, g3, leaf_id = make_inputs(rng, N=4000, F=4, B=16, L=2)
+    ref = np.asarray(hist_leaves_scatter(binned, g3, leaf_id, 2, 16))
+    err16 = np.abs(np.asarray(
+        hist_leaves_onehot(binned, g3, leaf_id, 2, 16, precision="bf16")) - ref).max()
+    err16x2 = np.abs(np.asarray(
+        hist_leaves_onehot(binned, g3, leaf_id, 2, 16, precision="bf16x2")) - ref).max()
+    assert err16x2 < err16
+
+
+def test_count_channel_exact(rng):
+    """Counts (channel 2 with unit weights) must be exactly integral."""
+    binned, g3, leaf_id = make_inputs(rng, N=5000, F=3, B=16, L=4)
+    g3 = g3.at[:, 2].set(1.0)
+    got = np.asarray(hist_leaves_onehot(binned, g3, leaf_id, 4, 16, precision="bf16x2"))
+    counts = got[..., 2]
+    np.testing.assert_array_equal(counts, np.round(counts))
+    assert counts.sum() == 5000 * 3  # every row counted once per feature
+
+
+def test_hist_one_leaf_masks_rows(rng):
+    binned, g3, leaf_id = make_inputs(rng, N=500, F=4, B=8, L=3)
+    full = np.asarray(hist_leaves_scatter(binned, g3, leaf_id, 3, 8))
+    one = np.asarray(hist_one_leaf(binned, g3, leaf_id, jnp.asarray(1), 8))
+    np.testing.assert_allclose(one, full[1], rtol=1e-5, atol=1e-5)
+
+
+def test_padded_rows_dropped(rng):
+    """onehot path pads rows to the chunk size; padding must not leak."""
+    binned, g3, leaf_id = make_inputs(rng, N=777, F=2, B=8, L=3)
+    ref = hist_leaves_scatter(binned, g3, leaf_id, 3, 8)
+    got = hist_leaves_onehot(binned, g3, leaf_id, 3, 8, precision="f32", row_chunk=256)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=1e-4, atol=1e-4)

@@ -1,0 +1,233 @@
+"""End-to-end training tests (the analog of the reference's
+tests/python_package_test/test_engine.py strategy: small datasets, assert
+metric quality and semantic invariants)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from conftest import make_binary_problem, make_regression_problem
+from lightgbmv1_tpu.config import Config
+from lightgbmv1_tpu.io.dataset import BinnedDataset
+from lightgbmv1_tpu.models.gbdt import create_boosting
+
+
+def train(cfg_dict, X, y, n_iter=30, weight=None, Xv=None, yv=None):
+    cfg = Config.from_dict({"verbosity": -1, **cfg_dict})
+    ds = BinnedDataset.from_numpy(X, label=y, weight=weight, config=cfg)
+    g = create_boosting(cfg, ds)
+    if Xv is not None:
+        dv = BinnedDataset.from_numpy(Xv, label=yv, config=cfg, reference=ds)
+        g.add_valid(dv, "valid_0")
+    for _ in range(n_iter):
+        if g.train_one_iter():
+            break
+    return g
+
+
+def metric_value(results, name):
+    for _, metric, value, _ in results:
+        if metric == name:
+            return value
+    raise KeyError(name)
+
+
+def test_binary_auc():
+    X, y = make_binary_problem(2000)
+    g = train({"objective": "binary", "num_leaves": 31, "min_data_in_leaf": 5,
+               "metric": "auc,binary_logloss"}, X, y, 50)
+    auc = metric_value(g.eval_train(), "auc")
+    assert auc > 0.97
+
+
+def test_binary_validation_tracks():
+    X, y = make_binary_problem(3000, seed=1)
+    Xv, yv = make_binary_problem(800, seed=2)
+    g = train({"objective": "binary", "metric": "auc"}, X[:2000], y[:2000], 50,
+              Xv=Xv, yv=yv)
+    vauc = metric_value(g.eval_valid(), "auc")
+    assert vauc > 0.92
+
+
+def test_regression_l2():
+    X, y = make_regression_problem(2000)
+    g = train({"objective": "regression", "metric": "l2"}, X, y, 60)
+    l2 = metric_value(g.eval_train(), "l2")
+    assert l2 < 0.3 * np.var(y)
+
+
+def test_regression_learning_rate_shrinkage():
+    """Smaller learning rate learns strictly slower over few iterations."""
+    X, y = make_regression_problem(1000)
+    g_fast = train({"objective": "regression", "learning_rate": 0.3, "metric": "l2"}, X, y, 10)
+    g_slow = train({"objective": "regression", "learning_rate": 0.01, "metric": "l2"}, X, y, 10)
+    assert metric_value(g_fast.eval_train(), "l2") < metric_value(g_slow.eval_train(), "l2")
+
+
+def test_l1_objective_median_renewal():
+    X, y = make_regression_problem(1500)
+    g = train({"objective": "regression_l1", "metric": "l1"}, X, y, 60)
+    l1 = metric_value(g.eval_train(), "l1")
+    baseline = np.abs(y - np.median(y)).mean()
+    assert l1 < 0.5 * baseline
+
+
+def test_multiclass_softmax():
+    rng = np.random.RandomState(0)
+    X = rng.randn(2000, 6)
+    y = (X[:, 0] > 0.5).astype(int) + (X[:, 1] > 0).astype(int)  # 3 classes
+    g = train({"objective": "multiclass", "num_class": 3,
+               "metric": "multi_logloss,multi_error"}, X, y.astype(float), 30)
+    err = metric_value(g.eval_train(), "multi_error")
+    assert err < 0.15
+    assert g.num_trees() == g.iter * 3  # one tree per class per iteration
+
+
+def test_min_data_in_leaf_respected():
+    X, y = make_binary_problem(1000)
+    g = train({"objective": "binary", "min_data_in_leaf": 50}, X, y, 5)
+    for t in g.materialize_host_trees():
+        if t.num_leaves > 1:
+            assert t.leaf_count.min() >= 50
+
+
+def test_max_depth_respected():
+    X, y = make_binary_problem(1000)
+    g = train({"objective": "binary", "max_depth": 2, "num_leaves": 31,
+               "min_data_in_leaf": 5}, X, y, 3)
+    for t in g.materialize_host_trees():
+        # depth-2 tree has at most 4 leaves
+        assert t.num_leaves <= 4
+
+
+def test_num_leaves_respected():
+    X, y = make_binary_problem(2000)
+    g = train({"objective": "binary", "num_leaves": 7, "min_data_in_leaf": 5}, X, y, 3)
+    for t in g.materialize_host_trees():
+        assert t.num_leaves <= 7
+
+
+def test_tree_structure_consistency():
+    """Child pointers form a valid binary tree over num_leaves leaves."""
+    X, y = make_binary_problem(1000)
+    g = train({"objective": "binary", "num_leaves": 12, "min_data_in_leaf": 5}, X, y, 3)
+    for t in g.materialize_host_trees():
+        n = t.num_leaves
+        seen_leaves, seen_nodes = set(), set()
+        stack = [0]
+        while stack:
+            nd = stack.pop()
+            assert nd not in seen_nodes
+            seen_nodes.add(nd)
+            for c in (t.left_child[nd], t.right_child[nd]):
+                if c < 0:
+                    leaf = -c - 1
+                    assert leaf not in seen_leaves
+                    seen_leaves.add(leaf)
+                else:
+                    stack.append(int(c))
+        assert len(seen_leaves) == n
+        assert len(seen_nodes) == n - 1
+
+
+def test_leaf_counts_sum_to_n():
+    X, y = make_binary_problem(1000)
+    g = train({"objective": "binary", "num_leaves": 15, "min_data_in_leaf": 5}, X, y, 3)
+    for t in g.materialize_host_trees():
+        assert t.leaf_count.sum() == 1000
+
+
+def test_train_predict_consistency():
+    """Host-tree raw prediction on training data must reproduce the cached
+    training scores (the reference's CLI⇄Python consistency strategy,
+    tests/python_package_test/test_consistency.py)."""
+    X, y = make_binary_problem(800)
+    g = train({"objective": "binary", "min_data_in_leaf": 5}, X, y, 10)
+    scores = g.raw_train_scores()[:, 0]
+    pred = np.full(800, g._init_scores[0])
+    for t in g.materialize_host_trees():
+        pred += t.predict(X)
+    np.testing.assert_allclose(pred, scores, rtol=1e-4, atol=1e-4)
+
+
+def test_missing_values_learnable():
+    """NaN pattern carries signal; training must exploit it."""
+    rng = np.random.RandomState(3)
+    X = rng.randn(2000, 4)
+    y = (rng.rand(2000) > 0.5).astype(float)
+    X[y > 0.5, 0] = np.nan  # perfectly predictive missingness
+    g = train({"objective": "binary", "metric": "auc", "min_data_in_leaf": 5}, X, y, 10)
+    assert metric_value(g.eval_train(), "auc") > 0.99
+
+
+def test_weights_change_model():
+    X, y = make_binary_problem(1000)
+    w = np.where(y > 0, 10.0, 1.0)
+    g1 = train({"objective": "binary"}, X, y, 5)
+    g2 = train({"objective": "binary"}, X, y, 5, weight=w)
+    s1, s2 = g1.raw_train_scores(), g2.raw_train_scores()
+    assert np.abs(s1 - s2).max() > 1e-3
+
+
+def test_bagging():
+    X, y = make_binary_problem(2000)
+    g = train({"objective": "binary", "bagging_fraction": 0.5, "bagging_freq": 1,
+               "metric": "auc"}, X, y, 30)
+    assert metric_value(g.eval_train(), "auc") > 0.9
+    # bagged trees see roughly half the data
+    t = g.materialize_host_trees()[0]
+    assert t.leaf_count.sum() < 2000 * 0.7
+
+
+def test_goss():
+    X, y = make_binary_problem(2000)
+    from lightgbmv1_tpu.metrics import create_metrics
+    g = train({"objective": "binary", "boosting": "goss", "metric": "auc"}, X, y, 30)
+    assert metric_value(g.eval_train(), "auc") > 0.93
+
+
+def test_dart():
+    X, y = make_binary_problem(2000)
+    g = train({"objective": "binary", "boosting": "dart", "metric": "auc"}, X, y, 30)
+    assert metric_value(g.eval_train(), "auc") > 0.93
+
+
+def test_rf():
+    X, y = make_binary_problem(2000)
+    g = train({"objective": "binary", "boosting": "rf", "bagging_fraction": 0.6,
+               "bagging_freq": 1, "metric": "auc", "num_leaves": 31,
+               "min_data_in_leaf": 5}, X, y, 20)
+    assert metric_value(g.eval_train(), "auc") > 0.9
+
+
+def test_feature_fraction():
+    X, y = make_binary_problem(2000)
+    g = train({"objective": "binary", "feature_fraction": 0.5, "metric": "auc",
+               "feature_fraction_seed": 7}, X, y, 30)
+    assert metric_value(g.eval_train(), "auc") > 0.93
+
+
+def test_lambda_l2_regularizes():
+    X, y = make_regression_problem(1000)
+    g0 = train({"objective": "regression"}, X, y, 5)
+    g1 = train({"objective": "regression", "lambda_l2": 100.0}, X, y, 5)
+    # heavy L2 shrinks leaf outputs
+    m0 = max(np.abs(t.leaf_value).max() for t in g0.materialize_host_trees())
+    m1 = max(np.abs(t.leaf_value).max() for t in g1.materialize_host_trees())
+    assert m1 < m0
+
+
+def test_custom_gradients():
+    """Custom objective path (reference: LGBM_BoosterUpdateOneIterCustom)."""
+    X, y = make_regression_problem(1000)
+    cfg = Config.from_dict({"objective": "none", "verbosity": -1, "min_data_in_leaf": 5})
+    ds = BinnedDataset.from_numpy(X, label=y, config=cfg)
+    g = create_boosting(cfg, ds)
+    for _ in range(20):
+        scores = g.raw_train_scores()[:, 0]
+        grad = (scores - y).astype(np.float32)
+        hess = np.ones_like(grad)
+        g.train_one_iter(custom_grad=grad, custom_hess=hess)
+    mse = ((g.raw_train_scores()[:, 0] - y) ** 2).mean()
+    assert mse < 0.3 * np.var(y)
