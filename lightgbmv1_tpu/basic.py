@@ -1,0 +1,609 @@
+"""Dataset and Booster — the lightgbm-compatible Python API.
+
+TPU-native re-design of the reference python-package core
+(reference: ``python-package/lightgbm/basic.py`` — class Dataset :909 with
+lazy construction and reference-alignment, class Booster :1930 with
+``update`` :2315, custom-objective ``__boost`` :2381, ``predict`` :2816).
+
+Where the reference marshals numpy through ctypes into C++, this package
+keeps data in numpy/JAX arrays end to end; the Booster wraps the device
+GBDT driver (models/gbdt.py) directly.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from copy import deepcopy
+from typing import Any, Callable, Dict, List, Optional, Sequence, Union
+
+import numpy as np
+
+from .config import Config
+from .io.dataset import BinnedDataset
+from .io.model_text import LoadedModel, dump_model_dict, model_from_string, model_to_string
+from .io.parser import load_data_file
+from .metrics import create_metrics
+from .models.gbdt import GBDT, create_boosting
+from .models.tree import HostTree
+from .utils.log import LightGBMError, log_fatal, log_info, log_warning
+
+
+def _to_2d_numpy(data) -> np.ndarray:
+    if hasattr(data, "values") and not isinstance(data, np.ndarray):  # pandas
+        data = data.values
+    if hasattr(data, "toarray"):  # scipy sparse
+        data = data.toarray()
+    arr = np.asarray(data, dtype=np.float64)
+    if arr.ndim == 1:
+        arr = arr.reshape(1, -1)
+    return arr
+
+
+def _objective_string(config: Config) -> str:
+    """Objective line for the model file (reference gbdt.cpp ObjectiveName
+    + per-objective ToString, e.g. 'binary sigmoid:1')."""
+    obj = config.objective
+    if obj == "binary":
+        return f"binary sigmoid:{config.sigmoid:g}"
+    if obj in ("multiclass", "multiclassova"):
+        extra = f" sigmoid:{config.sigmoid:g}" if obj == "multiclassova" else ""
+        return f"{obj} num_class:{config.num_class}{extra}"
+    if obj == "lambdarank":
+        return "lambdarank"
+    if obj == "quantile":
+        return f"quantile alpha:{config.alpha:g}"
+    if obj == "huber":
+        return f"huber alpha:{config.alpha:g}"
+    if obj == "fair":
+        return f"fair c:{config.fair_c:g}"
+    if obj == "tweedie":
+        return f"tweedie tweedie_variance_power:{config.tweedie_variance_power:g}"
+    return obj
+
+
+class Dataset:
+    """Training data wrapper with lazy binning (reference basic.py:909)."""
+
+    def __init__(
+        self,
+        data,
+        label=None,
+        reference: Optional["Dataset"] = None,
+        weight=None,
+        group=None,
+        init_score=None,
+        feature_name="auto",
+        categorical_feature="auto",
+        params: Optional[Dict[str, Any]] = None,
+        free_raw_data: bool = False,
+    ):
+        self.params = dict(params or {})
+        self.reference = reference
+        self.free_raw_data = free_raw_data
+        self._binned: Optional[BinnedDataset] = None
+        self.feature_name = feature_name
+        self.categorical_feature = categorical_feature
+
+        if isinstance(data, (str, os.PathLike)):
+            cfg = Config.from_dict(self.params)
+            df = load_data_file(
+                str(data),
+                has_header=cfg.header,
+                label_column=cfg.label_column,
+                weight_column=cfg.weight_column,
+                group_column=cfg.group_column,
+                ignore_column=cfg.ignore_column,
+            )
+            self.data = df.X
+            label = df.label if label is None else label
+            weight = df.weight if weight is None else weight
+            group = df.group if group is None else group
+            init_score = getattr(df, "init_score", None) if init_score is None else init_score
+            if df.feature_names and feature_name == "auto":
+                self.feature_name = df.feature_names
+        else:
+            self.data = _to_2d_numpy(data) if data is not None else None
+
+        self.label = None if label is None else np.asarray(label, dtype=np.float64).ravel()
+        self.weight = None if weight is None else np.asarray(weight, dtype=np.float64).ravel()
+        self.group = None if group is None else np.asarray(group, dtype=np.int64).ravel()
+        self.init_score = None if init_score is None else np.asarray(init_score, dtype=np.float64)
+
+    # ------------------------------------------------------------------
+    def construct(self) -> "Dataset":
+        if self._binned is not None:
+            return self
+        if self.data is None:
+            log_fatal("Cannot construct Dataset: raw data was freed")
+        cfg = Config.from_dict(self.params)
+        cat = []
+        if self.categorical_feature not in ("auto", None):
+            names = self._feature_names_list()
+            for c in self.categorical_feature:
+                if isinstance(c, str):
+                    cat.append(names.index(c))
+                else:
+                    cat.append(int(c))
+        ref_binned = self.reference.construct()._binned if self.reference is not None else None
+        self._binned = BinnedDataset.from_numpy(
+            self.data,
+            label=self.label,
+            weight=self.weight,
+            group=self.group,
+            init_score=self.init_score,
+            config=cfg,
+            categorical_features=cat,
+            feature_names=self._feature_names_list(),
+            reference=ref_binned,
+        )
+        if self.free_raw_data:
+            self.data = None
+        return self
+
+    def _feature_names_list(self) -> Optional[List[str]]:
+        if isinstance(self.feature_name, (list, tuple)):
+            return list(self.feature_name)
+        if self.data is not None:
+            return [f"Column_{i}" for i in range(self.data.shape[1])]
+        return None
+
+    # ------------------------------------------------------------------
+    def create_valid(self, data, label=None, weight=None, group=None,
+                     init_score=None, params=None) -> "Dataset":
+        return Dataset(
+            data, label=label, reference=self, weight=weight, group=group,
+            init_score=init_score, params=params or self.params,
+        )
+
+    def set_label(self, label) -> "Dataset":
+        self.label = np.asarray(label, dtype=np.float64).ravel()
+        if self._binned is not None:
+            self._binned.metadata.label = self.label.astype(np.float32)
+        return self
+
+    def set_weight(self, weight) -> "Dataset":
+        self.weight = None if weight is None else np.asarray(weight, dtype=np.float64).ravel()
+        if self._binned is not None:
+            self._binned.metadata.weight = (
+                None if weight is None else self.weight.astype(np.float32))
+        return self
+
+    def set_group(self, group) -> "Dataset":
+        self.group = None if group is None else np.asarray(group, dtype=np.int64).ravel()
+        if self._binned is not None:
+            self._binned.metadata.set_group(self.group)
+        return self
+
+    def set_init_score(self, init_score) -> "Dataset":
+        self.init_score = None if init_score is None else np.asarray(init_score, np.float64)
+        if self._binned is not None:
+            self._binned.metadata.init_score = self.init_score
+        return self
+
+    def set_field(self, field_name: str, data) -> "Dataset":
+        return {
+            "label": self.set_label,
+            "weight": self.set_weight,
+            "group": self.set_group,
+            "init_score": self.set_init_score,
+        }[field_name](data)
+
+    def get_field(self, field_name: str):
+        return {
+            "label": self.label,
+            "weight": self.weight,
+            "group": self.group,
+            "init_score": self.init_score,
+        }[field_name]
+
+    def get_label(self):
+        return self.label
+
+    def get_weight(self):
+        return self.weight
+
+    def get_group(self):
+        return self.group
+
+    def get_init_score(self):
+        return self.init_score
+
+    def num_data(self) -> int:
+        if self._binned is not None:
+            return self._binned.num_data
+        return 0 if self.data is None else self.data.shape[0]
+
+    def num_feature(self) -> int:
+        if self._binned is not None:
+            return self._binned.num_features
+        return 0 if self.data is None else self.data.shape[1]
+
+    def subset(self, used_indices, params=None) -> "Dataset":
+        if self.data is None:
+            log_fatal("Cannot subset: raw data was freed")
+        idx = np.asarray(used_indices)
+        sub = Dataset(
+            self.data[idx],
+            label=None if self.label is None else self.label[idx],
+            weight=None if self.weight is None else self.weight[idx],
+            init_score=None if self.init_score is None else self.init_score[idx],
+            params=params or self.params,
+            reference=self,
+            feature_name=self.feature_name,
+            categorical_feature=self.categorical_feature,
+        )
+        return sub
+
+
+class Booster:
+    """Gradient boosting model handle (reference basic.py:1930)."""
+
+    def __init__(
+        self,
+        params: Optional[Dict[str, Any]] = None,
+        train_set: Optional[Dataset] = None,
+        model_file: Optional[str] = None,
+        model_str: Optional[str] = None,
+    ):
+        self.params = dict(params or {})
+        self.best_iteration = -1
+        self.best_score: Dict[str, Dict[str, float]] = {}
+        self._gbdt: Optional[GBDT] = None
+        self._loaded: Optional[LoadedModel] = None
+        self.train_set = train_set
+        self._name_valid_sets: List[str] = []
+        self._pred_objective = None
+
+        if train_set is not None:
+            if not isinstance(train_set, Dataset):
+                raise TypeError("train_set must be a Dataset")
+            train_set.params = {**self.params, **train_set.params} \
+                if train_set.params else dict(self.params)
+            train_set.params.update(self.params)
+            train_set.construct()
+            self.config = Config.from_dict(self.params)
+            self._gbdt = create_boosting(self.config, train_set._binned)
+        elif model_file is not None:
+            with open(model_file) as fh:
+                self._init_from_string(fh.read())
+        elif model_str is not None:
+            self._init_from_string(model_str)
+        else:
+            raise TypeError("Need at least one of train_set, model_file, model_str")
+
+    # ------------------------------------------------------------------
+    def _init_from_string(self, s: str) -> None:
+        self._loaded = model_from_string(s)
+        params = {"objective": self._loaded.objective}
+        if self._loaded.num_class > 1:
+            params["num_class"] = self._loaded.num_class
+        op = self._loaded.objective_params
+        if "sigmoid" in op:
+            params["sigmoid"] = float(op["sigmoid"])
+        if "alpha" in op:
+            params["alpha"] = float(op["alpha"])
+        self.config = Config.from_dict(params)
+        from .objectives import create_objective
+
+        self._pred_objective = create_objective(self.config)
+
+    # ------------------------------------------------------------------
+    def add_valid(self, data: Dataset, name: str) -> "Booster":
+        if self._gbdt is None:
+            log_fatal("Cannot add validation data to a loaded model")
+        data.construct()
+        self._gbdt.add_valid(data._binned, name)
+        self._name_valid_sets.append(name)
+        return self
+
+    def update(self, train_set: Optional[Dataset] = None,
+               fobj: Optional[Callable] = None) -> bool:
+        """One boosting iteration; returns True when no further splits are
+        possible (reference basic.py:2315 update / __boost :2381)."""
+        if self._gbdt is None:
+            log_fatal("Cannot update a loaded model")
+        if train_set is not None:
+            log_fatal("Resetting train_set is not supported")
+        if fobj is None:
+            return self._gbdt.train_one_iter()
+        preds = self._gbdt.raw_train_scores()
+        if self._gbdt.num_class == 1:
+            preds = preds[:, 0]
+        grad, hess = fobj(preds, self.train_set)
+        return self._gbdt.train_one_iter(
+            custom_grad=np.asarray(grad), custom_hess=np.asarray(hess)
+        )
+
+    def rollback_one_iter(self) -> "Booster":
+        if self._gbdt is not None:
+            self._gbdt.rollback_one_iter()
+        return self
+
+    def current_iteration(self) -> int:
+        if self._gbdt is not None:
+            return self._gbdt.iter
+        return self._loaded.num_iterations
+
+    def num_trees(self) -> int:
+        if self._gbdt is not None:
+            return self._gbdt.num_trees()
+        return len(self._loaded.trees)
+
+    def num_model_per_iteration(self) -> int:
+        if self._gbdt is not None:
+            return self._gbdt.num_model_per_iteration
+        return self._loaded.num_tree_per_iteration
+
+    def num_feature(self) -> int:
+        if self._gbdt is not None:
+            return self._gbdt.train_set.num_features
+        return self._loaded.max_feature_idx + 1
+
+    def feature_name(self) -> List[str]:
+        if self._gbdt is not None:
+            return list(self._gbdt.train_set.feature_names)
+        return list(self._loaded.feature_names)
+
+    def reset_parameter(self, params: Dict[str, Any]) -> "Booster":
+        self.params.update(params)
+        if self._gbdt is not None:
+            self._gbdt.config.update(params)
+        return self
+
+    # ------------------------------------------------------------------
+    def eval_train(self, feval=None):
+        out = [("training",) + tuple(r[1:]) for r in self._gbdt.eval_train()]
+        return self._add_feval(out, feval, "training", self._gbdt.raw_train_scores(),
+                               self.train_set)
+
+    def eval_valid(self, feval=None):
+        results = self._gbdt.eval_valid()
+        out = list(results)
+        if feval is not None:
+            for i, name in enumerate(self._name_valid_sets):
+                scores = np.asarray(self._gbdt._valid_scores[i].score)
+                vs = self._gbdt._valid_sets[i]
+                out = self._add_feval(out, feval, name, scores, vs)
+        return out
+
+    def _add_feval(self, out, feval, name, raw_scores, dataset):
+        if feval is None:
+            return out
+        fevals = feval if isinstance(feval, (list, tuple)) else [feval]
+        preds = raw_scores[:, 0] if raw_scores.shape[1] == 1 else raw_scores
+        for f in fevals:
+            res = f(preds, dataset)
+            if isinstance(res, tuple):
+                res = [res]
+            for metric_name, value, hb in res:
+                out.append((name, metric_name, value, hb))
+        return out
+
+    # ------------------------------------------------------------------
+    def _all_trees(self) -> List[HostTree]:
+        trees: List[HostTree] = []
+        if self._loaded is not None:
+            trees.extend(self._loaded.trees)
+        if self._gbdt is not None:
+            trees.extend(self._gbdt.materialize_host_trees())
+        return trees
+
+    def predict(
+        self,
+        data,
+        start_iteration: int = 0,
+        num_iteration: Optional[int] = None,
+        raw_score: bool = False,
+        pred_leaf: bool = False,
+        pred_contrib: bool = False,
+        **kwargs,
+    ) -> np.ndarray:
+        """Prediction on raw features (reference basic.py:2816 / Predictor)."""
+        if isinstance(data, (str, os.PathLike)):
+            df = load_data_file(str(data), is_predict=True)
+            X = df.X
+            # prediction files usually carry the label column like training
+            # files do (reference Predictor convention); detect by column
+            # count and strip it
+            if X.shape[1] == self.num_feature() + 1:
+                X = X[:, 1:]
+        else:
+            X = _to_2d_numpy(data)
+        trees = self._all_trees()
+        K = self.num_model_per_iteration()
+        if num_iteration is None or num_iteration < 0:
+            num_iteration = (self.best_iteration
+                             if self.best_iteration and self.best_iteration > 0
+                             else len(trees) // K)
+        trees = trees[start_iteration * K: (start_iteration + num_iteration) * K]
+
+        if pred_leaf:
+            out = np.stack([t.predict_leaf_index(X) for t in trees], axis=1)
+            return out
+        if pred_contrib:
+            return self._predict_contrib(X, trees, K)
+
+        n = X.shape[0]
+        raw = np.zeros((n, K), dtype=np.float64)
+        for i, t in enumerate(trees):
+            raw[:, i % K] += t.predict(X)
+        # the boost-from-average constant lives inside tree leaf values
+        # (AddBias, reference gbdt.cpp:381-383), so no base term is added
+        from .models.gbdt import RF
+
+        avg = (self._loaded.average_output if self._loaded is not None
+               else isinstance(self._gbdt, RF))
+        if avg and trees:
+            raw = raw / (len(trees) // K)
+        if raw_score:
+            return raw[:, 0] if K == 1 else raw
+        obj = self._gbdt.objective if self._gbdt is not None else self._pred_objective
+        if obj is not None:
+            converted = obj.convert_output(raw if K > 1 else raw[:, 0])
+            return np.asarray(converted)
+        return raw[:, 0] if K == 1 else raw
+
+    def _predict_contrib(self, X, trees, K):
+        """SHAP-style feature contributions via per-tree path attribution
+        (reference: Tree::PredictContrib tree.h:138). Simplified: uses the
+        Saabas attribution (internal_value deltas along the decision path)."""
+        n, F = X.shape
+        out = np.zeros((n, K * (F + 1)), dtype=np.float64)
+        for ti, t in enumerate(trees):
+            k = ti % K
+            if t.num_leaves <= 1:
+                # constant tree (e.g. the embedded boost-from-average init):
+                # its value belongs in the base-value column
+                if t.num_leaves == 1:
+                    out[:, k * (F + 1) + F] += float(t.leaf_value[0])
+                continue
+            contribs = _tree_saabas_contrib(t, X)
+            out[:, k * (F + 1): k * (F + 1) + F] += contribs[:, :F]
+            out[:, k * (F + 1) + F] += contribs[:, F]
+        return out[:, : F + 1] if K == 1 else out
+
+    # ------------------------------------------------------------------
+    def model_to_string(self, num_iteration: Optional[int] = None,
+                        start_iteration: int = 0) -> str:
+        trees = self._all_trees()
+        K = self.num_model_per_iteration()
+        if num_iteration is None or num_iteration < 0:
+            num_iteration = (self.best_iteration
+                             if self.best_iteration and self.best_iteration > 0
+                             else len(trees) // K)
+        trees = trees[start_iteration * K: (start_iteration + num_iteration) * K]
+        if self._gbdt is not None:
+            cfg = self.config
+            ds = self._gbdt.train_set
+            feature_names = list(ds.feature_names)
+            feature_infos = ds.feature_infos()
+            objective_string = _objective_string(cfg)
+            from .models.gbdt import RF
+
+            average_output = isinstance(self._gbdt, RF)
+            params = {
+                "boosting": cfg.boosting, "objective": cfg.objective,
+                "metric": ",".join(cfg.metric), "learning_rate": cfg.learning_rate,
+                "num_leaves": cfg.num_leaves, "max_depth": cfg.max_depth,
+                "min_data_in_leaf": cfg.min_data_in_leaf,
+                "min_sum_hessian_in_leaf": cfg.min_sum_hessian_in_leaf,
+                "bagging_fraction": cfg.bagging_fraction,
+                "bagging_freq": cfg.bagging_freq,
+                "feature_fraction": cfg.feature_fraction,
+                "lambda_l1": cfg.lambda_l1, "lambda_l2": cfg.lambda_l2,
+                "max_bin": cfg.max_bin, "seed": cfg.seed,
+            }
+        else:
+            lm = self._loaded
+            feature_names = lm.feature_names
+            feature_infos = lm.feature_infos
+            objective_string = lm.objective + "".join(
+                f" {k}:{v}" for k, v in lm.objective_params.items())
+            average_output = lm.average_output
+            params = lm.parameters
+        return model_to_string(
+            trees,
+            objective_string=objective_string,
+            num_class=self.config.num_class if self._gbdt is not None else self._loaded.num_class,
+            num_tree_per_iteration=K,
+            feature_names=feature_names,
+            feature_infos=feature_infos,
+            average_output=average_output,
+            parameters=params,
+        )
+
+    def save_model(self, filename, num_iteration: Optional[int] = None,
+                   start_iteration: int = 0) -> "Booster":
+        with open(filename, "w") as fh:
+            fh.write(self.model_to_string(num_iteration, start_iteration))
+        return self
+
+    def dump_model(self, num_iteration: Optional[int] = None,
+                   start_iteration: int = 0) -> Dict:
+        trees = self._all_trees()
+        K = self.num_model_per_iteration()
+        if num_iteration is None or num_iteration < 0:
+            num_iteration = len(trees) // K
+        trees = trees[start_iteration * K: (start_iteration + num_iteration) * K]
+        if self._gbdt is not None:
+            ds = self._gbdt.train_set
+            names, infos = list(ds.feature_names), ds.feature_infos()
+            objective_string = _objective_string(self.config)
+            num_class = self.config.num_class
+        else:
+            names, infos = self._loaded.feature_names, self._loaded.feature_infos
+            objective_string = self._loaded.objective
+            num_class = self._loaded.num_class
+        return dump_model_dict(
+            trees, objective_string=objective_string, num_class=num_class,
+            num_tree_per_iteration=K, feature_names=names, feature_infos=infos,
+        )
+
+    # ------------------------------------------------------------------
+    def feature_importance(self, importance_type: str = "split",
+                           iteration: Optional[int] = None) -> np.ndarray:
+        trees = self._all_trees()
+        K = self.num_model_per_iteration()
+        if iteration is not None and iteration >= 0:
+            trees = trees[: iteration * K]
+        F = self.num_feature()
+        out = np.zeros(F, dtype=np.float64)
+        for t in trees:
+            for i in range(t.num_leaves - 1):
+                f = t.split_feature[i]
+                if importance_type == "split":
+                    out[f] += 1
+                else:
+                    out[f] += t.split_gain[i]
+        if importance_type == "split":
+            return out.astype(np.int64)
+        return out
+
+    def __copy__(self):
+        return self
+
+    def free_dataset(self) -> "Booster":
+        return self
+
+    def free_network(self) -> "Booster":
+        return self
+
+
+def _tree_saabas_contrib(tree: HostTree, X: np.ndarray) -> np.ndarray:
+    """Per-feature contribution by walking the path and attributing value
+    deltas to split features; column F holds the root expected value."""
+    n, F = X.shape
+    out = np.zeros((n, F + 1))
+    from .io.binning import K_ZERO_THRESHOLD, MISSING_NAN, MISSING_ZERO
+
+    node = np.zeros(n, dtype=np.int64)
+    active = np.ones(n, dtype=bool)
+    cur_val = np.full(n, tree.internal_value[0] if tree.num_leaves > 1 else 0.0)
+    out[:, F] = cur_val
+    while active.any():
+        nd = node[active]
+        f = tree.split_feature[nd]
+        v = X[active, f]
+        t_ = tree.threshold[nd]
+        dl = tree.default_left[nd]
+        mt = tree.missing_type[nd]
+        isnan = np.isnan(v)
+        v0 = np.where(isnan, 0.0, v)
+        miss = np.where(mt == MISSING_NAN, isnan,
+                        np.where(mt == MISSING_ZERO,
+                                 isnan | (np.abs(v0) <= K_ZERO_THRESHOLD), False))
+        go_left = np.where(miss, dl, v0 <= t_)
+        nxt = np.where(go_left, tree.left_child[nd], tree.right_child[nd])
+        nxt_val = np.where(
+            nxt < 0, tree.leaf_value[np.minimum(-nxt - 1, tree.num_leaves - 1)],
+            tree.internal_value[np.maximum(nxt, 0)]
+        )
+        idx = np.flatnonzero(active)
+        delta = nxt_val - cur_val[idx]
+        out[idx, f] += delta
+        cur_val[idx] = nxt_val
+        node[idx] = nxt
+        done = nxt < 0
+        active[idx[done]] = False
+    return out

@@ -1,0 +1,358 @@
+"""Model serialization — LightGBM v3 text format and JSON dump.
+
+TPU-native re-implementation of the reference model text layer
+(reference: ``src/boosting/gbdt_model_text.cpp`` — ``SaveModelToString``
+:306-397, ``LoadModelFromString`` :410+, ``DumpModel`` :21; per-tree block
+``Tree::ToString`` src/io/tree.cpp:223).
+
+The emitted format is field-compatible with the reference (``version=v3``
+header keys, per-tree ``Tree=i`` blocks, ``tree_sizes``, feature
+importances, embedded parameters block) so reference tooling can read our
+models and vice versa.
+
+decision_type byte (reference include/LightGBM/tree.h decision-type masks):
+bit0 = categorical, bit1 = default_left, bits 2-3 = missing type
+(0 None, 1 Zero, 2 NaN).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ..utils.log import log_fatal, log_warning
+from ..io.binning import MISSING_NAN, MISSING_NONE, MISSING_ZERO
+from ..models.tree import HostTree
+
+_K_CATEGORICAL_MASK = 1
+_K_DEFAULT_LEFT_MASK = 2
+
+
+def _encode_decision_type(is_cat: bool, default_left: bool, missing_type: int) -> int:
+    dt = 0
+    if is_cat:
+        dt |= _K_CATEGORICAL_MASK
+    if default_left:
+        dt |= _K_DEFAULT_LEFT_MASK
+    dt |= (int(missing_type) & 3) << 2
+    return dt
+
+
+def _decode_decision_type(dt: int):
+    return bool(dt & _K_CATEGORICAL_MASK), bool(dt & _K_DEFAULT_LEFT_MASK), (dt >> 2) & 3
+
+
+def _fmt_float(x: float) -> str:
+    """High-precision float formatting (reference Common::DoubleToStr)."""
+    return np.format_float_scientific(x, precision=16, trim="-").replace("e", "e")
+
+
+def _fmt_list(values, fmt=str) -> str:
+    return " ".join(fmt(v) for v in values)
+
+
+def tree_to_string(tree: HostTree, index: int) -> str:
+    """Per-tree block (reference: Tree::ToString, src/io/tree.cpp:223)."""
+    n = tree.num_leaves
+    lines = [f"Tree={index}"]
+    lines.append(f"num_leaves={n}")
+    lines.append("num_cat=0")
+    if n > 1:
+        dts = [
+            _encode_decision_type(False, bool(dl), int(mt))
+            for dl, mt in zip(tree.default_left, tree.missing_type)
+        ]
+        lines.append("split_feature=" + _fmt_list(tree.split_feature))
+        lines.append("split_gain=" + _fmt_list(tree.split_gain, lambda x: f"{x:.8g}"))
+        lines.append("threshold=" + _fmt_list(tree.threshold, _fmt_float))
+        lines.append("decision_type=" + _fmt_list(dts))
+        lines.append("left_child=" + _fmt_list(tree.left_child))
+        lines.append("right_child=" + _fmt_list(tree.right_child))
+        lines.append("leaf_value=" + _fmt_list(tree.leaf_value, _fmt_float))
+        lines.append("leaf_weight=" + _fmt_list(tree.leaf_weight, lambda x: f"{x:.8g}"))
+        lines.append("leaf_count=" + _fmt_list(tree.leaf_count))
+        lines.append("internal_value=" + _fmt_list(tree.internal_value, lambda x: f"{x:.8g}"))
+        lines.append("internal_weight=" + _fmt_list(tree.internal_weight, lambda x: f"{x:.8g}"))
+        lines.append("internal_count=" + _fmt_list(tree.internal_count))
+    else:
+        lines.append("leaf_value=" + _fmt_float(
+            tree.leaf_value[0] if len(tree.leaf_value) else 0.0))
+    lines.append(f"shrinkage={tree.shrinkage:g}")
+    return "\n".join(lines) + "\n"
+
+
+def _parse_tree_block(block: str) -> HostTree:
+    kv: Dict[str, str] = {}
+    index = 0
+    for line in block.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        if line.startswith("Tree="):
+            index = int(line.split("=", 1)[1])
+            continue
+        if "=" in line:
+            k, v = line.split("=", 1)
+            kv[k] = v
+
+    t = HostTree.__new__(HostTree)
+    n = int(kv["num_leaves"])
+    t.num_leaves = n
+    t.shrinkage = float(kv.get("shrinkage", 1.0))
+
+    def arr(key, dtype, size):
+        if key not in kv or not kv[key].strip():
+            return np.zeros(size, dtype=dtype)
+        return np.array(kv[key].split(), dtype=dtype)
+
+    n_nodes = max(n - 1, 0)
+    t.split_feature = arr("split_feature", np.int32, n_nodes)
+    t.split_gain = arr("split_gain", np.float64, n_nodes)
+    t.threshold = arr("threshold", np.float64, n_nodes)
+    dts = arr("decision_type", np.int32, n_nodes)
+    cats, dls, mts = [], [], []
+    for dt in dts:
+        c, d, m = _decode_decision_type(int(dt))
+        cats.append(c)
+        dls.append(d)
+        mts.append(m)
+    t.default_left = np.array(dls, dtype=bool) if n_nodes else np.zeros(0, bool)
+    t.missing_type = np.array(mts, dtype=np.int32) if n_nodes else np.zeros(0, np.int32)
+    t.left_child = arr("left_child", np.int32, n_nodes)
+    t.right_child = arr("right_child", np.int32, n_nodes)
+    t.leaf_value = arr("leaf_value", np.float64, n)
+    t.leaf_weight = arr("leaf_weight", np.float64, n)
+    t.leaf_count = arr("leaf_count", np.int64, n)
+    t.internal_value = arr("internal_value", np.float64, n_nodes)
+    t.internal_weight = arr("internal_weight", np.float64, n_nodes)
+    t.internal_count = arr("internal_count", np.int64, n_nodes)
+    t.threshold_bin = np.zeros(n_nodes, np.int32)  # not stored in text
+    # reconstruct leaf_parent from children
+    t.leaf_parent = np.full(n, -1, np.int32)
+    for nd in range(n_nodes):
+        for c in (t.left_child[nd], t.right_child[nd]):
+            if c < 0:
+                t.leaf_parent[-c - 1] = nd
+    return t
+
+
+@dataclass
+class LoadedModel:
+    """Parsed model — everything needed for prediction and continued use."""
+
+    trees: List[HostTree] = field(default_factory=list)
+    objective: str = "regression"
+    objective_params: Dict[str, str] = field(default_factory=dict)
+    num_class: int = 1
+    num_tree_per_iteration: int = 1
+    label_index: int = 0
+    max_feature_idx: int = 0
+    feature_names: List[str] = field(default_factory=list)
+    feature_infos: List[str] = field(default_factory=list)
+    average_output: bool = False
+    parameters: Dict[str, str] = field(default_factory=dict)
+
+    @property
+    def num_iterations(self) -> int:
+        return len(self.trees) // max(self.num_tree_per_iteration, 1)
+
+
+def model_to_string(
+    trees: List[HostTree],
+    *,
+    objective_string: str,
+    num_class: int,
+    num_tree_per_iteration: int,
+    feature_names: List[str],
+    feature_infos: List[str],
+    label_index: int = 0,
+    average_output: bool = False,
+    parameters: Optional[Dict[str, Any]] = None,
+) -> str:
+    """reference: GBDT::SaveModelToString, gbdt_model_text.cpp:306-397."""
+    out: List[str] = []
+    out.append("tree")
+    out.append("version=v3")
+    out.append(f"num_class={num_class}")
+    out.append(f"num_tree_per_iteration={num_tree_per_iteration}")
+    out.append(f"label_index={label_index}")
+    out.append(f"max_feature_idx={len(feature_names) - 1}")
+    out.append(f"objective={objective_string}")
+    if average_output:
+        out.append("average_output")
+    out.append("feature_names=" + " ".join(feature_names))
+    out.append("feature_infos=" + " ".join(feature_infos))
+
+    tree_strs = [tree_to_string(t, i) + "\n" for i, t in enumerate(trees)]
+    out.append("tree_sizes=" + " ".join(str(len(s)) for s in tree_strs))
+    out.append("")
+    for s in tree_strs:
+        out.append(s.rstrip("\n"))
+        out.append("")
+    out.append("end of trees")
+    out.append("")
+
+    # feature importances (split counts, descending — reference
+    # gbdt_model_text.cpp FeatureImportance section)
+    counts = np.zeros(len(feature_names), dtype=np.int64)
+    for t in trees:
+        for f in t.split_feature:
+            counts[f] += 1
+    order = np.argsort(-counts, kind="stable")
+    out.append("feature importances:")
+    for i in order:
+        if counts[i] > 0:
+            out.append(f"{feature_names[i]}={counts[i]}")
+    out.append("")
+    out.append("parameters:")
+    for k, v in (parameters or {}).items():
+        if isinstance(v, (list, tuple)):
+            v = ",".join(str(x) for x in v)
+        out.append(f"[{k}: {v}]")
+    out.append("end of parameters")
+    out.append("")
+    out.append("pandas_categorical:null")
+    return "\n".join(out) + "\n"
+
+
+def model_from_string(model_str: str) -> LoadedModel:
+    """reference: GBDT::LoadModelFromString, gbdt_model_text.cpp:410+."""
+    m = LoadedModel()
+    lines = model_str.splitlines()
+    i = 0
+    n = len(lines)
+    # header
+    while i < n and not lines[i].startswith("Tree="):
+        line = lines[i].strip()
+        i += 1
+        if not line or line == "tree":
+            continue
+        if line == "end of trees":
+            break
+        if line == "average_output":
+            m.average_output = True
+            continue
+        if "=" not in line:
+            continue
+        key, value = line.split("=", 1)
+        if key == "num_class":
+            m.num_class = int(value)
+        elif key == "num_tree_per_iteration":
+            m.num_tree_per_iteration = int(value)
+        elif key == "label_index":
+            m.label_index = int(value)
+        elif key == "max_feature_idx":
+            m.max_feature_idx = int(value)
+        elif key == "objective":
+            parts = value.split()
+            m.objective = parts[0] if parts else "regression"
+            for p in parts[1:]:
+                if ":" in p:
+                    k2, v2 = p.split(":", 1)
+                    m.objective_params[k2] = v2
+        elif key == "feature_names":
+            m.feature_names = value.split()
+        elif key == "feature_infos":
+            m.feature_infos = value.split()
+    # trees
+    while i < n:
+        line = lines[i].strip()
+        if line.startswith("Tree="):
+            block = [lines[i]]
+            i += 1
+            while i < n and lines[i].strip() != "" :
+                block.append(lines[i])
+                i += 1
+            m.trees.append(_parse_tree_block("\n".join(block)))
+        elif line == "end of trees":
+            i += 1
+            break
+        else:
+            i += 1
+    # parameters block
+    in_params = False
+    for j in range(i, n):
+        line = lines[j].strip()
+        if line == "parameters:":
+            in_params = True
+        elif line == "end of parameters":
+            in_params = False
+        elif in_params and line.startswith("[") and line.endswith("]"):
+            inner = line[1:-1]
+            if ": " in inner:
+                k, v = inner.split(": ", 1)
+                m.parameters[k] = v
+    if not m.trees and "Tree=" in model_str:
+        log_warning("Model parsing found no trees")
+    return m
+
+
+# ---------------------------------------------------------------------------
+# JSON dump (reference: GBDT::DumpModel, gbdt_model_text.cpp:21-120)
+# ---------------------------------------------------------------------------
+
+
+def _node_to_dict(tree: HostTree, node: int, feature_names: List[str]) -> Dict:
+    if node < 0:
+        leaf = -node - 1
+        return {
+            "leaf_index": int(leaf),
+            "leaf_value": float(tree.leaf_value[leaf]),
+            "leaf_weight": float(tree.leaf_weight[leaf]),
+            "leaf_count": int(tree.leaf_count[leaf]),
+        }
+    mt = {MISSING_NONE: "None", MISSING_ZERO: "Zero", MISSING_NAN: "NaN"}[
+        int(tree.missing_type[node])
+    ]
+    return {
+        "split_index": int(node),
+        "split_feature": int(tree.split_feature[node]),
+        "split_gain": float(tree.split_gain[node]),
+        "threshold": float(tree.threshold[node]),
+        "decision_type": "<=",
+        "default_left": bool(tree.default_left[node]),
+        "missing_type": mt,
+        "internal_value": float(tree.internal_value[node]),
+        "internal_weight": float(tree.internal_weight[node]),
+        "internal_count": int(tree.internal_count[node]),
+        "left_child": _node_to_dict(tree, int(tree.left_child[node]), feature_names),
+        "right_child": _node_to_dict(tree, int(tree.right_child[node]), feature_names),
+    }
+
+
+def dump_model_dict(
+    trees: List[HostTree],
+    *,
+    objective_string: str,
+    num_class: int,
+    num_tree_per_iteration: int,
+    feature_names: List[str],
+    feature_infos: List[str],
+    label_index: int = 0,
+    average_output: bool = False,
+) -> Dict:
+    return {
+        "name": "tree",
+        "version": "v3",
+        "num_class": num_class,
+        "num_tree_per_iteration": num_tree_per_iteration,
+        "label_index": label_index,
+        "max_feature_idx": len(feature_names) - 1,
+        "objective": objective_string,
+        "average_output": average_output,
+        "feature_names": list(feature_names),
+        "feature_infos": list(feature_infos),
+        "tree_info": [
+            {
+                "tree_index": i,
+                "num_leaves": t.num_leaves,
+                "num_cat": 0,
+                "shrinkage": t.shrinkage,
+                "tree_structure": _node_to_dict(t, 0 if t.num_leaves > 1 else -1,
+                                                feature_names),
+            }
+            for i, t in enumerate(trees)
+        ],
+    }

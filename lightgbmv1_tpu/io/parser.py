@@ -1,0 +1,190 @@
+"""Data file parsers: CSV / TSV / LibSVM with auto format detection.
+
+TPU-native replacement for the reference parsers (reference:
+``src/io/parser.cpp`` ``Parser::CreateParser`` auto-detection,
+``CSVParser``/``TSVParser``/``LibSVMParser``; loader conventions from
+``src/io/dataset_loader.cpp`` — label/weight/group columns, sibling
+``<file>.weight`` / ``<file>.query`` files, ``#`` comments, optional
+header).
+
+A native C++ fast path lives in ``native/`` (ctypes-loaded when built);
+this module is the always-available numpy fallback and the semantics
+reference.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from ..utils.log import log_fatal, log_info, log_warning
+
+
+def _detect_format(sample_lines: List[str]) -> str:
+    """reference: Parser::CreateParser auto-detection logic."""
+    for line in sample_lines:
+        if ":" in line.split("#", 1)[0]:
+            tokens = line.split()
+            # libsvm if any token beyond the first looks like idx:value
+            for tok in tokens[1:]:
+                if ":" in tok:
+                    head = tok.split(":", 1)[0]
+                    try:
+                        int(head)
+                        return "libsvm"
+                    except ValueError:
+                        break
+    first = sample_lines[0] if sample_lines else ""
+    if "\t" in first:
+        return "tsv"
+    if "," in first:
+        return "csv"
+    return "tsv"  # whitespace-separated
+
+
+def _parse_dense(lines: List[str], sep: Optional[str]) -> np.ndarray:
+    rows = []
+    for line in lines:
+        line = line.split("#", 1)[0].strip()
+        if not line:
+            continue
+        parts = line.split(sep) if sep else line.split()
+        rows.append([float(p) if p not in ("", "na", "nan", "NA", "NaN", "null")
+                     else np.nan for p in parts])
+    return np.asarray(rows, dtype=np.float64)
+
+
+def _parse_libsvm(lines: List[str]) -> Tuple[np.ndarray, np.ndarray]:
+    labels = []
+    entries = []  # (row, idx, val)
+    max_idx = -1
+    for r, line in enumerate(lines):
+        line = line.split("#", 1)[0].strip()
+        if not line:
+            continue
+        toks = line.split()
+        labels.append(float(toks[0]))
+        row = len(labels) - 1
+        for tok in toks[1:]:
+            if ":" not in tok:
+                continue
+            i, v = tok.split(":", 1)
+            idx = int(i)
+            max_idx = max(max_idx, idx)
+            entries.append((row, idx, float(v)))
+    X = np.zeros((len(labels), max_idx + 1), dtype=np.float64)
+    for r, c, v in entries:
+        X[r, c] = v
+    return X, np.asarray(labels)
+
+
+class DataFile:
+    """Parsed data file: features + label/weight/group metadata."""
+
+    def __init__(self, X, label=None, weight=None, group=None,
+                 feature_names=None):
+        self.X = X
+        self.label = label
+        self.weight = weight
+        self.group = group
+        self.feature_names = feature_names
+
+
+def _resolve_column(spec: str, header_names: Optional[List[str]], what: str) -> Optional[int]:
+    """Column spec: int index, or ``name:<colname>`` with header
+    (reference: config label_column conventions)."""
+    if spec == "":
+        return None
+    if spec.startswith("name:"):
+        name = spec[5:]
+        if not header_names:
+            log_fatal(f"{what} column by name requires header=true")
+        if name not in header_names:
+            log_fatal(f"{what} column {name} not found in header")
+        return header_names.index(name)
+    return int(spec)
+
+
+def load_data_file(
+    path: str,
+    *,
+    has_header: bool = False,
+    label_column: str = "",
+    weight_column: str = "",
+    group_column: str = "",
+    ignore_column: str = "",
+    is_predict: bool = False,
+) -> DataFile:
+    """Load a training/prediction data file with the reference's loader
+    conventions (reference: DatasetLoader::LoadFromFile,
+    src/io/dataset_loader.cpp:167; sibling weight/query files
+    metadata.cpp conventions)."""
+    if not os.path.exists(path):
+        log_fatal(f"Data file {path} does not exist")
+    with open(path) as fh:
+        lines = fh.read().splitlines()
+    header_names = None
+    if has_header and lines:
+        first = lines[0]
+        sep = "\t" if "\t" in first else ("," if "," in first else None)
+        header_names = first.split(sep) if sep else first.split()
+        lines = lines[1:]
+
+    fmt = _detect_format(lines[:20])
+    label = weight = group = None
+    if fmt == "libsvm":
+        X, label = _parse_libsvm(lines)
+        feature_names = None
+    else:
+        sep = "\t" if fmt == "tsv" and "\t" in (lines[0] if lines else "") else (
+            "," if fmt == "csv" else None)
+        data = _parse_dense(lines, sep)
+        label_idx = _resolve_column(label_column, header_names, "label")
+        if label_idx is None:
+            label_idx = 0 if not is_predict else None
+        weight_idx = _resolve_column(weight_column, header_names, "weight")
+        group_idx = _resolve_column(group_column, header_names, "group")
+        ignore = set()
+        if ignore_column:
+            for tok in ignore_column.split(","):
+                idx = _resolve_column(tok, header_names, "ignore")
+                if idx is not None:
+                    ignore.add(idx)
+        meta_cols = {c for c in (label_idx, weight_idx, group_idx) if c is not None}
+        keep = [c for c in range(data.shape[1])
+                if c not in meta_cols and c not in ignore]
+        X = data[:, keep]
+        feature_names = (
+            [header_names[c] for c in keep] if header_names else None
+        )
+        if label_idx is not None:
+            label = data[:, label_idx]
+        if weight_idx is not None:
+            weight = data[:, weight_idx]
+        if group_idx is not None:
+            # group column holds a query id per row -> convert to sizes
+            qid = data[:, group_idx]
+            change = np.flatnonzero(np.diff(qid) != 0)
+            bounds = np.concatenate([[0], change + 1, [len(qid)]])
+            group = np.diff(bounds)
+
+    # sibling files (reference: metadata loads <data>.weight / <data>.query)
+    wfile = path + ".weight"
+    if weight is None and os.path.exists(wfile):
+        weight = np.loadtxt(wfile, dtype=np.float64, ndmin=1)
+        log_info(f"Loading weights from {wfile}")
+    qfile = path + ".query"
+    if group is None and os.path.exists(qfile):
+        group = np.loadtxt(qfile, dtype=np.int64, ndmin=1)
+        log_info(f"Loading query boundaries from {qfile}")
+    ifile = path + ".init"
+    init_score = None
+    if os.path.exists(ifile):
+        init_score = np.loadtxt(ifile, dtype=np.float64)
+        log_info(f"Loading initial scores from {ifile}")
+
+    df = DataFile(X, label, weight, group, feature_names)
+    df.init_score = init_score
+    return df

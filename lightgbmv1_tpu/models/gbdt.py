@@ -124,6 +124,7 @@ class GBDT:
         self.models: List[Optional[HostTree]] = []  # flat: iter-major, class-minor
         self._device_trees: List[TreeArrays] = []
         self._model_shrink: List[float] = []
+        self._model_bias: List[float] = []
         # Host trees are materialized lazily (one batched device_get at the
         # end) unless the objective renews leaf outputs on the host — keeps
         # the per-iteration loop free of device->host syncs, which dominate
@@ -298,6 +299,10 @@ class GBDT:
         updates are correct no-ops and no ``num_leaves`` check is needed."""
         cfg = self.config
         rate = cfg.learning_rate if shrinkage is None else shrinkage
+        # init score is embedded into the saved model via AddBias
+        # (reference: gbdt.cpp:381-383), NOT into the score caches (those
+        # already carry it from _ScoreUpdater init)
+        bias = self._tree_bias(k)
 
         if self._needs_host_tree:
             host_tree = HostTree(jax.device_get(tree_dev))
@@ -312,12 +317,14 @@ class GBDT:
                     )
                 )
             host_tree.apply_shrinkage(rate)
+            host_tree.add_bias(bias)
             self.models.append(host_tree)
         else:
             self.models.append(None)  # materialized lazily in one batch
 
         shrunk = tree_dev._replace(leaf_value=tree_dev.leaf_value * rate)
         self._model_shrink.append(rate)
+        self._model_bias.append(bias)
 
         # score updates: train via partition gather, valid via binned predict
         self._train_scores.add_leaf_values(shrunk.leaf_value, leaf_id, k)
@@ -341,8 +348,16 @@ class GBDT:
                 # device leaf values already include shrinkage
                 ht.shrinkage = self._model_shrink[i]
                 self._fill_real_thresholds(ht)
+                ht.add_bias(self._model_bias[i])
                 self.models[i] = ht
         return self.models
+
+    def _tree_bias(self, k: int) -> float:
+        """Constant folded into this tree's saved leaf values.  GBDT: the
+        init score goes into the first tree of each class (gbdt.cpp:381)."""
+        if self.iter == 0 and not self._used_init_score:
+            return float(self._init_scores[k])
+        return 0.0
 
     def _fill_real_thresholds(self, tree: HostTree) -> None:
         mappers = self.train_set.bin_mappers
@@ -392,6 +407,7 @@ class GBDT:
         self.models = self.models[:n_models]
         self._device_trees = self._device_trees[:n_models]
         self._model_shrink = self._model_shrink[:n_models]
+        self._model_bias = self._model_bias[:n_models]
         self.iter -= 1
         self._prev_state = None
 
@@ -511,6 +527,7 @@ class DART(GBDT):
                         ),
                         self._device_trees[it * self.num_class + kk].leaf_value,
                         self._model_shrink[it * self.num_class + kk],
+                        self._model_bias[it * self.num_class + kk],
                     )
                     for it in drop_iters
                     for kk in range(self.num_class)
@@ -556,6 +573,8 @@ class DART(GBDT):
                     self._device_trees[idx] = self._device_trees[idx]._replace(
                         leaf_value=self._device_trees[idx].leaf_value * old_factor
                     )
+                    # the embedded init score scales with the tree
+                    self._model_bias[idx] *= old_factor
                     pred, vpreds = dropped_preds[idx]
                     self._train_scores.add_pred(old_factor * pred, k)
                     for vs, vp in zip(self._valid_scores, vpreds):
@@ -566,12 +585,21 @@ class DART(GBDT):
 
     def _remove_dropped(self, drop_iters: List[int]):
         """Subtract dropped trees from all score caches; return the cached
-        per-tree predictions keyed by model index."""
+        per-tree predictions keyed by model index.
+
+        Drops use the **bias-carrying** tree (the embedded init score included)
+        exactly like the reference, which drops via the saved model trees
+        (dart.hpp DroppingTrees uses models_, whose first tree absorbed the
+        init via AddBias) — this keeps score caches and the saved model
+        consistent under drop-normalization."""
         preds = {}
         for it in drop_iters:
             for k in range(self.num_class):
                 idx = it * self.num_class + k
                 tree = self._device_trees[idx]
+                b = self._model_bias[idx]
+                if b:
+                    tree = tree._replace(leaf_value=tree.leaf_value + b)
                 pred = tree_predict_binned(
                     tree, self.binned, self.meta.nan_bin, self.meta.missing_type
                 )
@@ -589,7 +617,7 @@ class DART(GBDT):
     def rollback_one_iter(self):
         if self._prev_state is not None and len(self._prev_state) == 4:
             dropped = self._prev_state[3]
-            for idx, (host_snap, dev_vals, shrink) in dropped.items():
+            for idx, (host_snap, dev_vals, shrink, bias) in dropped.items():
                 if host_snap is not None and self.models[idx] is not None:
                     lv, iv, sh = host_snap
                     self.models[idx].leaf_value = lv
@@ -599,6 +627,7 @@ class DART(GBDT):
                     leaf_value=dev_vals
                 )
                 self._model_shrink[idx] = shrink
+                self._model_bias[idx] = bias
             self._prev_state = self._prev_state[:3]
         super().rollback_one_iter()
 
@@ -613,19 +642,32 @@ class RF(GBDT):
         if config.bagging_freq <= 0 or config.bagging_fraction >= 1.0:
             log_fatal("RF mode requires bagging "
                       "(bagging_freq > 0 and bagging_fraction < 1)")
+        if train_set.metadata.init_score is not None:
+            log_fatal("RF mode does not support init_score (reference rf.hpp:44)")
         super().__init__(config, train_set, objective, metrics)
 
+    def _tree_bias(self, k: int) -> float:
+        # reference rf.hpp:136: every tree absorbs the init score, and
+        # prediction divides the summed output by the iteration count
+        return float(self._init_scores[k])
+
+    _cached_grads = None
+
     def _gradients(self):
-        # gradients always computed at the constant init score
-        init = jnp.asarray(
-            np.broadcast_to(self._init_scores[None, :], (self.num_data, self.num_class)),
-            jnp.float32,
-        )
-        s = init[:, 0] if self.num_class == 1 else init
-        grad, hess = self.objective.get_gradients(s)
-        if grad.ndim == 1:
-            grad, hess = grad[:, None], hess[:, None]
-        return grad, hess
+        # gradients always computed at the constant init score — computed
+        # once and reused (reference rf.hpp: "only boosting one time")
+        if self._cached_grads is None:
+            init = jnp.asarray(
+                np.broadcast_to(self._init_scores[None, :],
+                                (self.num_data, self.num_class)),
+                jnp.float32,
+            )
+            s = init[:, 0] if self.num_class == 1 else init
+            grad, hess = self.objective.get_gradients(s)
+            if grad.ndim == 1:
+                grad, hess = grad[:, None], hess[:, None]
+            self._cached_grads = (grad, hess)
+        return self._cached_grads
 
     def train_one_iter(self, custom_grad=None, custom_hess=None,
                        check_stop: bool = True) -> bool:

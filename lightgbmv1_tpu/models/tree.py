@@ -207,6 +207,16 @@ class HostTree:
         self.internal_value *= rate
         self.shrinkage *= rate
 
+    def add_bias(self, val: float) -> None:
+        """Fold a constant score into the tree (reference: Tree::AddBias,
+        tree.h:198-211 — used to embed the boost-from-average init score into
+        the saved model; forces shrinkage to 1)."""
+        if val == 0.0:
+            return
+        self.leaf_value += val
+        self.internal_value += val
+        self.shrinkage = 1.0
+
     def set_leaf_values(self, values: np.ndarray) -> None:
         self.leaf_value = np.asarray(values, dtype=np.float64)[: self.num_leaves]
 

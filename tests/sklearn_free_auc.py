@@ -1,0 +1,24 @@
+"""Tiny exact AUC helper for tests (no sklearn dependency needed)."""
+
+import numpy as np
+
+
+def auc_score(y_true, y_score):
+    y_true = np.asarray(y_true) > 0
+    order = np.argsort(y_score, kind="mergesort")
+    y = y_true[order]
+    s = np.asarray(y_score)[order]
+    # average ranks over ties
+    ranks = np.empty(len(s), dtype=np.float64)
+    i = 0
+    while i < len(s):
+        j = i
+        while j + 1 < len(s) and s[j + 1] == s[i]:
+            j += 1
+        ranks[i : j + 1] = (i + j) / 2.0 + 1.0
+        i = j + 1
+    npos = y.sum()
+    nneg = len(y) - npos
+    if npos == 0 or nneg == 0:
+        return 0.5
+    return (ranks[y].sum() - npos * (npos + 1) / 2.0) / (npos * nneg)

@@ -1,0 +1,203 @@
+"""lightgbm-compatible API tests: Dataset/Booster/train/cv/callbacks,
+model text round-trip (the reference's test_basic.py + test_consistency.py
+territory)."""
+
+import os
+
+import numpy as np
+import pytest
+
+import lightgbmv1_tpu as lgb
+from conftest import make_binary_problem, make_regression_problem
+
+
+def test_train_basic():
+    X, y = make_binary_problem(1500)
+    ds = lgb.Dataset(X, label=y, params={"verbosity": -1})
+    booster = lgb.train({"objective": "binary", "metric": "auc", "verbosity": -1,
+                         "min_data_in_leaf": 5}, ds, num_boost_round=20,
+                        verbose_eval=False)
+    assert booster.num_trees() == 20
+    pred = booster.predict(X)
+    assert pred.shape == (1500,)
+    assert ((pred >= 0) & (pred <= 1)).all()
+    from sklearn_free_auc import auc_score
+    assert auc_score(y, pred) > 0.95
+
+
+def test_predict_matches_training_scores():
+    """Saved-model prediction must equal the cached training scores
+    (reference consistency strategy) including the boost-from-average bias."""
+    X, y = make_binary_problem(800)
+    ds = lgb.Dataset(X, label=y, params={"verbosity": -1})
+    booster = lgb.train({"objective": "binary", "verbosity": -1,
+                         "min_data_in_leaf": 5}, ds, 10, verbose_eval=False)
+    raw = booster.predict(X, raw_score=True)
+    cached = booster._gbdt.raw_train_scores()[:, 0]
+    np.testing.assert_allclose(raw, cached, rtol=1e-4, atol=1e-4)
+
+
+def test_model_text_roundtrip(tmp_path):
+    X, y = make_binary_problem(800)
+    ds = lgb.Dataset(X, label=y, params={"verbosity": -1})
+    booster = lgb.train({"objective": "binary", "verbosity": -1,
+                         "min_data_in_leaf": 5}, ds, 8, verbose_eval=False)
+    path = str(tmp_path / "model.txt")
+    booster.save_model(path)
+    loaded = lgb.Booster(model_file=path)
+    assert loaded.num_trees() == booster.num_trees()
+    p1 = booster.predict(X)
+    p2 = loaded.predict(X)
+    np.testing.assert_allclose(p1, p2, rtol=1e-6, atol=1e-7)
+    # text format markers (v3 compatibility)
+    text = open(path).read()
+    for marker in ("tree\nversion=v3", "num_class=1", "feature_names=",
+                   "tree_sizes=", "Tree=0", "end of trees",
+                   "feature importances:", "parameters:", "pandas_categorical:null"):
+        assert marker in text, marker
+
+
+def test_model_text_roundtrip_multiclass(tmp_path):
+    rng = np.random.RandomState(0)
+    X = rng.randn(900, 5)
+    y = ((X[:, 0] > 0).astype(int) + (X[:, 1] > 0).astype(int)).astype(float)
+    ds = lgb.Dataset(X, label=y, params={"verbosity": -1})
+    booster = lgb.train({"objective": "multiclass", "num_class": 3,
+                         "verbosity": -1, "min_data_in_leaf": 5}, ds, 5,
+                        verbose_eval=False)
+    path = str(tmp_path / "model.txt")
+    booster.save_model(path)
+    loaded = lgb.Booster(model_file=path)
+    p1, p2 = booster.predict(X), loaded.predict(X)
+    assert p1.shape == (900, 3)
+    np.testing.assert_allclose(p1, p2, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(p1.sum(axis=1), 1.0, rtol=1e-5)
+
+
+def test_early_stopping():
+    X, y = make_binary_problem(2000, seed=1)
+    Xv, yv = make_binary_problem(500, seed=9)
+    ds = lgb.Dataset(X, label=y, params={"verbosity": -1})
+    dv = ds.create_valid(Xv, label=yv)
+    evals = {}
+    booster = lgb.train({"objective": "binary", "metric": "binary_logloss",
+                         "learning_rate": 0.3, "verbosity": -1,
+                         "min_data_in_leaf": 5},
+                        ds, 200, valid_sets=[dv],
+                        early_stopping_rounds=5, evals_result=evals,
+                        verbose_eval=False)
+    assert booster.best_iteration > 0
+    assert booster.best_iteration < 200
+    assert len(evals["valid_0"]["binary_logloss"]) < 200
+    # best_score recorded
+    assert "valid_0" in booster.best_score
+
+
+def test_record_evaluation_and_log_evaluation():
+    X, y = make_binary_problem(1000)
+    ds = lgb.Dataset(X, label=y, params={"verbosity": -1})
+    dv = ds.create_valid(*make_binary_problem(300, seed=5))
+    evals = {}
+    lgb.train({"objective": "binary", "metric": "auc", "verbosity": -1,
+               "min_data_in_leaf": 5}, ds, 7,
+              valid_sets=[dv], evals_result=evals, verbose_eval=False)
+    assert len(evals["valid_0"]["auc"]) == 7
+
+
+def test_custom_fobj_feval():
+    X, y = make_regression_problem(1000)
+    ds = lgb.Dataset(X, label=y, params={"verbosity": -1})
+
+    def l2_obj(preds, dataset):
+        return preds - dataset.get_label(), np.ones_like(preds)
+
+    def l1_eval(preds, dataset):
+        return "custom_l1", float(np.abs(preds - dataset.get_label()).mean()), False
+
+    evals = {}
+    booster = lgb.train({"verbosity": -1, "min_data_in_leaf": 5, "metric": "none"},
+                        ds, 30, valid_sets=[ds], fobj=l2_obj, feval=l1_eval,
+                        evals_result=evals, verbose_eval=False)
+    vals = evals["training"]["custom_l1"]
+    assert vals[-1] < vals[0] * 0.7
+
+
+def test_reset_parameter_callback():
+    X, y = make_regression_problem(800)
+    ds = lgb.Dataset(X, label=y, params={"verbosity": -1})
+    booster = lgb.train(
+        {"objective": "regression", "verbosity": -1, "min_data_in_leaf": 5},
+        ds, 10, valid_sets=[ds], verbose_eval=False,
+        callbacks=[lgb.reset_parameter(learning_rate=lambda i: 0.2 * (0.9 ** i))])
+    assert booster._gbdt.config.learning_rate < 0.2
+
+
+def test_cv():
+    X, y = make_binary_problem(1200)
+    ds = lgb.Dataset(X, label=y, params={"verbosity": -1})
+    res = lgb.cv({"objective": "binary", "metric": "auc", "verbosity": -1,
+                  "min_data_in_leaf": 5}, ds, num_boost_round=8, nfold=3,
+                 stratified=True, seed=1)
+    assert len(res["auc-mean"]) == 8
+    assert res["auc-mean"][-1] > 0.9
+    assert "auc-stdv" in res
+
+
+def test_feature_importance():
+    X, y = make_binary_problem(1500)
+    ds = lgb.Dataset(X, label=y, params={"verbosity": -1})
+    booster = lgb.train({"objective": "binary", "verbosity": -1,
+                         "min_data_in_leaf": 5}, ds, 10, verbose_eval=False)
+    imp_split = booster.feature_importance("split")
+    imp_gain = booster.feature_importance("gain")
+    assert imp_split.sum() > 0
+    assert imp_gain.sum() > 0
+    # feature 0 drives the label; it must matter most by gain
+    assert imp_gain.argmax() == 0
+
+
+def test_pred_leaf():
+    X, y = make_binary_problem(500)
+    ds = lgb.Dataset(X, label=y, params={"verbosity": -1})
+    booster = lgb.train({"objective": "binary", "verbosity": -1, "num_leaves": 8,
+                         "min_data_in_leaf": 5}, ds, 4, verbose_eval=False)
+    leaves = booster.predict(X, pred_leaf=True)
+    assert leaves.shape == (500, 4)
+    assert leaves.max() < 8
+
+
+def test_pred_contrib_sums_to_raw():
+    X, y = make_binary_problem(400)
+    ds = lgb.Dataset(X, label=y, params={"verbosity": -1})
+    booster = lgb.train({"objective": "binary", "verbosity": -1,
+                         "min_data_in_leaf": 5}, ds, 5, verbose_eval=False)
+    contrib = booster.predict(X, pred_contrib=True)
+    raw = booster.predict(X, raw_score=True)
+    assert contrib.shape == (400, X.shape[1] + 1)
+    np.testing.assert_allclose(contrib.sum(axis=1), raw, rtol=1e-4, atol=1e-4)
+
+
+def test_dataset_from_file(tmp_path):
+    """Reference example file format (TSV, label first column)."""
+    X, y = make_binary_problem(300)
+    path = str(tmp_path / "data.tsv")
+    np.savetxt(path, np.column_stack([y, X]), delimiter="\t", fmt="%.6f")
+    ds = lgb.Dataset(path, params={"verbosity": -1})
+    booster = lgb.train({"objective": "binary", "verbosity": -1,
+                         "min_data_in_leaf": 5}, ds, 5, verbose_eval=False)
+    assert booster.num_trees() == 5
+    assert ds.num_feature() == X.shape[1]
+
+
+def test_dump_model_json():
+    X, y = make_binary_problem(400)
+    ds = lgb.Dataset(X, label=y, params={"verbosity": -1})
+    booster = lgb.train({"objective": "binary", "verbosity": -1, "num_leaves": 5,
+                         "min_data_in_leaf": 5}, ds, 3, verbose_eval=False)
+    d = booster.dump_model()
+    assert d["version"] == "v3"
+    assert len(d["tree_info"]) == 3
+    ts = d["tree_info"][0]["tree_structure"]
+    assert "split_feature" in ts and "left_child" in ts
+    import json
+    json.dumps(d)  # must be serializable

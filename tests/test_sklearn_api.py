@@ -1,0 +1,86 @@
+"""sklearn wrapper tests (reference: tests/python_package_test/test_sklearn.py)."""
+
+import numpy as np
+import pytest
+
+import lightgbmv1_tpu as lgb
+from conftest import make_binary_problem, make_regression_problem
+from sklearn_free_auc import auc_score
+
+
+def test_regressor():
+    X, y = make_regression_problem(1200)
+    model = lgb.LGBMRegressor(n_estimators=30, min_child_samples=5)
+    model.fit(X, y)
+    pred = model.predict(X)
+    assert ((pred - y) ** 2).mean() < 0.3 * np.var(y)
+    assert model.n_features_ == X.shape[1]
+    assert model.feature_importances_.sum() > 0
+
+
+def test_classifier_binary():
+    X, y = make_binary_problem(1500)
+    model = lgb.LGBMClassifier(n_estimators=30, min_child_samples=5)
+    model.fit(X, y)
+    proba = model.predict_proba(X)
+    assert proba.shape == (1500, 2)
+    np.testing.assert_allclose(proba.sum(axis=1), 1.0, rtol=1e-6)
+    pred = model.predict(X)
+    assert set(np.unique(pred)) <= {0.0, 1.0}
+    assert (pred == y).mean() > 0.9
+    assert auc_score(y, proba[:, 1]) > 0.95
+
+
+def test_classifier_string_labels():
+    X, y = make_binary_problem(800)
+    labels = np.where(y > 0, "spam", "ham")
+    model = lgb.LGBMClassifier(n_estimators=10, min_child_samples=5)
+    model.fit(X, labels)
+    pred = model.predict(X)
+    assert set(np.unique(pred)) <= {"spam", "ham"}
+    assert (pred == labels).mean() > 0.85
+    assert list(model.classes_) == ["ham", "spam"]
+
+
+def test_classifier_multiclass():
+    rng = np.random.RandomState(0)
+    X = rng.randn(1500, 6)
+    y = (X[:, 0] > 0.5).astype(int) + (X[:, 1] > 0).astype(int)
+    model = lgb.LGBMClassifier(n_estimators=20, min_child_samples=5)
+    model.fit(X, y)
+    assert model.n_classes_ == 3
+    proba = model.predict_proba(X)
+    assert proba.shape == (1500, 3)
+    assert (model.predict(X) == y).mean() > 0.85
+
+
+def test_early_stopping_fit():
+    X, y = make_binary_problem(2000, seed=1)
+    Xv, yv = make_binary_problem(500, seed=2)
+    model = lgb.LGBMClassifier(n_estimators=200, learning_rate=0.3,
+                               min_child_samples=5)
+    model.fit(X, y, eval_set=[(Xv, yv)], eval_metric="binary_logloss",
+              early_stopping_rounds=5)
+    assert 0 < model.best_iteration_ < 200
+
+
+def test_ranker():
+    rng = np.random.RandomState(7)
+    n_q, q_size = 40, 20
+    X = rng.randn(n_q * q_size, 5)
+    rel = np.clip((X[:, 0] * 2 + rng.randn(n_q * q_size) * 0.5).round(), 0, 4)
+    group = np.full(n_q, q_size)
+    model = lgb.LGBMRanker(n_estimators=20, min_child_samples=5)
+    model.fit(X, rel, group=group, eval_metric="ndcg")
+    pred = model.predict(X)
+    # predictions must correlate with relevance
+    assert np.corrcoef(pred, rel)[0, 1] > 0.5
+
+
+def test_get_set_params():
+    model = lgb.LGBMRegressor(num_leaves=7, custom_thing=3)
+    params = model.get_params()
+    assert params["num_leaves"] == 7
+    assert params["custom_thing"] == 3
+    model.set_params(num_leaves=15)
+    assert model.num_leaves == 15

@@ -145,7 +145,8 @@ def test_train_predict_consistency():
     X, y = make_binary_problem(800)
     g = train({"objective": "binary", "min_data_in_leaf": 5}, X, y, 10)
     scores = g.raw_train_scores()[:, 0]
-    pred = np.full(800, g._init_scores[0])
+    # the boost-from-average init is embedded in the first tree (AddBias)
+    pred = np.zeros(800)
     for t in g.materialize_host_trees():
         pred += t.predict(X)
     np.testing.assert_allclose(pred, scores, rtol=1e-4, atol=1e-4)
@@ -231,3 +232,17 @@ def test_custom_gradients():
         g.train_one_iter(custom_grad=grad, custom_hess=hess)
     mse = ((g.raw_train_scores()[:, 0] - y) ** 2).mean()
     assert mse < 0.3 * np.var(y)
+
+
+def test_dart_predict_matches_scores():
+    """DART drop-normalization must keep the saved model consistent with the
+    cached training scores (incl. the embedded boost-from-average bias)."""
+    import lightgbmv1_tpu as lgb
+    X, y = make_binary_problem(600)
+    ds = lgb.Dataset(X, label=y, params={"verbosity": -1})
+    booster = lgb.train({"objective": "binary", "boosting": "dart",
+                         "drop_rate": 0.5, "skip_drop": 0.0, "verbosity": -1,
+                         "min_data_in_leaf": 5}, ds, 15, verbose_eval=False)
+    raw = booster.predict(X, raw_score=True)
+    cached = booster._gbdt.raw_train_scores()[:, 0]
+    np.testing.assert_allclose(raw, cached, rtol=1e-3, atol=1e-3)
