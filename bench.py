@@ -1,0 +1,82 @@
+"""Benchmark: HIGGS-shaped binary training throughput on one chip.
+
+Reference baseline (BASELINE.md / docs/Experiments.rst:110-124): LightGBM
+trains HIGGS (10.5M rows x 28 features, num_leaves=255, max_bin=255) at
+500 trees / 130.094 s on 2x Xeon E5-2690 v4 = **40.36M row-trees/s**.
+The GPU-learner benchmark config (docs/GPU-Performance.rst:108-124) uses
+max_bin=63; we follow the GPU config for bins since that is the
+device-offload comparison point.
+
+This bench trains on a synthetic HIGGS-shaped dataset (same feature count,
+bins, leaves) sized to this chip and reports throughput in the same unit:
+
+    value       = trained rows*trees per second (millions)
+    vs_baseline = value / 40.36   (>1 means faster than the reference CPU)
+
+Prints exactly one JSON line.
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+
+    from lightgbmv1_tpu.config import Config
+    from lightgbmv1_tpu.io.dataset import BinnedDataset
+    from lightgbmv1_tpu.models.gbdt import create_boosting
+
+    backend = jax.default_backend()
+    # HIGGS shape: 28 features; rows scaled down for bench wall-clock
+    N = int(os.environ.get("BENCH_ROWS", 1_000_000))
+    F = 28
+    TREES = int(os.environ.get("BENCH_TREES", 20))
+    if backend == "cpu":   # keep the CPU fallback quick
+        N, TREES = 100_000, 5
+
+    rng = np.random.RandomState(0)
+    X = rng.randn(N, F).astype(np.float32)
+    logit = X[:, 0] * 1.2 - X[:, 1] + 0.6 * X[:, 2] * X[:, 3] + 0.4 * X[:, 4]
+    y = (logit + rng.randn(N).astype(np.float32) > 0).astype(np.float64)
+
+    cfg = Config.from_dict({
+        "objective": "binary",
+        "num_leaves": 255,
+        "max_bin": 63,            # GPU benchmark config (GPU-Performance.rst)
+        "learning_rate": 0.1,
+        "min_data_in_leaf": 20,
+        "verbosity": -1,
+        # batched frontier growth keeps the MXU busy (depthwise policy —
+        # the same policy as xgboost_hist in the reference's comparison)
+        "tree_growth": "levelwise",
+    })
+    ds = BinnedDataset.from_numpy(X, label=y, config=cfg)
+    gbdt = create_boosting(cfg, ds)
+
+    # warmup: compiles the scanned multi-iteration step
+    gbdt.train_iters(TREES)
+    jax.block_until_ready(gbdt._train_scores.score)
+
+    t0 = time.time()
+    gbdt.train_iters(TREES)
+    jax.block_until_ready(gbdt._train_scores.score)
+    dt = time.time() - t0
+
+    row_trees_per_s = N * TREES / dt / 1e6
+    baseline = 10.5e6 * 500 / 130.094 / 1e6   # reference CPU HIGGS throughput
+    print(json.dumps({
+        "metric": f"higgs-shaped binary training throughput ({backend}, "
+                  f"{N} rows, 28 feat, 63 bins, 255 leaves)",
+        "value": round(row_trees_per_s, 3),
+        "unit": "M row-trees/s",
+        "vs_baseline": round(row_trees_per_s / baseline, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -145,31 +145,173 @@ class GBDT:
 
     # ------------------------------------------------------------------
     def _build_trainer(self):
-        cfg = self.config
-        method = default_hist_method(cfg.hist_method)
-        precision = cfg.hist_dtype
-        B = self.num_bins
+        from ..parallel.trainer import build_trainer
 
-        def hist_fn(binned, g3, leaf_id, target):
-            return hist_one_leaf(
-                binned, g3, leaf_id, target, B, method=method, precision=precision
-            )
-
-        if cfg.tree_learner in ("data", "feature", "voting"):
-            from ..parallel.trainer import wrap_parallel_hist
-
-            hist_fn = wrap_parallel_hist(hist_fn, cfg)
-
-        grow = make_leafwise_grower(
-            num_leaves=cfg.num_leaves,
-            num_bins=B,
-            meta=self.meta,
-            params=self.split_params,
-            max_depth=cfg.max_depth,
-            feature_fraction_bynode=cfg.feature_fraction_bynode,
-            hist_fn=hist_fn,
+        self._grow, self._grow_binned, _ = build_trainer(
+            self.config,
+            self.train_set.binned,
+            self.meta,
+            self.split_params,
+            self.num_bins,
         )
-        self._grow = jax.jit(grow)
+        self._step = None  # fused per-iteration step, built lazily
+
+    # ------------------------------------------------------------------
+    # Fused iteration: gradients -> sampling -> K tree builds -> score
+    # updates, all under ONE jit so an iteration is a single device
+    # dispatch.  Essential when the device sits behind a network tunnel and
+    # on TPU generally (SURVEY.md §3.3: one compiled step per iteration).
+    # ------------------------------------------------------------------
+    def _supports_fused_step(self) -> bool:
+        return (
+            self.objective is not None
+            and self.objective.renew_percentile is None
+            and not self._needs_host_tree
+        )
+
+    def _bag_fraction_mask(self, key, iteration):
+        """Traceable bagging mask (see _bagging_mask for semantics)."""
+        cfg = self.config
+        use_pos_neg = (
+            cfg.objective == "binary"
+            and (cfg.pos_bagging_fraction < 1.0 or cfg.neg_bagging_fraction < 1.0)
+        )
+        if cfg.bagging_freq <= 0 or (cfg.bagging_fraction >= 1.0 and not use_pos_neg):
+            return None
+        kk = jax.random.fold_in(
+            jax.random.PRNGKey(cfg.bagging_seed),
+            iteration // max(cfg.bagging_freq, 1),
+        )
+        if use_pos_neg:
+            label = self.objective.label
+            pos = jax.random.bernoulli(kk, cfg.pos_bagging_fraction, (self.num_data,))
+            neg = jax.random.bernoulli(
+                jax.random.fold_in(kk, 1), cfg.neg_bagging_fraction, (self.num_data,)
+            )
+            mask = jnp.where(label > 0, pos, neg)
+        else:
+            mask = jax.random.bernoulli(kk, cfg.bagging_fraction, (self.num_data,))
+        return mask.astype(jnp.float32)
+
+    def _build_step(self):
+        cfg = self.config
+        K = self.num_class
+        rate = cfg.learning_rate if not isinstance(self, RF) else 1.0
+        valid_binned = list(self._valid_binned)
+
+        def step(train_score, valid_scores, iteration, feat_masks):
+            s = train_score[:, 0] if K == 1 else train_score
+            grad, hess = self._objective_grads(s)
+            if grad.ndim == 1:
+                grad, hess = grad[:, None], hess[:, None]
+            bag = self._bag_fraction_mask(None, iteration)
+            trees = []
+            leaf_ids = []
+            for k in range(K):
+                g3 = self._sample_g3(grad[:, k], hess[:, k], bag, iteration)
+                key = jax.random.fold_in(self._rng_key, iteration * K + k)
+                tree_dev, leaf_id, _ = self._grow(
+                    self._grow_binned, g3, feat_masks[k], key
+                )
+                shrunk = tree_dev._replace(leaf_value=tree_dev.leaf_value * rate)
+                train_score = train_score.at[:, k].add(shrunk.leaf_value[leaf_id])
+                new_valid = []
+                for vb, vscore in zip(valid_binned, valid_scores):
+                    pred = tree_predict_binned(
+                        shrunk, vb, self.meta.nan_bin, self.meta.missing_type
+                    )
+                    new_valid.append(vscore.at[:, k].add(pred))
+                valid_scores = tuple(new_valid) if new_valid else valid_scores
+                trees.append(shrunk)
+                leaf_ids.append(leaf_id)
+            stacked = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *trees)
+            return train_score, valid_scores, stacked, jnp.stack(leaf_ids)
+
+        self._step_fn = step
+        return jax.jit(step)
+
+    def _objective_grads(self, s):
+        return self.objective.get_gradients(s)
+
+    # ------------------------------------------------------------------
+    def train_iters(self, n: int) -> None:
+        """Run ``n`` boosting iterations in a SINGLE device dispatch via
+        ``lax.scan`` over the fused step — the 'scan over trees on device'
+        option (SURVEY.md §3.3).  Amortizes host->device dispatch latency,
+        which dominates when the chip sits behind a network tunnel."""
+        if n <= 0:
+            return
+        if not self._supports_fused_step():
+            for _ in range(n):
+                if self.train_one_iter(check_stop=False):
+                    break
+            return
+        if self._step is None:
+            self._step = self._build_step()
+        if getattr(self, "_scan", None) is None:
+            step_fn = self._step_fn
+
+            def scan_fn(train_score, valid_scores, start_iter, feat_masks_all):
+                def body(carry, fm):
+                    ts, vs, it = carry
+                    ts, vs, stacked, _ = step_fn(ts, vs, it, fm)
+                    return (ts, vs, it + 1), stacked
+
+                (ts, vs, _), trees = jax.lax.scan(
+                    body, (train_score, valid_scores, start_iter), feat_masks_all
+                )
+                return ts, vs, trees
+
+            self._scan = jax.jit(scan_fn)
+
+        K = self.num_class
+        feat_masks = jnp.asarray(np.stack([
+            np.stack([self._tree_feature_mask() for _ in range(K)])
+            for _ in range(n)
+        ]))
+        vscores = tuple(vs.score for vs in self._valid_scores)
+        self._save_rollback_state()
+        new_train, new_valid, trees = self._scan(
+            self._train_scores.score, vscores,
+            jnp.asarray(self.iter, jnp.int32), feat_masks,
+        )
+        self._train_scores.score = new_train
+        for vs, s in zip(self._valid_scores, new_valid):
+            vs.score = s
+        for i in range(n):
+            for k in range(K):
+                self._device_trees.append(
+                    jax.tree_util.tree_map(lambda a: a[i, k], trees)
+                )
+                self.models.append(None)
+                self._model_shrink.append(
+                    self.config.learning_rate if not isinstance(self, RF) else 1.0
+                )
+                self._model_bias.append(self._tree_bias(k))
+            self.iter += 1
+
+    def _fused_train_one_iter(self) -> None:
+        if self._step is None:
+            self._step = self._build_step()
+        feat_masks = jnp.asarray(
+            np.stack([self._tree_feature_mask() for _ in range(self.num_class)])
+        )
+        vscores = tuple(vs.score for vs in self._valid_scores)
+        new_train, new_valid, stacked, leaf_ids = self._step(
+            self._train_scores.score, vscores,
+            jnp.asarray(self.iter, jnp.int32), feat_masks,
+        )
+        self._train_scores.score = new_train
+        for vs, s in zip(self._valid_scores, new_valid):
+            vs.score = s
+        for k in range(self.num_class):
+            tree_k = jax.tree_util.tree_map(lambda a: a[k], stacked)
+            self._device_trees.append(tree_k)
+            self.models.append(None)
+            self._model_shrink.append(
+                self.config.learning_rate if not isinstance(self, RF) else 1.0
+            )
+            self._model_bias.append(self._tree_bias(k))
 
     # ------------------------------------------------------------------
     def add_valid(self, valid_set: BinnedDataset, name: str) -> None:
@@ -262,6 +404,20 @@ class GBDT:
         signal when the best gain is non-positive).  ``check_stop=False``
         skips the device->host sync — the benchmark path."""
         cfg = self.config
+        if custom_grad is None and self._supports_fused_step():
+            self._save_rollback_state()
+            self._fused_train_one_iter()
+            self.iter += 1
+            if check_stop:
+                new = self._device_trees[-self.num_class:]
+                stopped = all(int(t.num_leaves) <= 1 for t in new)
+                if stopped:
+                    log_warning(
+                        "Stopped training because there are no more leaves "
+                        "that meet the split requirements"
+                    )
+                return stopped
+            return False
         self._save_rollback_state()
         if custom_grad is not None:
             grad = jnp.asarray(np.asarray(custom_grad).reshape(self.num_data, -1), jnp.float32)
@@ -275,7 +431,7 @@ class GBDT:
             g3 = self._sample_g3(grad[:, k], hess[:, k], bag, self.iter)
             key = jax.random.fold_in(self._rng_key, self.iter * self.num_class + k)
             base_mask = jnp.asarray(self._tree_feature_mask())
-            tree_dev, leaf_id, root_sum = self._grow(self.binned, g3, base_mask, key)
+            tree_dev, leaf_id, root_sum = self._grow(self._grow_binned, g3, base_mask, key)
             new_trees.append(self._finish_tree(tree_dev, leaf_id, k))
         self.iter += 1
         stopped = False
@@ -556,7 +712,7 @@ class DART(GBDT):
             g3 = self._sample_g3(grad[:, k], hess[:, k], bag, self.iter)
             key = jax.random.fold_in(self._rng_key, self.iter * self.num_class + k)
             base_mask = jnp.asarray(self._tree_feature_mask())
-            tree_dev, leaf_id, _ = self._grow(self.binned, g3, base_mask, key)
+            tree_dev, leaf_id, _ = self._grow(self._grow_binned, g3, base_mask, key)
             new_trees.append(
                 self._finish_tree(tree_dev, leaf_id, k, shrinkage=lr * new_factor)
             )
@@ -669,10 +825,19 @@ class RF(GBDT):
             self._cached_grads = (grad, hess)
         return self._cached_grads
 
+    def _objective_grads(self, s):
+        # gradients always evaluated at the constant init score
+        init = jnp.asarray(self._init_scores, jnp.float32)
+        const = jnp.broadcast_to(init[None, :], (self.num_data, self.num_class))
+        sc = const[:, 0] if self.num_class == 1 else const
+        return self.objective.get_gradients(sc)
+
     def train_one_iter(self, custom_grad=None, custom_hess=None,
                        check_stop: bool = True) -> bool:
         # trees are unshrunk; scores hold the running *sum*, converted to an
         # average at eval time
+        if custom_grad is None and self._supports_fused_step():
+            return GBDT.train_one_iter(self, check_stop=check_stop)
         cfg = self.config
         self._save_rollback_state()
         grad, hess = (
@@ -689,7 +854,7 @@ class RF(GBDT):
             g3 = self._sample_g3(grad[:, k], hess[:, k], bag, self.iter)
             key = jax.random.fold_in(self._rng_key, self.iter * self.num_class + k)
             base_mask = jnp.asarray(self._tree_feature_mask())
-            tree_dev, leaf_id, _ = self._grow(self.binned, g3, base_mask, key)
+            tree_dev, leaf_id, _ = self._grow(self._grow_binned, g3, base_mask, key)
             new_trees.append(self._finish_tree(tree_dev, leaf_id, k, shrinkage=1.0))
         self.iter += 1
         if custom_grad is None and check_stop:

@@ -87,6 +87,7 @@ def make_leafwise_grower(
     feature_fraction_bynode: float = 1.0,
     hist_fn: Callable = None,
     split_fn: Callable = None,
+    sums_fn: Callable = None,
 ):
     """Build the jittable ``grow(binned, g3, base_mask, key)`` function.
 
@@ -95,6 +96,9 @@ def make_leafwise_grower(
     ``split_fn(hist, parent_sum, feature_mask, key, uid) -> SplitResult`` —
     defaults to the local vectorized search; the feature-parallel learner
     substitutes a sharded search + cross-shard argmax.
+    ``sums_fn(g3) -> (3,)`` — root grad/hess/count totals (psum over the row
+    mesh axis in data-parallel mode; the analog of the reference's root
+    sum Allreduce, data_parallel_tree_learner.cpp:126-151).
     """
     L = num_leaves
     L1 = max(L - 1, 1)
@@ -102,6 +106,10 @@ def make_leafwise_grower(
     if split_fn is None:
         def split_fn(hist, parent, mask, key, uid):
             return find_best_split(hist, parent, meta, mask, params)
+
+    if sums_fn is None:
+        def sums_fn(g3):
+            return g3.sum(axis=0)
 
     def apply_decision(binned, leaf_id, leaf, new_leaf, feat, thr, dl):
         bins_f = binned[feat]                       # (N,) dynamic row gather
@@ -118,7 +126,7 @@ def make_leafwise_grower(
 
         leaf_id = jnp.zeros(N, jnp.int32)
         hist0 = hist_fn(binned, g3, leaf_id, jnp.asarray(0, jnp.int32))
-        root_sum = hist0[0].sum(axis=0)             # totals from any feature's bins
+        root_sum = sums_fn(g3)
         mask0 = _node_feature_mask(key, 0, base_mask, feature_fraction_bynode)
         res0 = split_fn(hist0, root_sum, mask0, key, 0)
 
@@ -245,5 +253,170 @@ def make_leafwise_grower(
 
         st = lax.fori_loop(0, L - 1, body, st) if L > 1 else st
         return st.tree, st.leaf_id, root_sum
+
+    return grow
+
+
+# ---------------------------------------------------------------------------
+# Level-wise (depth-wise) grower — the batched fast path
+# ---------------------------------------------------------------------------
+
+
+def make_levelwise_grower(
+    *,
+    num_leaves: int,
+    num_bins: int,
+    meta: FeatureMeta,
+    params: SplitParams,
+    max_depth: int = -1,
+    feature_fraction_bynode: float = 1.0,
+    hist_frontier_fn: Callable = None,
+    split_fn: Callable = None,
+    sums_fn: Callable = None,
+):
+    """Depth-wise tree growth with the whole frontier batched per level.
+
+    Rationale: an exact leaf-wise step histograms ONE leaf, which on the MXU
+    is a 3-row matmul (3/128 utilization).  Batching all `2^d` leaves of a
+    level multiplies the matmul row count by the frontier size, which is what
+    makes GBDT training MXU-bound instead of latency-bound.  Semantics match
+    xgboost_hist's depthwise policy — the configuration the reference
+    benchmarks itself against (docs/Experiments.rst:110-135) — with the
+    ``num_leaves`` budget enforced by per-level gain ranking.
+
+    ``hist_frontier_fn(binned, g3, leaf_id, L_level) -> (L_level, F, B, 3)``
+    computes histograms for every leaf in one pass (psum-wrapped when
+    data-parallel).
+    """
+    import math as _math
+
+    from ..ops.split import find_best_split_batch
+
+    L = num_leaves
+    L1 = max(L - 1, 1)
+    levels = _math.ceil(_math.log2(max(L, 2)))
+    if max_depth > 0:
+        levels = min(levels, max_depth)
+
+    if split_fn is None:
+        def split_fn(hist, parent, mask, key, uid):
+            return find_best_split(hist, parent, meta, mask, params)
+
+    if sums_fn is None:
+        def sums_fn(g3):
+            return g3.sum(axis=0)
+
+    def grow(binned, g3, base_mask, key):
+        N = binned.shape[1]
+        F = binned.shape[0]
+        from .tree import empty_tree
+
+        leaf_id = jnp.zeros(N, jnp.int32)
+        root_sum = sums_fn(g3)
+        tree = empty_tree(L)
+        leaf_sums = jnp.zeros((L, 3), jnp.float32).at[0].set(root_sum)
+        leaf_active = jnp.zeros(L, bool).at[0].set(True)
+        leaf_is_left = jnp.zeros(L, bool)
+        num_leaves_cur = jnp.asarray(1, jnp.int32)
+        num_nodes_cur = jnp.asarray(0, jnp.int32)
+
+        for d in range(levels):
+            Ld = min(1 << d, L)
+            hist = hist_frontier_fn(binned, g3, leaf_id, Ld)   # (Ld, F, B, 3)
+            if feature_fraction_bynode < 1.0:
+                masks = jnp.stack([
+                    _node_feature_mask(key, d * (2 * L) + i, base_mask,
+                                       feature_fraction_bynode)
+                    for i in range(Ld)
+                ])
+            else:
+                masks = jnp.broadcast_to(base_mask, (Ld, F))
+            res = jax.vmap(
+                lambda h, p, m: split_fn(h, p, m, key, d)
+            )(hist, leaf_sums[:Ld], masks)
+
+            gains = jnp.where(leaf_active[:Ld], res.gain, -jnp.inf)
+            want = gains > 0
+            # budget: rank wanted splits by gain, keep the top (L - current)
+            order = jnp.argsort(-jnp.where(want, gains, -jnp.inf))
+            rank = jnp.zeros(Ld, jnp.int32).at[order].set(
+                jnp.arange(Ld, dtype=jnp.int32))
+            budget = L - num_leaves_cur
+            split_mask = want & (rank < budget)
+
+            split_order = jnp.cumsum(split_mask.astype(jnp.int32)) - 1
+            node_idx = num_nodes_cur + split_order          # (Ld,)
+            new_leaf = num_leaves_cur + split_order
+
+            # per-row partition update (vectorized over all rows at once)
+            feat_l = jnp.where(split_mask, res.feature, 0)
+            thr_l = jnp.where(split_mask, res.threshold_bin, 0)
+            dl_l = res.default_left
+            lid_c = jnp.minimum(leaf_id, Ld - 1)
+            f_row = feat_l[lid_c]
+            in_split = split_mask[lid_c] & (leaf_id < Ld)
+            b_row = jnp.take_along_axis(binned, f_row[None, :], axis=0)[0]
+            is_na = (meta.missing_type[f_row] == MISSING_NAN) & (
+                b_row == meta.nan_bin[f_row]
+            )
+            go_left = jnp.where(is_na, dl_l[lid_c], b_row <= thr_l[lid_c])
+            leaf_id = jnp.where(in_split & (~go_left), new_leaf[lid_c], leaf_id)
+
+            # tree array updates (scatter with out-of-bounds drop for masked)
+            nd = jnp.where(split_mask, node_idx, L1 + 1)
+            nl = jnp.where(split_mask, new_leaf, L + 1)
+            ld_idx = jnp.where(split_mask, jnp.arange(Ld), L + 1)
+            parent_out = jax.vmap(
+                lambda s: leaf_output(s[0], s[1], params)
+            )(leaf_sums[:Ld])
+            left_out = jax.vmap(lambda s: leaf_output(s[0], s[1], params))(res.left_sum)
+            right_out = jax.vmap(lambda s: leaf_output(s[0], s[1], params))(res.right_sum)
+
+            t = tree
+            # re-wire parents of the split leaves
+            p = t.leaf_parent[jnp.minimum(ld_idx, L - 1)]
+            fix_l = jnp.where(split_mask & (p >= 0) & leaf_is_left[jnp.minimum(ld_idx, L - 1)],
+                              jnp.maximum(p, 0), L1 + 1)
+            fix_r = jnp.where(split_mask & (p >= 0) & (~leaf_is_left[jnp.minimum(ld_idx, L - 1)]),
+                              jnp.maximum(p, 0), L1 + 1)
+            lc = t.left_child.at[fix_l].set(nd, mode="drop")
+            rc = t.right_child.at[fix_r].set(nd, mode="drop")
+            lc = lc.at[nd].set(-(ld_idx + 1), mode="drop")
+            rc = rc.at[nd].set(-(nl + 1), mode="drop")
+            tree = t._replace(
+                num_leaves=num_leaves_cur + split_mask.sum(),
+                split_feature=t.split_feature.at[nd].set(res.feature, mode="drop"),
+                threshold_bin=t.threshold_bin.at[nd].set(res.threshold_bin, mode="drop"),
+                default_left=t.default_left.at[nd].set(res.default_left, mode="drop"),
+                missing_type=t.missing_type.at[nd].set(
+                    meta.missing_type[res.feature], mode="drop"),
+                left_child=lc,
+                right_child=rc,
+                split_gain=t.split_gain.at[nd].set(res.gain, mode="drop"),
+                internal_value=t.internal_value.at[nd].set(parent_out, mode="drop"),
+                internal_weight=t.internal_weight.at[nd].set(
+                    leaf_sums[:Ld, 1], mode="drop"),
+                internal_count=t.internal_count.at[nd].set(
+                    leaf_sums[:Ld, 2], mode="drop"),
+                leaf_value=t.leaf_value.at[ld_idx].set(left_out, mode="drop")
+                .at[nl].set(right_out, mode="drop"),
+                leaf_weight=t.leaf_weight.at[ld_idx].set(res.left_sum[:, 1], mode="drop")
+                .at[nl].set(res.right_sum[:, 1], mode="drop"),
+                leaf_count=t.leaf_count.at[ld_idx].set(res.left_sum[:, 2], mode="drop")
+                .at[nl].set(res.right_sum[:, 2], mode="drop"),
+                leaf_parent=t.leaf_parent.at[ld_idx].set(nd, mode="drop")
+                .at[nl].set(nd, mode="drop"),
+            )
+            leaf_sums = leaf_sums.at[ld_idx].set(res.left_sum, mode="drop") \
+                .at[nl].set(res.right_sum, mode="drop")
+            leaf_is_left = leaf_is_left.at[ld_idx].set(True, mode="drop") \
+                .at[nl].set(False, mode="drop")
+            leaf_active = (leaf_active & jnp.pad(split_mask, (0, L - Ld))
+                           if Ld < L else leaf_active & split_mask)
+            leaf_active = leaf_active.at[nl].set(True, mode="drop")
+            num_leaves_cur = num_leaves_cur + split_mask.sum()
+            num_nodes_cur = num_nodes_cur + split_mask.sum()
+
+        return tree, leaf_id, root_sum
 
     return grow

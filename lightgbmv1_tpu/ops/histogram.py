@@ -127,20 +127,18 @@ def hist_leaves_onehot(
             leaf_ck[None, :] == lax.broadcasted_iota(jnp.int32, (Lp, 1), 0)
         ).astype(jnp.float32)                                   # (Lp, C)
         lg = (leaf_onehot[:, None, :] * g3_ck.T[None, :, :]).reshape(Lp * 3, C)
-
-        def per_feature(bins_f):
-            onehot = (
-                bins_f[:, None].astype(jnp.int32)
-                == lax.broadcasted_iota(jnp.int32, (1, B), 1)
-            )                                                   # (C, B)
-            return _matmul_hist(lg, onehot, precision)          # (Lp*3, B)
-
-        h = lax.map(per_feature, bins_ck)                        # (F, Lp*3, B)
+        # one-hot over ALL features at once, laid out (C, F*B) so the whole
+        # chunk is a single large MXU matmul instead of F skinny ones
+        onehot = (
+            bins_ck.T[:, :, None].astype(jnp.int32)
+            == lax.broadcasted_iota(jnp.int32, (1, 1, B), 2)
+        ).reshape(C, F * B)                                     # (C, F*B)
+        h = _matmul_hist(lg, onehot, precision)                 # (Lp*3, F*B)
         return acc + h, None
 
-    init = jnp.zeros((F, Lp * 3, B), jnp.float32)
+    init = jnp.zeros((Lp * 3, F * B), jnp.float32)
     h, _ = lax.scan(chunk_body, init, (binned_c, g3_c, leaf_c))
-    h = h.reshape(F, Lp, 3, B).transpose(1, 0, 3, 2)             # (Lp, F, B, 3)
+    h = h.reshape(Lp, 3, F, B).transpose(0, 2, 3, 1)             # (Lp, F, B, 3)
     return h[:L]
 
 
