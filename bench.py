@@ -1,17 +1,28 @@
-"""Benchmark: HIGGS-shaped binary training throughput on one chip.
+"""Benchmark: HIGGS-shaped binary training throughput + AUC on one chip.
 
 Reference baseline (BASELINE.md / docs/Experiments.rst:110-124): LightGBM
-trains HIGGS (10.5M rows x 28 features, num_leaves=255, max_bin=255) at
-500 trees / 130.094 s on 2x Xeon E5-2690 v4 = **40.36M row-trees/s**.
-The GPU-learner benchmark config (docs/GPU-Performance.rst:108-124) uses
-max_bin=63; we follow the GPU config for bins since that is the
-device-offload comparison point.
+trains HIGGS (10.5M rows x 28 features, num_leaves=255) at 500 trees /
+130.094 s on 2x Xeon E5-2690 v4 = **40.36M row-trees/s**.  The GPU-learner
+benchmark config (docs/GPU-Performance.rst:108-124) uses max_bin=63; we
+follow the GPU config for bins since that is the device-offload comparison
+point.
 
 This bench trains on a synthetic HIGGS-shaped dataset (same feature count,
-bins, leaves) sized to this chip and reports throughput in the same unit:
+bins, leaves) sized to this chip and reports:
 
-    value       = trained rows*trees per second (millions)
+    value       = trained rows*trees per second (millions), measured with a
+                  full device sync (jax.device_get) — NOT block_until_ready,
+                  which does not synchronize through the axon tunnel
     vs_baseline = value / 40.36   (>1 means faster than the reference CPU)
+    auc         = held-out AUC after `auc_iters` total trees
+    auc_ref     = reference LightGBM (C++, leaf-wise) AUC on the SAME data
+                  and config, recorded from a run of the reference binary
+
+See PERF.md for measured ceilings of the benchmarked device — the tunneled
+single TPU chip in this environment sustains ~1.9 TF/s matmul and ~8.6 GB/s
+HBM (about 1% of a physical v5e), which bounds any implementation far below
+the 2x-Xeon baseline; vs_baseline on this device is therefore a relative
+engineering metric, not a statement about TPU silicon.
 
 Prints exactly one JSON line.
 """
@@ -24,6 +35,15 @@ import time
 import numpy as np
 
 
+def make_data(n, seed):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, 28).astype(np.float32)
+    logit = (X[:, 0] * 1.2 - X[:, 1] + 0.6 * X[:, 2] * X[:, 3]
+             + 0.4 * X[:, 4] + 0.3 * np.sin(3.0 * X[:, 5]))
+    y = (logit + rng.randn(n).astype(np.float32) > 0).astype(np.float64)
+    return X, y
+
+
 def main():
     import jax
 
@@ -32,17 +52,15 @@ def main():
     from lightgbmv1_tpu.models.gbdt import create_boosting
 
     backend = jax.default_backend()
-    # HIGGS shape: 28 features; rows scaled down for bench wall-clock
     N = int(os.environ.get("BENCH_ROWS", 1_000_000))
-    F = 28
-    TREES = int(os.environ.get("BENCH_TREES", 20))
+    TREES = int(os.environ.get("BENCH_TREES", 10))
+    AUC_ITERS = int(os.environ.get("BENCH_AUC_ITERS", 100))
+    N_TEST = 100_000
     if backend == "cpu":   # keep the CPU fallback quick
-        N, TREES = 100_000, 5
+        N, TREES, AUC_ITERS, N_TEST = 50_000, 3, 20, 20_000
 
-    rng = np.random.RandomState(0)
-    X = rng.randn(N, F).astype(np.float32)
-    logit = X[:, 0] * 1.2 - X[:, 1] + 0.6 * X[:, 2] * X[:, 3] + 0.4 * X[:, 4]
-    y = (logit + rng.randn(N).astype(np.float32) > 0).astype(np.float64)
+    X, y = make_data(N, 0)
+    Xt, yt = make_data(N_TEST, 1)
 
     cfg = Config.from_dict({
         "objective": "binary",
@@ -50,24 +68,45 @@ def main():
         "max_bin": 63,            # GPU benchmark config (GPU-Performance.rst)
         "learning_rate": 0.1,
         "min_data_in_leaf": 20,
+        "metric": "auc",
         "verbosity": -1,
         # batched frontier growth keeps the MXU busy (depthwise policy —
         # the same policy as xgboost_hist in the reference's comparison)
         "tree_growth": "levelwise",
     })
     ds = BinnedDataset.from_numpy(X, label=y, config=cfg)
+    dt_test = BinnedDataset.from_numpy(Xt, label=yt, config=cfg, reference=ds)
     gbdt = create_boosting(cfg, ds)
+    gbdt.add_valid(dt_test, "test")
+
+    def sync():
+        jax.device_get(gbdt._train_scores.score)
 
     # warmup: compiles the scanned multi-iteration step
     gbdt.train_iters(TREES)
-    jax.block_until_ready(gbdt._train_scores.score)
+    sync()
 
     t0 = time.time()
     gbdt.train_iters(TREES)
-    jax.block_until_ready(gbdt._train_scores.score)
+    sync()
     dt = time.time() - t0
-
     row_trees_per_s = N * TREES / dt / 1e6
+
+    # quality: continue to AUC_ITERS total trees, eval held-out AUC
+    remaining = max(AUC_ITERS - gbdt.iter, 0)
+    if remaining:
+        gbdt.train_iters(remaining)
+        sync()
+    auc = None
+    for (_, name, value, _) in gbdt.eval_valid():
+        if name == "auc":
+            auc = float(value)
+    # reference LightGBM (C++ CLI built from /root/reference, run on THIS
+    # host, leaf-wise, same synthetic data/config, 100 iters): valid AUC and
+    # throughput measured 2026-07-30, recorded in PERF.md
+    auc_ref = 0.913227          # reference valid_1 auc at iteration 100
+    ref_same_host_mrt = 2.360   # reference M row-trees/s on this host's CPU
+
     baseline = 10.5e6 * 500 / 130.094 / 1e6   # reference CPU HIGGS throughput
     print(json.dumps({
         "metric": f"higgs-shaped binary training throughput ({backend}, "
@@ -75,6 +114,14 @@ def main():
         "value": round(row_trees_per_s, 3),
         "unit": "M row-trees/s",
         "vs_baseline": round(row_trees_per_s / baseline, 4),
+        "auc": round(auc, 5) if auc is not None else None,
+        "auc_ref_lightgbm_cpp": auc_ref,
+        "auc_iters": int(gbdt.iter),
+        "train_seconds_for_timed_block": round(dt, 3),
+        # the reference C++ CLI measured on THIS host's CPU (the 40.36 M
+        # row-trees/s baseline machine is a 28-core dual-Xeon; see PERF.md)
+        "ref_cpp_same_host_M_row_trees_per_s": ref_same_host_mrt,
+        "vs_ref_same_host": round(row_trees_per_s / ref_same_host_mrt, 4),
     }))
 
 

@@ -245,6 +245,7 @@ class Booster:
         train_set: Optional[Dataset] = None,
         model_file: Optional[str] = None,
         model_str: Optional[str] = None,
+        init_model: Optional[Union[str, "Booster"]] = None,
     ):
         self.params = dict(params or {})
         self.best_iteration = -1
@@ -263,7 +264,29 @@ class Booster:
             train_set.params.update(self.params)
             train_set.construct()
             self.config = Config.from_dict(self.params)
-            self._gbdt = create_boosting(self.config, train_set._binned)
+            init_raw = None
+            if init_model is not None:
+                # continued training (reference: CreateBoosting(type, file)
+                # boosting.cpp:46+, init score from the old model's
+                # prediction, application.cpp:90-93)
+                if isinstance(init_model, Booster):
+                    self._loaded = model_from_string(init_model.model_to_string())
+                else:
+                    with open(init_model) as fh:
+                        self._loaded = model_from_string(fh.read())
+                if self._loaded.average_output:
+                    log_fatal("Continued training from an RF (average_output)"
+                              " model is not supported")
+                init_raw = self._loaded_raw_scores(train_set,
+                                                   "continued training")
+                if train_set.init_score is not None:
+                    # reference stacks the loaded model's scores ON TOP of
+                    # the dataset init_score (ScoreUpdater ctor + AddScore)
+                    init_raw = init_raw + np.asarray(
+                        train_set.init_score, np.float64).reshape(
+                            init_raw.shape[0], -1)
+            self._gbdt = create_boosting(self.config, train_set._binned,
+                                         init_raw_scores=init_raw)
         elif model_file is not None:
             with open(model_file) as fh:
                 self._init_from_string(fh.read())
@@ -293,9 +316,28 @@ class Booster:
         if self._gbdt is None:
             log_fatal("Cannot add validation data to a loaded model")
         data.construct()
-        self._gbdt.add_valid(data._binned, name)
+        init_raw = None
+        if self._loaded is not None and self._loaded.trees:
+            # continued training: valid scores resume from the loaded trees
+            init_raw = self._loaded_raw_scores(data, "continued training")
+            if data.init_score is not None:
+                init_raw = init_raw + np.asarray(
+                    data.init_score, np.float64).reshape(init_raw.shape[0], -1)
+        self._gbdt.add_valid(data._binned, name, init_raw=init_raw)
         self._name_valid_sets.append(name)
         return self
+
+    def _loaded_raw_scores(self, dataset: Dataset, why: str) -> np.ndarray:
+        """Raw predictions of the loaded trees on a dataset's raw features."""
+        X = dataset.data
+        if X is None:
+            log_fatal(f"Raw data is required for {why} "
+                      "(dataset was constructed with free_raw_data=True)")
+        K = max(self._loaded.num_tree_per_iteration, 1)
+        raw = np.zeros((X.shape[0], K), dtype=np.float64)
+        for i, t in enumerate(self._loaded.trees):
+            raw[:, i % K] += t.predict(X)
+        return raw
 
     def update(self, train_set: Optional[Dataset] = None,
                fobj: Optional[Callable] = None) -> bool:
@@ -321,14 +363,20 @@ class Booster:
         return self
 
     def current_iteration(self) -> int:
+        n = 0
+        if self._loaded is not None:
+            n += self._loaded.num_iterations
         if self._gbdt is not None:
-            return self._gbdt.iter
-        return self._loaded.num_iterations
+            n += self._gbdt.iter
+        return n
 
     def num_trees(self) -> int:
+        n = 0
+        if self._loaded is not None:
+            n += len(self._loaded.trees)
         if self._gbdt is not None:
-            return self._gbdt.num_trees()
-        return len(self._loaded.trees)
+            n += self._gbdt.num_trees()
+        return n
 
     def num_model_per_iteration(self) -> int:
         if self._gbdt is not None:
@@ -443,6 +491,72 @@ class Booster:
             converted = obj.convert_output(raw if K > 1 else raw[:, 0])
             return np.asarray(converted)
         return raw[:, 0] if K == 1 else raw
+
+    def refit(self, data, label, decay_rate: float = 0.9) -> "Booster":
+        """Refit the existing model's leaf values on new data
+        (reference: basic.py:2873 refit → GBDT::RefitTree gbdt.cpp:266-290 →
+        FitByExistingTree; ``leaf_output = decay_rate * old +
+        (1 - decay_rate) * new``).  Tree structures are kept; only outputs
+        are re-estimated from the new data's gradients."""
+        from copy import deepcopy
+
+        from .objectives import create_objective
+
+        X = _to_2d_numpy(data)
+        y = np.asarray(label, dtype=np.float32).ravel()
+        trees = [deepcopy(t) for t in self._all_trees()]
+        if not trees:
+            log_fatal("Cannot refit an empty model")
+        K = self.num_model_per_iteration()
+        cfg = getattr(self, "config", None) or Config.from_dict(self.params)
+        obj = create_objective(cfg)
+        if obj is None:
+            raise LightGBMError("Cannot refit due to null objective function.")
+
+        from .io.dataset import Metadata
+
+        meta = Metadata()
+        meta.label = y
+        obj.init(meta, len(y))
+        l1, l2 = cfg.lambda_l1, cfg.lambda_l2
+        scores = np.zeros((len(y), K), dtype=np.float64)
+        import jax
+
+        for i, t in enumerate(trees):
+            k = i % K
+            s = scores[:, 0] if K == 1 else scores
+            grad, hess = jax.device_get(obj.get_gradients(
+                np.asarray(s, np.float32)))
+            grad = np.asarray(grad).reshape(len(y), -1)[:, k]
+            hess = np.asarray(hess).reshape(len(y), -1)[:, k]
+            leaf = t.predict_leaf_index(X)
+            for lf in range(t.num_leaves):
+                rows = leaf == lf
+                if not rows.any():
+                    continue
+                sg, sh = grad[rows].sum(), hess[rows].sum()
+                thr = np.sign(sg) * max(abs(sg) - l1, 0.0)
+                new_out = (-thr / (sh + l2)) * t.shrinkage
+                t.leaf_value[lf] = (decay_rate * t.leaf_value[lf]
+                                    + (1.0 - decay_rate) * new_out)
+            scores[:, k] += t.leaf_value[leaf]
+
+        new_booster = Booster.__new__(Booster)
+        new_booster.params = dict(self.params)
+        new_booster.best_iteration = -1
+        new_booster.best_score = {}
+        new_booster._gbdt = None
+        new_booster.train_set = None
+        new_booster._name_valid_sets = []
+        if self._loaded is not None and self._gbdt is None:
+            loaded = deepcopy(self._loaded)
+        else:
+            loaded = model_from_string(self.model_to_string())
+        loaded.trees = trees
+        new_booster._loaded = loaded
+        new_booster.config = cfg
+        new_booster._pred_objective = obj
+        return new_booster
 
     def _predict_contrib(self, X, trees, K):
         """SHAP-style feature contributions via per-tree path attribution

@@ -288,7 +288,7 @@ class Config:
     # -- TPU-specific (new; no reference equivalent) ------------------------
     tree_growth: str = "leafwise"  # leafwise (reference semantics) | levelwise (batched)
     hist_method: str = "auto"      # auto | scatter | onehot | pallas
-    hist_dtype: str = "bf16x2"     # bf16 | bf16x2 | f32 : histogram matmul precision
+    hist_dtype: str = "bf16x2"     # bf16 | bf16x2 | f32 | int8 (quantized) precision
     num_shards: int = 0            # devices for data-parallel (0 = all available)
 
     # -- IO -----------------------------------------------------------------
@@ -368,6 +368,11 @@ class Config:
     @property
     def num_tree_per_iteration(self) -> int:
         if self.objective in ("multiclass", "multiclassova"):
+            return self.num_class
+        # custom objective (objective=none) with num_class>1 still trains one
+        # tree per class — reference gbdt.cpp:71 sets num_tree_per_iteration_
+        # from num_class when the objective function is null
+        if self.objective in ("none", "custom", "") and self.num_class > 1:
             return self.num_class
         return 1
 

@@ -51,11 +51,8 @@ def train(
     if fobj is not None:
         params["objective"] = "none"
 
-    if init_model is not None:
-        log_warning("init_model (continued training) is not yet supported on "
-                    "the TPU backend; starting fresh")
-
-    booster = Booster(params=params, train_set=train_set)
+    booster = Booster(params=params, train_set=train_set,
+                      init_model=init_model)
     is_valid_contain_train = False
     train_data_name = "training"
     if valid_sets is not None:

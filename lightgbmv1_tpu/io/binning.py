@@ -244,7 +244,9 @@ class BinMapper:
     @staticmethod
     def _find_bin_categorical(m, vals, implicit_zero_cnt, max_bin,
                               min_data_in_bin, use_missing, na_cnt):
-        cats = np.round(vals).astype(np.int64)
+        # reference uses the C truncation cast for categorical values
+        # (bin.cpp CategoricalBin / static_cast<int>), not rounding
+        cats = np.trunc(vals).astype(np.int64)
         neg = cats < 0
         if neg.any():
             # reference warns and treats negatives as missing-ish; fold into "other"
@@ -274,7 +276,7 @@ class BinMapper:
         if self.bin_type == BIN_CATEGORICAL:
             out = np.full(v.shape, self.num_bin - 1, dtype=np.int32)  # other bin
             nan_mask = np.isnan(v)
-            cats = np.round(np.where(nan_mask, -1, v)).astype(np.int64)
+            cats = np.trunc(np.where(nan_mask, -1, v)).astype(np.int64)
             for c, b in self.categorical_2_bin.items():
                 out[cats == c] = b
             return out
@@ -296,7 +298,10 @@ class BinMapper:
         b = min(int(bin_idx), n_real - 1)
         ub = self.bin_upper_bound[b]
         if math.isinf(ub):
-            ub = self.max_value + 1.0
+            # reference stores AvoidInf = ±1e300 (bin.cpp GetDoubleUpperBound)
+            # so out-of-train-range raw values still go left at a NaN-vs-rest
+            # split; max_value+1 would create train/serve skew beyond it
+            ub = 1e300
         return float(ub)
 
     def feature_info_str(self) -> str:

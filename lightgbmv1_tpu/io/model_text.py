@@ -53,20 +53,52 @@ def _fmt_list(values, fmt=str) -> str:
     return " ".join(fmt(v) for v in values)
 
 
+def _cats_to_bitset(cats: np.ndarray) -> np.ndarray:
+    """Raw category values -> uint32 bitset words (reference
+    Common::ConstructBitset); word count = max//32 + 1."""
+    cats = np.asarray(cats, dtype=np.int64)
+    if len(cats) == 0:
+        return np.zeros(1, np.uint32)
+    words = np.zeros(int(cats.max()) // 32 + 1, np.uint32)
+    np.bitwise_or.at(words, cats // 32, np.uint32(1) << (cats % 32).astype(np.uint32))
+    return words
+
+
+def _bitset_to_cats(words: np.ndarray) -> np.ndarray:
+    bits = np.unpackbits(np.asarray(words, np.uint32).view(np.uint8),
+                         bitorder="little")
+    return np.flatnonzero(bits).astype(np.int64)
+
+
 def tree_to_string(tree: HostTree, index: int) -> str:
     """Per-tree block (reference: Tree::ToString, src/io/tree.cpp:223)."""
     n = tree.num_leaves
+    n_nodes = max(n - 1, 0)
+    is_cat = getattr(tree, "is_cat", np.zeros(n_nodes, bool))
+    cat_sets = getattr(tree, "cat_sets", [None] * n_nodes)
+    cat_nodes = [i for i in range(n_nodes) if is_cat[i]]
     lines = [f"Tree={index}"]
     lines.append(f"num_leaves={n}")
-    lines.append("num_cat=0")
+    lines.append(f"num_cat={len(cat_nodes)}")
     if n > 1:
         dts = [
-            _encode_decision_type(False, bool(dl), int(mt))
-            for dl, mt in zip(tree.default_left, tree.missing_type)
+            _encode_decision_type(bool(is_cat[i]), bool(dl), int(mt))
+            for i, (dl, mt) in enumerate(zip(tree.default_left, tree.missing_type))
         ]
+        # categorical nodes store their cat index in the threshold slot
+        # (reference Tree::SplitCategorical, tree.cpp:78-80)
+        thresholds = np.array(tree.threshold, dtype=np.float64)
+        boundaries = [0]
+        words_all: List[int] = []
+        for ci, node in enumerate(cat_nodes):
+            thresholds[node] = float(ci)
+            s = cat_sets[node]
+            w = _cats_to_bitset(s if s is not None else tree.cat_bins_of(node))
+            boundaries.append(boundaries[-1] + len(w))
+            words_all.extend(int(x) for x in w)
         lines.append("split_feature=" + _fmt_list(tree.split_feature))
         lines.append("split_gain=" + _fmt_list(tree.split_gain, lambda x: f"{x:.8g}"))
-        lines.append("threshold=" + _fmt_list(tree.threshold, _fmt_float))
+        lines.append("threshold=" + _fmt_list(thresholds, _fmt_float))
         lines.append("decision_type=" + _fmt_list(dts))
         lines.append("left_child=" + _fmt_list(tree.left_child))
         lines.append("right_child=" + _fmt_list(tree.right_child))
@@ -76,6 +108,9 @@ def tree_to_string(tree: HostTree, index: int) -> str:
         lines.append("internal_value=" + _fmt_list(tree.internal_value, lambda x: f"{x:.8g}"))
         lines.append("internal_weight=" + _fmt_list(tree.internal_weight, lambda x: f"{x:.8g}"))
         lines.append("internal_count=" + _fmt_list(tree.internal_count))
+        if cat_nodes:
+            lines.append("cat_boundaries=" + _fmt_list(boundaries))
+            lines.append("cat_threshold=" + _fmt_list(words_all))
     else:
         lines.append("leaf_value=" + _fmt_float(
             tree.leaf_value[0] if len(tree.leaf_value) else 0.0))
@@ -120,6 +155,16 @@ def _parse_tree_block(block: str) -> HostTree:
         mts.append(m)
     t.default_left = np.array(dls, dtype=bool) if n_nodes else np.zeros(0, bool)
     t.missing_type = np.array(mts, dtype=np.int32) if n_nodes else np.zeros(0, np.int32)
+    t.is_cat = np.array(cats, dtype=bool) if n_nodes else np.zeros(0, bool)
+    t.cat_bitset = np.zeros((n_nodes, 1), np.uint32)   # bin-space unknown here
+    t.cat_sets = [None] * n_nodes
+    if t.is_cat.any():
+        bounds = arr("cat_boundaries", np.int64, 0)
+        words = arr("cat_threshold", np.uint32, 0)
+        for node in np.flatnonzero(t.is_cat):
+            ci = int(t.threshold[node])
+            w = words[int(bounds[ci]): int(bounds[ci + 1])]
+            t.cat_sets[node] = _bitset_to_cats(w)
     t.left_child = arr("left_child", np.int32, n_nodes)
     t.right_child = arr("right_child", np.int32, n_nodes)
     t.leaf_value = arr("leaf_value", np.float64, n)
@@ -201,7 +246,7 @@ def model_to_string(
         for f in t.split_feature:
             counts[f] += 1
     order = np.argsort(-counts, kind="stable")
-    out.append("feature importances:")
+    out.append("feature_importances:")
     for i in order:
         if counts[i] > 0:
             out.append(f"{feature_names[i]}={counts[i]}")

@@ -71,7 +71,13 @@ class GBDT:
         train_set: BinnedDataset,
         objective: Optional[ObjectiveFunction] = None,
         metrics: Optional[List[Metric]] = None,
+        init_raw_scores: Optional[np.ndarray] = None,
     ):
+        # init_raw_scores: (num_data, num_class) raw predictions of a loaded
+        # model — continued training resumes boosting from them (reference:
+        # continued training via input_model, application.cpp:90-93 predicts
+        # the old model to seed the score cache)
+        self._init_raw_scores = init_raw_scores
         self.config = config
         self.train_set = train_set
         self.num_data = train_set.num_data
@@ -85,7 +91,7 @@ class GBDT:
 
         # device-resident training data
         self.binned = jnp.asarray(train_set.binned)
-        self.meta = make_feature_meta(train_set)
+        self.meta = make_feature_meta(train_set, config.monotone_constraints)
         self.num_bins = train_set.padded_bin
         self.split_params = SplitParams(
             lambda_l1=config.lambda_l1,
@@ -94,6 +100,11 @@ class GBDT:
             min_sum_hessian_in_leaf=config.min_sum_hessian_in_leaf,
             min_gain_to_split=config.min_gain_to_split,
             max_delta_step=config.max_delta_step,
+            cat_l2=config.cat_l2,
+            cat_smooth=config.cat_smooth,
+            max_cat_threshold=int(config.max_cat_threshold),
+            max_cat_to_onehot=int(config.max_cat_to_onehot),
+            min_data_per_group=float(config.min_data_per_group),
         )
 
         self._build_trainer()
@@ -101,7 +112,12 @@ class GBDT:
         # initial scores (reference: BoostFromAverage gbdt.cpp:312-335)
         self._init_scores = np.zeros(self.num_class, dtype=np.float64)
         meta_init = train_set.metadata.init_score
-        if meta_init is not None:
+        if init_raw_scores is not None:
+            base = np.asarray(init_raw_scores, dtype=np.float64).reshape(
+                self.num_data, self.num_class)
+            self._train_scores = _ScoreUpdater(self.num_data, self.num_class, base)
+            self._used_init_score = True
+        elif meta_init is not None:
             init = np.asarray(meta_init, dtype=np.float64).reshape(self.num_data, -1)
             base = np.zeros((self.num_data, self.num_class))
             base[:, : init.shape[1]] = init
@@ -314,17 +330,21 @@ class GBDT:
             self._model_bias.append(self._tree_bias(k))
 
     # ------------------------------------------------------------------
-    def add_valid(self, valid_set: BinnedDataset, name: str) -> None:
+    def add_valid(self, valid_set: BinnedDataset, name: str,
+                  init_raw: Optional[np.ndarray] = None) -> None:
         metrics = create_metrics(self.config)
         for m in metrics:
             m.init(valid_set.metadata, valid_set.num_data)
-        init = (
-            np.asarray(valid_set.metadata.init_score, dtype=np.float64).reshape(
-                valid_set.num_data, -1
-            )
-            if valid_set.metadata.init_score is not None
-            else self._init_scores[None, :]
-        )
+        if init_raw is not None:
+            # continued training: valid scores also resume from the loaded
+            # model's predictions
+            init = np.asarray(init_raw, dtype=np.float64).reshape(
+                valid_set.num_data, self.num_class)
+        elif valid_set.metadata.init_score is not None:
+            init = np.asarray(valid_set.metadata.init_score,
+                              dtype=np.float64).reshape(valid_set.num_data, -1)
+        else:
+            init = self._init_scores[None, :]
         if self.iter > 0:
             log_fatal("Cannot add validation data after training started")
         self._valid_sets.append(valid_set)
@@ -518,9 +538,16 @@ class GBDT:
     def _fill_real_thresholds(self, tree: HostTree) -> None:
         mappers = self.train_set.bin_mappers
         for i in range(tree.num_leaves - 1):
-            tree.threshold[i] = mappers[tree.split_feature[i]].bin_to_threshold(
-                tree.threshold_bin[i]
-            )
+            m = mappers[tree.split_feature[i]]
+            if tree.is_cat[i]:
+                # bin-space bitset -> raw category values (the translation
+                # the reference does in Tree::SplitCategorical, tree.cpp:70-86)
+                cats = [m.bin_2_categorical[b] for b in tree.cat_bins_of(i)
+                        if b < len(m.bin_2_categorical)]
+                tree.cat_sets[i] = np.asarray(sorted(cats), dtype=np.int64)
+                tree.threshold[i] = 0.0   # rewritten to the cat index on save
+            else:
+                tree.threshold[i] = m.bin_to_threshold(tree.threshold_bin[i])
 
     def _renew_leaf_values(self, tree: HostTree, leaf_id: jax.Array, k: int, q: float):
         """reference: RenewTreeOutput (objective-specific, e.g. L1 median —
@@ -794,12 +821,15 @@ class DART(GBDT):
 
 
 class RF(GBDT):
-    def __init__(self, config, train_set, objective=None, metrics=None):
+    def __init__(self, config, train_set, objective=None, metrics=None,
+                 init_raw_scores=None):
         if config.bagging_freq <= 0 or config.bagging_fraction >= 1.0:
             log_fatal("RF mode requires bagging "
                       "(bagging_freq > 0 and bagging_fraction < 1)")
         if train_set.metadata.init_score is not None:
             log_fatal("RF mode does not support init_score (reference rf.hpp:44)")
+        if init_raw_scores is not None:
+            log_fatal("RF mode does not support continued training")
         super().__init__(config, train_set, objective, metrics)
 
     def _tree_bias(self, k: int) -> float:

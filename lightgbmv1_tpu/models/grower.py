@@ -34,10 +34,12 @@ from typing import Callable, NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 from ..io.binning import MISSING_NAN
 from ..ops.split import (
+    NO_CONSTRAINT,
     FeatureMeta,
     SplitParams,
     find_best_split,
@@ -57,6 +59,10 @@ class GrowerState(NamedTuple):
     best_dl: jax.Array        # (L,) bool
     best_left: jax.Array      # (L, 3)
     best_right: jax.Array     # (L, 3)
+    best_iscat: jax.Array     # (L,) bool
+    best_bitset: jax.Array    # (L, W) uint32
+    leaf_constr: jax.Array    # (L, 2) — per-leaf [min, max] output bound
+                              # (reference BasicLeafConstraints entries_)
     tree: TreeArrays
     leaf_is_left: jax.Array   # (L,) bool
     num_leaves: jax.Array     # () int32
@@ -85,6 +91,7 @@ def make_leafwise_grower(
     params: SplitParams,
     max_depth: int = -1,
     feature_fraction_bynode: float = 1.0,
+    monotone_penalty: float = 0.0,
     hist_fn: Callable = None,
     split_fn: Callable = None,
     sums_fn: Callable = None,
@@ -93,30 +100,44 @@ def make_leafwise_grower(
 
     ``hist_fn(binned, g3, leaf_id, target_leaf) -> (F, B, 3)`` — histogram of
     one leaf's rows (globally summed in distributed mode).
-    ``split_fn(hist, parent_sum, feature_mask, key, uid) -> SplitResult`` —
-    defaults to the local vectorized search; the feature-parallel learner
-    substitutes a sharded search + cross-shard argmax.
+    ``split_fn(hist, parent_sum, feature_mask, key, uid, constraint, depth)
+    -> SplitResult`` — defaults to the local vectorized search; the
+    feature-parallel learner substitutes a sharded search + cross-shard
+    argmax.  ``constraint`` is the leaf's monotone [min, max] output bound.
     ``sums_fn(g3) -> (3,)`` — root grad/hess/count totals (psum over the row
     mesh axis in data-parallel mode; the analog of the reference's root
     sum Allreduce, data_parallel_tree_learner.cpp:126-151).
     """
     L = num_leaves
     L1 = max(L - 1, 1)
+    use_mc = bool(np.asarray(meta.monotone_type).any())
 
     if split_fn is None:
-        def split_fn(hist, parent, mask, key, uid):
-            return find_best_split(hist, parent, meta, mask, params)
+        def split_fn(hist, parent, mask, key, uid, constraint, depth):
+            return find_best_split(hist, parent, meta, mask, params,
+                                   constraint, depth, monotone_penalty)
 
     if sums_fn is None:
         def sums_fn(g3):
             return g3.sum(axis=0)
 
-    def apply_decision(binned, leaf_id, leaf, new_leaf, feat, thr, dl):
+    def clamp_out(sums, constr):
+        out = leaf_output(sums[0], sums[1], params)
+        if not use_mc:
+            return out
+        return jnp.clip(out, constr[0], constr[1])
+
+    def apply_decision(binned, leaf_id, leaf, new_leaf, feat, thr, dl,
+                       is_cat, bitset):
         bins_f = binned[feat]                       # (N,) dynamic row gather
         is_na = (meta.missing_type[feat] == MISSING_NAN) & (
             bins_f == meta.nan_bin[feat]
         )
         go_left = jnp.where(is_na, dl, bins_f <= thr)
+        bi = bins_f.astype(jnp.int32)
+        word = bitset[bi >> 5]
+        in_set = ((word >> (bi.astype(jnp.uint32) & 31)) & 1) == 1
+        go_left = jnp.where(is_cat, in_set, go_left)
         return jnp.where((leaf_id == leaf) & (~go_left), new_leaf, leaf_id)
 
     def grow(binned, g3, base_mask, key):
@@ -128,10 +149,12 @@ def make_leafwise_grower(
         hist0 = hist_fn(binned, g3, leaf_id, jnp.asarray(0, jnp.int32))
         root_sum = sums_fn(g3)
         mask0 = _node_feature_mask(key, 0, base_mask, feature_fraction_bynode)
-        res0 = split_fn(hist0, root_sum, mask0, key, 0)
+        no_constr = jnp.asarray(NO_CONSTRAINT, jnp.float32)
+        res0 = split_fn(hist0, root_sum, mask0, key, 0, no_constr, 0)
 
         from ..models.tree import empty_tree
 
+        W = res0.cat_bitset.shape[0]
         st = GrowerState(
             leaf_id=leaf_id,
             hist_pool=jnp.zeros((L, F, B, 3), jnp.float32).at[0].set(hist0),
@@ -143,7 +166,10 @@ def make_leafwise_grower(
             best_dl=jnp.zeros(L, bool).at[0].set(res0.default_left),
             best_left=jnp.zeros((L, 3), jnp.float32).at[0].set(res0.left_sum),
             best_right=jnp.zeros((L, 3), jnp.float32).at[0].set(res0.right_sum),
-            tree=empty_tree(L),
+            best_iscat=jnp.zeros(L, bool).at[0].set(res0.is_cat),
+            best_bitset=jnp.zeros((L, W), jnp.uint32).at[0].set(res0.cat_bitset),
+            leaf_constr=jnp.tile(jnp.asarray(NO_CONSTRAINT, jnp.float32), (L, 1)),
+            tree=empty_tree(L, W),
             leaf_is_left=jnp.zeros(L, bool),
             num_leaves=jnp.asarray(1, jnp.int32),
             done=jnp.asarray(L <= 1),
@@ -162,9 +188,34 @@ def make_leafwise_grower(
                 dl = st.best_dl[leaf]
                 lsum = st.best_left[leaf]
                 rsum = st.best_right[leaf]
+                iscat = st.best_iscat[leaf]
+                bitset = st.best_bitset[leaf]
                 parent_sum = st.leaf_sums[leaf]
 
-                leaf_id = apply_decision(binned, st.leaf_id, leaf, nl, feat, thr, dl)
+                leaf_id = apply_decision(binned, st.leaf_id, leaf, nl, feat,
+                                         thr, dl, iscat, bitset)
+
+                # monotone constraint propagation (reference:
+                # BasicLeafConstraints::Update, monotone_constraints.hpp:99-117)
+                pconstr = st.leaf_constr[leaf]
+                out_l = clamp_out(lsum, pconstr)
+                out_r = clamp_out(rsum, pconstr)
+                if use_mc:
+                    mono = meta.monotone_type[feat]
+                    mid = 0.5 * (out_l + out_r)
+                    upd = (~iscat) & (mono != 0)
+                    new_max_l = jnp.where(upd & (mono > 0),
+                                          jnp.minimum(pconstr[1], mid), pconstr[1])
+                    new_min_l = jnp.where(upd & (mono < 0),
+                                          jnp.maximum(pconstr[0], mid), pconstr[0])
+                    new_max_r = jnp.where(upd & (mono < 0),
+                                          jnp.minimum(pconstr[1], mid), pconstr[1])
+                    new_min_r = jnp.where(upd & (mono > 0),
+                                          jnp.maximum(pconstr[0], mid), pconstr[0])
+                    constr_l = jnp.stack([new_min_l, new_max_l])
+                    constr_r = jnp.stack([new_min_r, new_max_r])
+                else:
+                    constr_l = constr_r = pconstr
 
                 # histogram-subtraction trick: one pass over the smaller child
                 smaller_is_left = lsum[2] <= rsum[2]
@@ -184,8 +235,10 @@ def make_leafwise_grower(
                 mask_r = _node_feature_mask(
                     key, 2 * s + 2, base_mask, feature_fraction_bynode
                 )
-                res_l = split_fn(h_left, lsum, mask_l, key, 2 * s + 1)
-                res_r = split_fn(h_right, rsum, mask_r, key, 2 * s + 2)
+                res_l = split_fn(h_left, lsum, mask_l, key, 2 * s + 1,
+                                 constr_l, d)
+                res_r = split_fn(h_right, rsum, mask_r, key, 2 * s + 2,
+                                 constr_r, d)
                 gain_l = jnp.where(depth_ok, res_l.gain, -jnp.inf)
                 gain_r = jnp.where(depth_ok, res_r.gain, -jnp.inf)
 
@@ -208,19 +261,18 @@ def make_leafwise_grower(
                     split_feature=t.split_feature.at[node].set(feat),
                     threshold_bin=t.threshold_bin.at[node].set(thr),
                     default_left=t.default_left.at[node].set(dl),
+                    is_cat=t.is_cat.at[node].set(iscat),
+                    cat_bitset=t.cat_bitset.at[node].set(bitset),
                     missing_type=t.missing_type.at[node].set(meta.missing_type[feat]),
                     left_child=lc,
                     right_child=rc,
                     split_gain=t.split_gain.at[node].set(gain),
                     internal_value=t.internal_value.at[node].set(
-                        leaf_output(parent_sum[0], parent_sum[1], params)
+                        clamp_out(parent_sum, pconstr)
                     ),
                     internal_weight=t.internal_weight.at[node].set(parent_sum[1]),
                     internal_count=t.internal_count.at[node].set(parent_sum[2]),
-                    leaf_value=t.leaf_value.at[leaf]
-                    .set(leaf_output(lsum[0], lsum[1], params))
-                    .at[nl]
-                    .set(leaf_output(rsum[0], rsum[1], params)),
+                    leaf_value=t.leaf_value.at[leaf].set(out_l).at[nl].set(out_r),
                     leaf_weight=t.leaf_weight.at[leaf].set(lsum[1]).at[nl].set(rsum[1]),
                     leaf_count=t.leaf_count.at[leaf].set(lsum[2]).at[nl].set(rsum[2]),
                     leaf_parent=t.leaf_parent.at[leaf].set(node).at[nl].set(node),
@@ -240,6 +292,9 @@ def make_leafwise_grower(
                     best_dl=st.best_dl.at[leaf].set(res_l.default_left).at[nl].set(res_r.default_left),
                     best_left=st.best_left.at[leaf].set(res_l.left_sum).at[nl].set(res_r.left_sum),
                     best_right=st.best_right.at[leaf].set(res_l.right_sum).at[nl].set(res_r.right_sum),
+                    best_iscat=st.best_iscat.at[leaf].set(res_l.is_cat).at[nl].set(res_r.is_cat),
+                    best_bitset=st.best_bitset.at[leaf].set(res_l.cat_bitset).at[nl].set(res_r.cat_bitset),
+                    leaf_constr=st.leaf_constr.at[leaf].set(constr_l).at[nl].set(constr_r),
                     tree=tree,
                     leaf_is_left=st.leaf_is_left.at[leaf].set(True).at[nl].set(False),
                     num_leaves=nl + 1,
@@ -270,6 +325,7 @@ def make_levelwise_grower(
     params: SplitParams,
     max_depth: int = -1,
     feature_fraction_bynode: float = 1.0,
+    monotone_penalty: float = 0.0,
     hist_frontier_fn: Callable = None,
     split_fn: Callable = None,
     sums_fn: Callable = None,
@@ -297,14 +353,22 @@ def make_levelwise_grower(
     levels = _math.ceil(_math.log2(max(L, 2)))
     if max_depth > 0:
         levels = min(levels, max_depth)
+    use_mc = bool(np.asarray(meta.monotone_type).any())
 
     if split_fn is None:
-        def split_fn(hist, parent, mask, key, uid):
-            return find_best_split(hist, parent, meta, mask, params)
+        def split_fn(hist, parent, mask, key, uid, constraint, depth):
+            return find_best_split(hist, parent, meta, mask, params,
+                                   constraint, depth, monotone_penalty)
 
     if sums_fn is None:
         def sums_fn(g3):
             return g3.sum(axis=0)
+
+    def clamp_out_batch(sums, constr):
+        out = jax.vmap(lambda s: leaf_output(s[0], s[1], params))(sums)
+        if not use_mc:
+            return out
+        return jnp.clip(out, constr[:, 0], constr[:, 1])
 
     def grow(binned, g3, base_mask, key):
         N = binned.shape[1]
@@ -313,8 +377,10 @@ def make_levelwise_grower(
 
         leaf_id = jnp.zeros(N, jnp.int32)
         root_sum = sums_fn(g3)
-        tree = empty_tree(L)
+        W = -(-num_bins // 32)
+        tree = empty_tree(L, W)
         leaf_sums = jnp.zeros((L, 3), jnp.float32).at[0].set(root_sum)
+        leaf_constr = jnp.tile(jnp.asarray(NO_CONSTRAINT, jnp.float32), (L, 1))
         leaf_active = jnp.zeros(L, bool).at[0].set(True)
         leaf_is_left = jnp.zeros(L, bool)
         num_leaves_cur = jnp.asarray(1, jnp.int32)
@@ -332,8 +398,8 @@ def make_levelwise_grower(
             else:
                 masks = jnp.broadcast_to(base_mask, (Ld, F))
             res = jax.vmap(
-                lambda h, p, m: split_fn(h, p, m, key, d)
-            )(hist, leaf_sums[:Ld], masks)
+                lambda h, p, m, c: split_fn(h, p, m, key, d, c, d)
+            )(hist, leaf_sums[:Ld], masks, leaf_constr[:Ld])
 
             gains = jnp.where(leaf_active[:Ld], res.gain, -jnp.inf)
             want = gains > 0
@@ -360,17 +426,38 @@ def make_levelwise_grower(
                 b_row == meta.nan_bin[f_row]
             )
             go_left = jnp.where(is_na, dl_l[lid_c], b_row <= thr_l[lid_c])
+            # categorical rows: bin-space bitset membership
+            bi = b_row.astype(jnp.int32)
+            word = res.cat_bitset.reshape(-1)[lid_c * W + (bi >> 5)]
+            in_set = ((word >> (bi.astype(jnp.uint32) & 31)) & 1) == 1
+            go_left = jnp.where(res.is_cat[lid_c], in_set, go_left)
             leaf_id = jnp.where(in_split & (~go_left), new_leaf[lid_c], leaf_id)
 
             # tree array updates (scatter with out-of-bounds drop for masked)
             nd = jnp.where(split_mask, node_idx, L1 + 1)
             nl = jnp.where(split_mask, new_leaf, L + 1)
             ld_idx = jnp.where(split_mask, jnp.arange(Ld), L + 1)
-            parent_out = jax.vmap(
-                lambda s: leaf_output(s[0], s[1], params)
-            )(leaf_sums[:Ld])
-            left_out = jax.vmap(lambda s: leaf_output(s[0], s[1], params))(res.left_sum)
-            right_out = jax.vmap(lambda s: leaf_output(s[0], s[1], params))(res.right_sum)
+            pconstr = leaf_constr[:Ld]
+            parent_out = clamp_out_batch(leaf_sums[:Ld], pconstr)
+            left_out = clamp_out_batch(res.left_sum, pconstr)
+            right_out = clamp_out_batch(res.right_sum, pconstr)
+            if use_mc:
+                # BasicLeafConstraints::Update, vectorized over the level
+                mono = meta.monotone_type[res.feature]
+                mid = 0.5 * (left_out + right_out)
+                upd = (~res.is_cat) & (mono != 0)
+                max_l = jnp.where(upd & (mono > 0),
+                                  jnp.minimum(pconstr[:, 1], mid), pconstr[:, 1])
+                min_l = jnp.where(upd & (mono < 0),
+                                  jnp.maximum(pconstr[:, 0], mid), pconstr[:, 0])
+                max_r = jnp.where(upd & (mono < 0),
+                                  jnp.minimum(pconstr[:, 1], mid), pconstr[:, 1])
+                min_r = jnp.where(upd & (mono > 0),
+                                  jnp.maximum(pconstr[:, 0], mid), pconstr[:, 0])
+                constr_l = jnp.stack([min_l, max_l], axis=1)
+                constr_r = jnp.stack([min_r, max_r], axis=1)
+            else:
+                constr_l = constr_r = pconstr
 
             t = tree
             # re-wire parents of the split leaves
@@ -388,6 +475,8 @@ def make_levelwise_grower(
                 split_feature=t.split_feature.at[nd].set(res.feature, mode="drop"),
                 threshold_bin=t.threshold_bin.at[nd].set(res.threshold_bin, mode="drop"),
                 default_left=t.default_left.at[nd].set(res.default_left, mode="drop"),
+                is_cat=t.is_cat.at[nd].set(res.is_cat, mode="drop"),
+                cat_bitset=t.cat_bitset.at[nd].set(res.cat_bitset, mode="drop"),
                 missing_type=t.missing_type.at[nd].set(
                     meta.missing_type[res.feature], mode="drop"),
                 left_child=lc,
@@ -409,6 +498,8 @@ def make_levelwise_grower(
             )
             leaf_sums = leaf_sums.at[ld_idx].set(res.left_sum, mode="drop") \
                 .at[nl].set(res.right_sum, mode="drop")
+            leaf_constr = leaf_constr.at[ld_idx].set(constr_l, mode="drop") \
+                .at[nl].set(constr_r, mode="drop")
             leaf_is_left = leaf_is_left.at[ld_idx].set(True, mode="drop") \
                 .at[nl].set(False, mode="drop")
             leaf_active = (leaf_active & jnp.pad(split_mask, (0, L - Ld))

@@ -43,9 +43,12 @@ class TreeArrays(NamedTuple):
     leaf_weight: jax.Array      # (L,) float32
     leaf_count: jax.Array       # (L,) float32
     leaf_parent: jax.Array      # (L,) int32
+    is_cat: jax.Array           # (L-1,) bool — categorical (bitset) split
+    cat_bitset: jax.Array       # (L-1, W) uint32 — bin-space membership
+                                # (reference: cat_threshold_inner_, tree.h:427)
 
 
-def empty_tree(max_leaves: int) -> TreeArrays:
+def empty_tree(max_leaves: int, cat_words: int = 1) -> TreeArrays:
     L = max_leaves
     L1 = max(L - 1, 1)
     return TreeArrays(
@@ -65,6 +68,8 @@ def empty_tree(max_leaves: int) -> TreeArrays:
         leaf_weight=jnp.zeros(L, jnp.float32),
         leaf_count=jnp.zeros(L, jnp.float32),
         leaf_parent=jnp.full(L, -1, jnp.int32),
+        is_cat=jnp.zeros(L1, bool),
+        cat_bitset=jnp.zeros((L1, cat_words), jnp.uint32),
     )
 
 
@@ -95,6 +100,13 @@ def tree_leaf_index_binned(
         dl = tree.default_left[nd]
         is_na = (missing_types[f] == MISSING_NAN) & (b == nan_bins[f])
         go_left = jnp.where(is_na, dl, b <= t)
+        # categorical: bitset membership (reference CategoricalDecisionInner,
+        # tree.h:322-335); the other/unseen bin is never in the set => right
+        W = tree.cat_bitset.shape[-1]
+        bi = b.astype(jnp.int32)
+        word = tree.cat_bitset.reshape(-1)[nd * W + (bi >> 5)]
+        in_set = ((word >> (bi.astype(jnp.uint32) & 31)) & 1) == 1
+        go_left = jnp.where(tree.is_cat[nd], in_set, go_left)
         nxt = jnp.where(go_left, tree.left_child[nd], tree.right_child[nd])
         node = jnp.where(active, nxt, node)
         return node, active
@@ -118,7 +130,12 @@ def tree_predict_binned(tree, binned, nan_bins, missing_types):
 
 def tree_predict_raw(tree: TreeArrays, X: jax.Array) -> jax.Array:
     """X: (N, F) float; NaN = missing. Mirrors Tree::NumericalDecision
-    (reference include/LightGBM/tree.h:~430) including missing-type handling."""
+    (reference include/LightGBM/tree.h:~430) including missing-type handling.
+
+    Categorical (bitset) nodes are not supported on this device path — the
+    deployment predictor for categorical models is the host ``HostTree``
+    walk (Booster.predict) or the binned path; raw categorical decisions
+    need the raw->bin category dictionary, which lives host-side."""
     N = X.shape[0]
 
     def cond(state):
@@ -199,7 +216,19 @@ class HostTree:
         self.leaf_weight = as_np(arrays.leaf_weight)[: self.num_leaves].astype(np.float64)
         self.leaf_count = as_np(arrays.leaf_count)[: self.num_leaves].astype(np.int64)
         self.leaf_parent = as_np(arrays.leaf_parent)[: self.num_leaves].astype(np.int32)
+        self.is_cat = as_np(arrays.is_cat)[:n_nodes].astype(bool)
+        self.cat_bitset = as_np(arrays.cat_bitset)[:n_nodes].astype(np.uint32)
+        # raw-category sets per node (None for numerical nodes); filled from
+        # the bin mappers by GBDT._fill_real_thresholds — the bin->category
+        # translation the reference does in Tree::SplitCategorical
+        self.cat_sets = [None] * n_nodes
         self.shrinkage = shrinkage
+
+    def cat_bins_of(self, node: int) -> np.ndarray:
+        """Bins in node's left set, decoded from the bin-space bitset."""
+        words = self.cat_bitset[node]
+        bits = np.unpackbits(words.view(np.uint8), bitorder="little")
+        return np.flatnonzero(bits)
 
     def apply_shrinkage(self, rate: float) -> None:
         """reference: Tree::Shrinkage, tree.h:187-196."""
@@ -221,59 +250,48 @@ class HostTree:
         self.leaf_value = np.asarray(values, dtype=np.float64)[: self.num_leaves]
 
     # -- numpy prediction (exact, host) ------------------------------------
-    def predict(self, X: np.ndarray) -> np.ndarray:
-        N = X.shape[0]
-        out = np.empty(N, dtype=np.float64)
-        if self.num_leaves <= 1:
-            out[:] = self.leaf_value[0] if self.num_leaves == 1 else 0.0
-            return out
-        node = np.zeros(N, dtype=np.int64)
-        active = np.ones(N, dtype=bool)
-        while active.any():
-            nd = node[active]
-            f = self.split_feature[nd]
-            v = X[active, f].astype(np.float64)
-            t = self.threshold[nd]
-            dl = self.default_left[nd]
-            mt = self.missing_type[nd]
-            isnan = np.isnan(v)
-            v0 = np.where(isnan, 0.0, v)
-            miss = np.where(
-                mt == MISSING_NAN, isnan,
-                np.where(mt == MISSING_ZERO,
-                         isnan | (np.abs(v0) <= K_ZERO_THRESHOLD), False),
-            )
-            go_left = np.where(miss, dl, v0 <= t)
-            nxt = np.where(go_left, self.left_child[nd], self.right_child[nd])
-            node[active] = nxt
-            idx = np.flatnonzero(active)
-            done = nxt < 0
-            out[idx[done]] = self.leaf_value[-nxt[done] - 1]
-            active[idx[done]] = False
-        return out
+    def _go_left(self, nd: np.ndarray, v: np.ndarray) -> np.ndarray:
+        """Vectorized Tree::Decision (reference tree.h:331-339): numerical
+        threshold compare or categorical raw-value bitset membership."""
+        t = self.threshold[nd]
+        dl = self.default_left[nd]
+        mt = self.missing_type[nd]
+        isnan = np.isnan(v)
+        v0 = np.where(isnan, 0.0, v)
+        miss = np.where(
+            mt == MISSING_NAN, isnan,
+            np.where(mt == MISSING_ZERO,
+                     isnan | (np.abs(v0) <= K_ZERO_THRESHOLD), False),
+        )
+        go_left = np.where(miss, dl, v0 <= t)
+        cat_rows = self.is_cat[nd]
+        if cat_rows.any():
+            # reference CategoricalDecision (tree.h:302-320): C truncation
+            # cast (static_cast<int>), NOT rounding; negatives and NaN go
+            # right (our binning routes both to the other/unseen bin, which
+            # is never in the left set)
+            vi = np.where(isnan, -1, np.trunc(v0)).astype(np.int64)
+            for node in np.unique(nd[cat_rows]):
+                m = cat_rows & (nd == node)
+                s = self.cat_sets[node]
+                if s is None:
+                    s = self.cat_bins_of(node)
+                go_left[m] = (vi[m] >= 0) & np.isin(vi[m], np.asarray(s))
+        return go_left
 
-    def predict_leaf_index(self, X: np.ndarray) -> np.ndarray:
+    def _walk(self, X: np.ndarray):
+        """Root-to-leaf walk; returns the leaf index per row."""
         N = X.shape[0]
+        leaf = np.zeros(N, dtype=np.int32)
         if self.num_leaves <= 1:
-            return np.zeros(N, dtype=np.int32)
+            return leaf
         node = np.zeros(N, dtype=np.int64)
         active = np.ones(N, dtype=bool)
-        leaf = np.zeros(N, dtype=np.int32)
         while active.any():
             nd = node[active]
             f = self.split_feature[nd]
             v = X[active, f].astype(np.float64)
-            t = self.threshold[nd]
-            dl = self.default_left[nd]
-            mt = self.missing_type[nd]
-            isnan = np.isnan(v)
-            v0 = np.where(isnan, 0.0, v)
-            miss = np.where(
-                mt == MISSING_NAN, isnan,
-                np.where(mt == MISSING_ZERO,
-                         isnan | (np.abs(v0) <= K_ZERO_THRESHOLD), False),
-            )
-            go_left = np.where(miss, dl, v0 <= t)
+            go_left = self._go_left(nd, v)
             nxt = np.where(go_left, self.left_child[nd], self.right_child[nd])
             node[active] = nxt
             idx = np.flatnonzero(active)
@@ -282,15 +300,27 @@ class HostTree:
             active[idx[done]] = False
         return leaf
 
+    def predict(self, X: np.ndarray) -> np.ndarray:
+        if self.num_leaves < 1:
+            return np.zeros(X.shape[0], dtype=np.float64)
+        return self.leaf_value[self._walk(X)]
+
+    def predict_leaf_index(self, X: np.ndarray) -> np.ndarray:
+        return self._walk(X)
+
     def to_arrays(self, max_leaves: int) -> TreeArrays:
         L = max_leaves
         L1 = max(L - 1, 1)
+        W = self.cat_bitset.shape[1] if self.cat_bitset.ndim == 2 and \
+            self.cat_bitset.shape[1] > 0 else 1
 
         def pad(a, n, dtype, fill=0):
             out = np.full(n, fill, dtype=dtype)
             out[: len(a)] = a
             return jnp.asarray(out)
 
+        bitset = np.zeros((L1, W), np.uint32)
+        bitset[: len(self.cat_bitset)] = self.cat_bitset
         return TreeArrays(
             num_leaves=jnp.asarray(self.num_leaves, jnp.int32),
             split_feature=pad(self.split_feature, L1, np.int32),
@@ -308,4 +338,6 @@ class HostTree:
             leaf_weight=pad(self.leaf_weight, L, np.float32),
             leaf_count=pad(self.leaf_count, L, np.float32),
             leaf_parent=pad(self.leaf_parent, L, np.int32, -1),
+            is_cat=pad(self.is_cat, L1, bool),
+            cat_bitset=jnp.asarray(bitset),
         )

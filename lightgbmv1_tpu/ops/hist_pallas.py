@@ -7,20 +7,26 @@ cross-workgroup reduction, 2,299 LoC of OpenCL).
 
 TPUs have no atomics; the design maps the OpenCL structure onto the MXU:
 
-* a grid step owns a row tile and builds the bin one-hot for ALL features of
-  its feature block at once, laid out ``(rows, features*bins)`` — the bins
-  are first broadcast across each feature's bin-lane span with a tiny
-  constant expansion matmul (`bins_wide[r, f*B+b] = bins[r, f]`), then
-  compared against a per-lane ``iota % B`` pattern.  Everything stays in
-  VMEM; nothing intermediate touches HBM (the jnp fallback's bottleneck),
-* per (channel, hi/lo-part) the histogram update is ONE large MXU matmul
-  ``(leaves, rows) @ (rows, features*bins)``,
-* the per-workgroup local histogram becomes a VMEM f32 accumulator block
-  revisited across the row-tile grid dimension (Pallas output revisiting =
-  the ``within_kernel_reduction`` of histogram256.cl:139-310, without the
-  atomic counter dance),
-* fp32 precision comes from the bf16 hi/lo split (two MXU passes) instead
-  of the OpenCL kernels' compile-time ``USE_DP_FLOAT`` switch.
+* a grid step owns a (rows × feature-block) tile and builds the bin one-hot
+  for its whole feature block in VMEM, laid out ``(rows, bins*features)``
+  via a tile-repeat of the bin ids (``pltpu.repeat``) compared against a
+  ``lane // FBLK`` iota — nothing intermediate ever touches HBM, which is
+  what made the pure-XLA one-hot path bandwidth-bound,
+* the histogram update is ONE MXU matmul per tile:
+  ``(3·leaves, rows) @ (rows, bins*features)``, with the per-leaf-masked
+  gradient rows built by an iota//3-vs-leaf compare (cheap VPU work),
+* the per-workgroup local histogram of the OpenCL kernels becomes a VMEM
+  f32 accumulator block revisited across the row-tile grid dimension (the
+  analog of ``within_kernel_reduction256x4``, histogram256.cl:139-310,
+  without the atomic counter dance),
+* precision modes replace the OpenCL ``USE_DP_FLOAT`` switch:
+    - ``int8``  — per-tile-quantized gradients on the int8 MXU path (2×
+      bf16 throughput; counts are exact via a power-of-two scale). The
+      TPU analog of LightGBM's quantized-histogram training.
+    - ``bf16``  — single bf16 pass (the GPU learner's single-precision
+      default, gpu_tree_learner.h:79).
+    - ``bf16x2``— hi/lo-split bf16, ~fp32 accuracy at 2 MXU passes.
+    - ``f32``   — exact; used by tests/CPU.
 
 HBM traffic per pass ≈ bins (N·F bytes) + g3 + leaf_id — nothing else.
 """
@@ -36,72 +42,109 @@ from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-FEATURE_BLOCK = 32
+MAX_LANES = 2048          # lanes per one-hot block: FBLK * num_bins
+_COUNT_SCALE = 64.0       # power-of-two count quantizer => exact counts
 
 
-def _row_tile_for(num_leaves_p: int) -> int:
-    # keep the VMEM working set (one-hot + bins_wide + lg parts + out
-    # accumulator) under the ~16MB budget as the leaf count grows
-    if num_leaves_p <= 72:
-        return 1024
-    if num_leaves_p <= 136:
-        return 512
+def _row_tile_for(m_pad: int, num_lanes: int) -> int:
+    """Row-tile size keeping the VMEM working set (chunked one-hot + repeat
+    buffer + lg rows + out accumulator) within budget as leaves grow."""
+    out_bytes = m_pad * num_lanes * 4
+    per_row = 512 * 6 + m_pad * 12
+    for t in (1024, 512, 256):
+        if out_bytes + t * per_row <= 10 * 2**20:
+            return t
     return 256
 
 
-def _hist_kernel(bins_ref, g3_ref, leaf_ref, out_ref, *, num_leaves_p,
-                 num_bins, fblock, precision):
-    """Grid: (feature_blocks, row_tiles).
+def _kernel(iota_ref, bins_ref, g3_ref, leaf_ref, out_ref, *, lpad, num_bins,
+            fblk, precision, interpret):
+    """Grid: (feature_blocks, row_tiles); out revisited across row tiles.
 
-    bins_ref: (RT, FBLK) uint8      — row-major bin tile
-    g3_ref:   (RT, 3) f32           — grad / hess / count
-    leaf_ref: (RT, 1) int32         — leaf id per row (padded rows -> Lp-1)
-    out_ref:  (1, 3, Lp, FBLK*B) f32 — accumulated across the row-tile dim
+    iota_ref: (1, FBLK*B) bf16         — precomputed ``lane // FBLK`` pattern
+                                         (bin ids are < 256 => exact in bf16;
+                                         v5e has no int8 vector compare)
+    bins_ref: (T, FBLK) uint8          — row-major bin tile
+    g3_ref:   (3, T) f32               — grad / hess / count (pre-transposed)
+    leaf_ref: (1, T) int32             — leaf id per row
+    out_ref:  (1, 3*Lpad, FBLK*B) f32  — rows are (leaf-major, channel-minor)
     """
     rt = pl.program_id(1)
-    Lp = num_leaves_p
     B = num_bins
-    FB = fblock * B
-    RT = g3_ref.shape[0]
-    mm_dtype = jnp.float32 if precision == "f32" else jnp.bfloat16
+    T = bins_ref.shape[0]
+    m_pad = out_ref.shape[1]
+    lanes = B * fblk
 
     @pl.when(rt == 0)
     def _():
-        out_ref[...] = jnp.zeros(out_ref.shape, out_ref.dtype)
+        out_ref[...] = jnp.zeros_like(out_ref)
 
-    # --- one-hot over (rows, features*bins) ------------------------------
-    # expansion matmul: bins_wide[r, f*B + b] = bins[r, f]
-    col_feat = lax.broadcasted_iota(jnp.int32, (fblock, FB), 1) // B
-    row_feat = lax.broadcasted_iota(jnp.int32, (fblock, FB), 0)
-    expand = (col_feat == row_feat).astype(jnp.bfloat16)        # (FBLK, FB)
-    bins_bf16 = bins_ref[...].astype(jnp.int32).astype(jnp.bfloat16)
-    bins_wide = jnp.dot(bins_bf16, expand,
-                        preferred_element_type=jnp.float32)     # (RT, FB)
-    iota_mod = (
-        lax.broadcasted_iota(jnp.int32, (1, FB), 1) % B
-    ).astype(jnp.float32)                                       # (1, FB)
-    oh = (bins_wide == iota_mod).astype(mm_dtype)               # (RT, FB)
+    def rep(x, n, axis):
+        if interpret:
+            reps = [1, 1]
+            reps[axis] = n
+            return jnp.tile(x, reps)
+        return pltpu.repeat(x, n, axis)
 
-    # --- per-leaf-masked gradient rows -----------------------------------
-    leaf = leaf_ref[:, 0]
-    leaf_oh = (
-        leaf[None, :] == lax.broadcasted_iota(jnp.int32, (Lp, RT), 0)
-    ).astype(jnp.float32)                                       # (Lp, RT)
+    # --- per-leaf-masked gradient rows (3*Lpad, T), built once -------------
+    leaf = leaf_ref[...]                                     # (1, T)
+    row_leaf = lax.broadcasted_iota(jnp.int32, (m_pad, T), 0) // 3
+    loh = row_leaf == leaf                                   # (3*Lpad, T) bool
+    g3 = g3_ref[...]                                         # (3, T) f32
 
-    for ch in range(3):
-        lg = leaf_oh * g3_ref[:, ch][None, :]                   # (Lp, RT)
-        if precision == "bf16":
-            parts = [lg.astype(jnp.bfloat16)]
-        elif precision == "f32":
-            parts = [lg]
-        else:  # bf16x2: exact-ish fp32 via hi/lo split
-            hi = lg.astype(jnp.bfloat16)
-            lo = (lg - hi.astype(jnp.float32)).astype(jnp.bfloat16)
-            parts = [hi, lo]
-        acc = out_ref[0, ch]
-        for p in parts:
-            acc = acc + jnp.dot(p, oh, preferred_element_type=jnp.float32)
-        out_ref[0, ch] = acc
+    # VPU constraints on this target: vector compare/select only in i32/f32;
+    # narrow dtypes appear only via a final astype feeding the MXU.
+    if precision == "int8":
+        amax = jnp.max(jnp.abs(g3[:2]), axis=1, keepdims=True)       # (2, 1)
+        inv = jnp.where(amax > 0, 127.0 / amax, 0.0)
+        scale = jnp.where(amax > 0, amax / 127.0, 0.0)
+        inv3 = jnp.concatenate(
+            [inv, jnp.full((1, 1), _COUNT_SCALE, jnp.float32)], axis=0)
+        scale3 = jnp.concatenate(
+            [scale, jnp.full((1, 1), 1.0 / _COUNT_SCALE, jnp.float32)], axis=0)
+        q3 = jnp.round(g3 * inv3)                                    # (3, T)
+        lg_parts = [jnp.where(loh, rep(q3, lpad, 0), 0.0).astype(jnp.int8)]
+        scale_rep = rep(scale3, lpad, 0)                             # (M, 1)
+    elif precision in ("bf16", "bf16x2"):
+        lg = jnp.where(loh, rep(g3, lpad, 0), 0.0)            # (3*Lpad, T)
+        hi = lg.astype(jnp.bfloat16)
+        lg_parts = [hi]
+        if precision == "bf16x2":
+            lg_parts.append((lg - hi.astype(jnp.float32)).astype(jnp.bfloat16))
+    else:  # f32 — exact (HIGHEST forces true-f32 MXU passes)
+        lg_parts = [jnp.where(loh, rep(g3, lpad, 0), 0.0)]
+
+    # --- bin one-hot, built in column chunks to bound VMEM -----------------
+    # column b*FBLK + f is (feature f, bin b); the repeat pattern of the bin
+    # ids over one chunk of bins is chunk-invariant, so it is hoisted.
+    cb = max(1, min(B, 512 // fblk))         # bins per chunk
+    n_chunks = -(-B // cb)
+    bins_f = bins_ref[...].astype(jnp.int32).astype(jnp.float32)
+
+    for c in range(n_chunks):
+        cb_c = min(cb, B - c * cb)
+        sl = slice(c * cb * fblk, (c * cb + cb_c) * fblk)
+        bw = rep(bins_f, cb_c, 1)                            # (T, cb_c*FBLK)
+        oh_cmp = bw == iota_ref[0:1, sl]
+        if precision == "int8":
+            oh = jnp.where(oh_cmp, 1.0, 0.0).astype(jnp.int8)
+            acc = lax.dot_general(lg_parts[0], oh, (((1,), (0,)), ((), ())),
+                                  preferred_element_type=jnp.int32)
+            out_ref[0, :, sl] += acc.astype(jnp.float32) * scale_rep
+        elif precision in ("bf16", "bf16x2"):
+            oh = jnp.where(oh_cmp, 1.0, 0.0).astype(jnp.bfloat16)
+            upd = lax.dot_general(lg_parts[0], oh, (((1,), (0,)), ((), ())),
+                                  preferred_element_type=jnp.float32)
+            for p in lg_parts[1:]:
+                upd = upd + lax.dot_general(p, oh, (((1,), (0,)), ((), ())),
+                                            preferred_element_type=jnp.float32)
+            out_ref[0, :, sl] += upd
+        else:
+            oh = jnp.where(oh_cmp, 1.0, 0.0)
+            out_ref[0, :, sl] += lax.dot_general(
+                lg_parts[0], oh, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+                precision=lax.Precision.HIGHEST)
 
 
 @functools.partial(
@@ -110,55 +153,62 @@ def _hist_kernel(bins_ref, g3_ref, leaf_ref, out_ref, *, num_leaves_p,
                      "interpret"),
 )
 def hist_leaves_pallas(
-    binned: jax.Array,      # (F, N) uint8/int16
+    binned: jax.Array,      # (F, N) uint8
     g3: jax.Array,          # (N, 3) f32
     leaf_id: jax.Array,     # (N,) int32
     num_leaves: int,
     num_bins: int,
-    precision: str = "bf16x2",
+    precision: str = "int8",
     row_tile: int = 0,
     interpret: bool = False,
 ) -> jax.Array:             # (L, F, B, 3) f32
     F, N = binned.shape
     L, B = num_leaves, num_bins
-    Lp = L + 1                       # padded rows route to slot L
-    RT = row_tile if row_tile > 0 else _row_tile_for(Lp)
-    NRT = -(-N // RT)
-    NFB = -(-F // FEATURE_BLOCK)
-    F_pad = NFB * FEATURE_BLOCK
-    N_pad = NRT * RT
+    if binned.dtype not in (jnp.uint8, np.uint8):
+        raise ValueError(
+            "hist_leaves_pallas requires uint8 bins (num_bins <= 256); "
+            "route int16-binned data to the onehot/scatter path")
 
-    binsT = jnp.pad(binned.astype(jnp.uint8),
-                    ((0, F_pad - F), (0, N_pad - N))).T      # (N_pad, F_pad)
-    g3_p = jnp.pad(g3.astype(jnp.float32), ((0, N_pad - N), (0, 0)))
-    leaf_p = jnp.pad(leaf_id.astype(jnp.int32), (0, N_pad - N),
-                     constant_values=L)[:, None]
+    fblk = max(1, min(F, MAX_LANES // B))
+    nfb = -(-F // fblk)
+    f_pad = nfb * fblk
+    lpad = -(-L // 8) * 8
+    m_pad = 3 * lpad
+    T = row_tile if row_tile > 0 else _row_tile_for(m_pad, fblk * B)
+    nrt = -(-N // T)
+    n_pad = nrt * T
+
+    # row-major bins; padded features get bin 255 (matches no b < 256 when
+    # B < 256; for B == 256 padded features land in bin 255 of a feature
+    # that is sliced away below). padded rows carry zero g3 => no effect.
+    binned_rm = jnp.pad(binned, ((0, f_pad - F), (0, n_pad - N)),
+                        constant_values=255).T           # (n_pad, f_pad)
+    g3t = jnp.pad(g3.astype(jnp.float32), ((0, n_pad - N), (0, 0))).T  # (3, n_pad)
+    leaf_p = jnp.pad(leaf_id.astype(jnp.int32), (0, n_pad - N),
+                     constant_values=lpad)[None, :]      # (1, n_pad)
+
+    iota_bins = (jnp.arange(B * fblk, dtype=jnp.int32)
+                 // fblk).astype(jnp.float32)[None, :]      # (1, B*fblk)
 
     kernel = functools.partial(
-        _hist_kernel, num_leaves_p=Lp, num_bins=B, fblock=FEATURE_BLOCK,
-        precision=precision,
+        _kernel, lpad=lpad, num_bins=B, fblk=fblk, precision=precision,
+        interpret=interpret,
     )
     out = pl.pallas_call(
         kernel,
-        grid=(NFB, NRT),
+        grid=(nfb, nrt),
         in_specs=[
-            pl.BlockSpec((RT, FEATURE_BLOCK), lambda fb, rt: (rt, fb),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((RT, 3), lambda fb, rt: (rt, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((RT, 1), lambda fb, rt: (rt, 0),
-                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, fblk * B), lambda fb, rt: (0, 0)),
+            pl.BlockSpec((T, fblk), lambda fb, rt: (rt, fb)),
+            pl.BlockSpec((3, T), lambda fb, rt: (0, rt)),
+            pl.BlockSpec((1, T), lambda fb, rt: (0, rt)),
         ],
-        out_specs=pl.BlockSpec(
-            (1, 3, Lp, FEATURE_BLOCK * B), lambda fb, rt: (fb, 0, 0, 0),
-            memory_space=pltpu.VMEM,
-        ),
-        out_shape=jax.ShapeDtypeStruct((NFB, 3, Lp, FEATURE_BLOCK * B),
-                                       jnp.float32),
+        out_specs=pl.BlockSpec((1, m_pad, fblk * B), lambda fb, rt: (fb, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((nfb, m_pad, fblk * B), jnp.float32),
         interpret=interpret,
-    )(binsT, g3_p, leaf_p)
+    )(iota_bins, binned_rm, g3t, leaf_p)
 
-    # (NFB, 3, Lp, FBLK*B) -> (L, F, B, 3)
-    h = out.reshape(NFB, 3, Lp, FEATURE_BLOCK, B)
-    h = h.transpose(2, 0, 3, 4, 1).reshape(Lp, F_pad, B, 3)
+    # (nfb, 3*Lpad, B*fblk) -> (L, F, B, 3)
+    h = out.reshape(nfb, lpad, 3, B, fblk)
+    h = h.transpose(1, 0, 4, 3, 2).reshape(lpad, f_pad, B, 3)
     return h[:L, :F]

@@ -168,7 +168,8 @@ def hist_one_leaf(
     if method == "pallas":
         from .hist_pallas import hist_leaves_pallas
 
-        return hist_leaves_pallas(binned, g3m, zeros, 1, num_bins)[0]
+        return hist_leaves_pallas(binned, g3m, zeros, 1, num_bins,
+                                  precision=precision)[0]
     return hist_leaves_scatter(binned, g3m, zeros, 1, num_bins)[0]
 
 
@@ -187,14 +188,26 @@ def hist_frontier(
     if method == "pallas":
         from .hist_pallas import hist_leaves_pallas
 
-        return hist_leaves_pallas(binned, g3, leaf_id, num_leaves, num_bins)
+        return hist_leaves_pallas(binned, g3, leaf_id, num_leaves, num_bins,
+                                  precision=precision)
     return hist_leaves_scatter(binned, g3, leaf_id, num_leaves, num_bins)
 
 
-def default_hist_method(config_method: str = "auto") -> str:
+def default_hist_method(config_method: str = "auto",
+                        bin_dtype=None) -> str:
+    """Pick the histogram implementation.
+
+    TPU default is the Pallas kernel (validated vs the scatter oracle in
+    tests/test_histogram.py, the analog of the reference's CompareHistograms
+    debug comparator, gpu_tree_learner.cpp:71-98).  int16-binned data
+    (num_bins > 256) routes to the XLA one-hot path — the Pallas kernel is
+    uint8-only (see hist_pallas.hist_leaves_pallas).
+    """
     if config_method != "auto":
         return config_method
     platform = jax.default_backend()
     if platform == "cpu":
         return "scatter"
-    return "onehot"  # pallas becomes the default once validated on hardware
+    if bin_dtype is not None and jnp.dtype(bin_dtype).itemsize > 1:
+        return "onehot"
+    return "pallas"

@@ -25,10 +25,11 @@ Differences from the reference:
 from __future__ import annotations
 
 import functools
-from typing import NamedTuple
+from typing import NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 from ..io.binning import MISSING_NAN, MISSING_ZERO
@@ -45,6 +46,12 @@ class SplitParams(NamedTuple):
     min_sum_hessian_in_leaf: float = 1e-3
     min_gain_to_split: float = 0.0
     max_delta_step: float = 0.0
+    # categorical split parameters (reference config.h / feature_histogram.hpp)
+    cat_l2: float = 10.0
+    cat_smooth: float = 10.0
+    max_cat_threshold: int = 32
+    max_cat_to_onehot: int = 4
+    min_data_per_group: float = 100.0
 
 
 class SplitResult(NamedTuple):
@@ -55,6 +62,9 @@ class SplitResult(NamedTuple):
     default_left: jax.Array  # bool — missing-value direction
     left_sum: jax.Array      # (3,) [grad, hess, count]
     right_sum: jax.Array     # (3,)
+    is_cat: jax.Array        # bool — categorical (bitset) split
+    cat_bitset: jax.Array    # (W,) uint32 — bin-space membership bitset
+                             # (W = ceil(num_bins/32)); bins in the set go left
 
 
 def threshold_l1(s: jax.Array, l1: float) -> jax.Array:
@@ -91,24 +101,183 @@ class FeatureMeta(NamedTuple):
     zero_bin: jax.Array       # (F,) int32
     is_categorical: jax.Array  # (F,) bool
     usable: jax.Array         # (F,) bool — not trivial
+    monotone_type: jax.Array  # (F,) int32 — -1 / 0 / +1 constraint direction
 
 
-def make_feature_meta(dataset) -> FeatureMeta:
-    import numpy as np
-
-    # TODO(categorical): categorical features are excluded from splitting
-    # until the bitset categorical split (reference
-    # FindBestThresholdCategoricalInner, feature_histogram.hpp:278-460) is
-    # implemented — splitting them as ordinal rank-bins would make raw
-    # prediction silently diverge from training.
+def make_feature_meta(dataset, monotone_constraints=None) -> FeatureMeta:
+    F = len(dataset.num_bins)
+    mono = np.zeros(F, np.int32)
+    if monotone_constraints:
+        mc = np.asarray(list(monotone_constraints), np.int32)
+        mono[: min(F, len(mc))] = mc[:F]
     return FeatureMeta(
         num_bins=jnp.asarray(dataset.num_bins, jnp.int32),
         missing_type=jnp.asarray(dataset.missing_types, jnp.int32),
         nan_bin=jnp.asarray(dataset.nan_bins, jnp.int32),
         zero_bin=jnp.asarray(dataset.zero_bins, jnp.int32),
         is_categorical=jnp.asarray(dataset.is_categorical),
-        usable=jnp.asarray(~dataset.is_trivial & ~dataset.is_categorical),
+        usable=jnp.asarray(~dataset.is_trivial),
+        monotone_type=jnp.asarray(mono),
     )
+
+
+NO_CONSTRAINT = (-3.0e38, 3.0e38)   # f32-max-ish; reference uses double max
+
+
+def leaf_gain_given_output(g, h, out, p: SplitParams):
+    """reference: GetLeafGainGivenOutput, feature_histogram.hpp — the gain
+    of a leaf forced to emit ``out`` (equals leaf_gain at the unconstrained
+    optimum)."""
+    t = threshold_l1(g, p.lambda_l1)
+    return -(2.0 * t * out + (h + p.lambda_l2) * out * out)
+
+
+def monotone_penalty_factor(depth, penalization):
+    """reference: ComputeMonotoneSplitGainPenalty,
+    monotone_constraints.hpp:66-76."""
+    eps = 1e-10
+    d = depth.astype(jnp.float32) if hasattr(depth, "astype") else float(depth)
+    small = 1.0 - penalization / (2.0 ** d) + eps
+    large = 1.0 - 2.0 ** (penalization - 1.0 - d) + eps
+    out = jnp.where(penalization <= 1.0, small, large)
+    return jnp.where(penalization >= d + 1.0, eps, out)
+
+
+def _pack_bitset(member: jax.Array, num_bins: int) -> jax.Array:
+    """(B,) bool membership -> (ceil(B/32),) uint32 bitset words."""
+    W = -(-num_bins // 32)
+    pad = W * 32 - num_bins
+    m = jnp.pad(member.astype(jnp.uint32), (0, pad)).reshape(W, 32)
+    shifts = jnp.arange(32, dtype=jnp.uint32)
+    return (m << shifts).sum(axis=1).astype(jnp.uint32)
+
+
+def bitset_contains(bitset: jax.Array, bins: jax.Array) -> jax.Array:
+    """Vectorized FindInBitset (reference include/LightGBM/utils/common.h):
+    bitset (..., W) uint32, bins (...,) int — True where bit is set."""
+    b = bins.astype(jnp.int32)
+    word = jnp.take_along_axis(
+        bitset, (b[..., None] >> 5).astype(jnp.int32), axis=-1)[..., 0]
+    return ((word >> (b.astype(jnp.uint32) & 31)) & 1) == 1
+
+
+def _best_categorical(hist, parent_sum, meta, feature_mask, params):
+    """Best categorical split across all features of one leaf.
+
+    reference: FindBestThresholdCategoricalInner,
+    src/treelearner/feature_histogram.hpp:278-460 — one-vs-rest for features
+    with few categories (max_cat_to_onehot), otherwise a two-direction scan
+    over bins sorted by grad/(hess+cat_smooth) with cat_l2 regularization and
+    min_data_per_group batching.
+
+    Deviation from the reference: the trailing "other/unseen/NaN" bin of a
+    categorical feature is never placed in the left (in-set) side, so the
+    bin-space decision used in training is always exactly expressible as a
+    raw-category bitset in the v3 model format (unseen categories at
+    prediction time go right, like the reference's FindInBitset miss).
+    """
+    F, B, _ = hist.shape
+    eps = 1e-15
+    g, h, c = hist[..., 0], hist[..., 1], hist[..., 2]
+    total_g, total_h, total_c = parent_sum[0], parent_sum[1], parent_sum[2]
+    t_idx = lax.broadcasted_iota(jnp.int32, (F, B), 1)
+    nb = meta.num_bins[:, None]
+    fmask = (feature_mask & meta.usable & meta.is_categorical)[:, None]
+    # exclude the trailing other/unseen bin from left-set membership
+    bin_ok = (t_idx < nb - 1) & fmask
+    use_onehot = (nb <= params.max_cat_to_onehot)
+
+    # ---- one-vs-rest (reference :316-369) --------------------------------
+    oth_g, oth_h, oth_c = total_g - g, total_h - h, total_c - c
+    ok1 = (
+        bin_ok & use_onehot
+        & (c >= params.min_data_in_leaf)
+        & (h >= params.min_sum_hessian_in_leaf)
+        & (oth_c >= params.min_data_in_leaf)
+        & (oth_h - eps >= params.min_sum_hessian_in_leaf)
+    )
+    gain1 = leaf_gain(g, h + eps, params) + leaf_gain(oth_g, oth_h - eps, params)
+    gain1 = jnp.where(ok1, gain1, NEG_INF)
+
+    # ---- sorted two-direction scan (reference :371-470) ------------------
+    l2cat = params._replace(lambda_l2=params.lambda_l2 + params.cat_l2)
+    valid = bin_ok & (~use_onehot) & (c >= params.cat_smooth)
+    ratio = jnp.where(valid, g / (h + params.cat_smooth), jnp.inf)
+    order = jnp.argsort(ratio, axis=1)                 # (F, B) valid first
+    used_bin = valid.sum(axis=1)                       # (F,)
+    sg = jnp.take_along_axis(g, order, axis=1)
+    sh = jnp.take_along_axis(h, order, axis=1)
+    sc = jnp.take_along_axis(c, order, axis=1)
+    # backward direction: positions used_bin-1, used_bin-2, ...
+    bwd_idx = jnp.clip(used_bin[:, None] - 1 - t_idx, 0, B - 1)
+    sg2 = jnp.stack([sg, jnp.take_along_axis(sg, bwd_idx, axis=1)])  # (2,F,B)
+    sh2 = jnp.stack([sh, jnp.take_along_axis(sh, bwd_idx, axis=1)])
+    sc2 = jnp.stack([sc, jnp.take_along_axis(sc, bwd_idx, axis=1)])
+    clg = jnp.cumsum(sg2, axis=2)
+    clh = jnp.cumsum(sh2, axis=2) + eps
+    clc = jnp.cumsum(sc2, axis=2)
+    crg, crh, crc = total_g - clg, total_h - clh, total_c - clc
+
+    max_num_cat = jnp.minimum(params.max_cat_threshold, (used_bin + 1) // 2)
+    pos_ok = (
+        (t_idx[None] < max_num_cat[None, :, None])
+        & (t_idx[None] < used_bin[None, :, None])
+        & (clc >= params.min_data_in_leaf)
+        & (clh >= params.min_sum_hessian_in_leaf)
+        & (crc >= params.min_data_in_leaf)
+        & (crc >= params.min_data_per_group)
+        & (crh >= params.min_sum_hessian_in_leaf)
+    )
+
+    # min_data_per_group batching: evaluate a prefix only when >= mdpg rows
+    # accumulated since the previous evaluated prefix (reference
+    # cnt_cur_group) — the single sequential piece, scanned over positions.
+    n_steps = min(B, int(params.max_cat_threshold))
+
+    def grp_step(grp, i):
+        grp = grp + sc2[:, :, i]
+        can = pos_ok[:, :, i] & (grp >= params.min_data_per_group)
+        return jnp.where(can, 0.0, grp), can
+
+    _, can_eval = lax.scan(grp_step, jnp.zeros((2, F)), jnp.arange(n_steps))
+    can_eval = jnp.moveaxis(can_eval, 0, 2)            # (2, F, n_steps)
+    can_eval = jnp.pad(can_eval, ((0, 0), (0, 0), (0, B - n_steps)))
+
+    gain2 = leaf_gain(clg, clh, l2cat) + leaf_gain(crg, crh, l2cat)
+    gain2 = jnp.where(can_eval, gain2, NEG_INF)        # (2, F, B)
+
+    # ---- pick the best categorical candidate -----------------------------
+    flat = jnp.concatenate([gain1.reshape(-1), gain2.reshape(-1)])
+    best = jnp.argmax(flat)
+    best_gain = flat[best]
+    from_onehot = best < F * B
+    idx2 = jnp.maximum(best - F * B, 0)
+    direction = (idx2 // (F * B)).astype(jnp.int32)    # 0 fwd, 1 bwd
+    feat = jnp.where(from_onehot, (best // B) % F, (idx2 // B) % F).astype(jnp.int32)
+    pos = jnp.where(from_onehot, best % B, idx2 % B).astype(jnp.int32)
+
+    left1 = hist[feat, pos] + jnp.array([0.0, eps, 0.0])
+    left2 = jnp.stack([clg[direction, feat, pos],
+                       clh[direction, feat, pos],
+                       clc[direction, feat, pos]])
+    left = jnp.where(from_onehot, left1, left2)
+
+    # membership: one-hot -> the single bin; sorted -> prefix of the order
+    pos_iota = t_idx[0]                                # (B,)
+    ub = used_bin[feat]
+    member_pos = jnp.where(direction == 0,
+                           pos_iota <= pos,
+                           (pos_iota >= ub - 1 - pos) & (pos_iota < ub))
+    member_sorted = jnp.zeros(B, bool).at[order[feat]].set(member_pos)
+    member_bins = jnp.where(from_onehot, pos_iota == pos, member_sorted)
+    bitset = _pack_bitset(member_bins, B)
+
+    return best_gain, feat, left, bitset
+
+
+def _no_cat_result(num_bins: int):
+    W = -(-num_bins // 32)
+    return jnp.zeros(W, jnp.uint32)
 
 
 def find_best_split(
@@ -117,9 +286,16 @@ def find_best_split(
     meta: FeatureMeta,
     feature_mask: jax.Array,  # (F,) bool — col-sampled usable features
     params: SplitParams,
+    constraint: Optional[jax.Array] = None,  # (2,) [min, max] leaf output bound
+    depth=0,                  # leaf depth (monotone_penalty)
+    monotone_penalty: float = 0.0,
 ) -> SplitResult:
     F, B, _ = hist.shape
     total_g, total_h, total_c = parent_sum[0], parent_sum[1], parent_sum[2]
+
+    use_mc = bool(np.asarray(meta.monotone_type).any())
+    if constraint is None:
+        constraint = jnp.asarray(NO_CONSTRAINT, jnp.float32)
 
     cum = jnp.cumsum(hist, axis=1)                    # (F, B, 3) inclusive
     t_idx = lax.broadcasted_iota(jnp.int32, (F, B), 1)
@@ -146,16 +322,40 @@ def find_best_split(
             & (lh >= params.min_sum_hessian_in_leaf)
             & (rh >= params.min_sum_hessian_in_leaf)
         )
-        gain = leaf_gain(lg, lh, params) + leaf_gain(rg, rh, params)
-        return jnp.where(ok, gain, NEG_INF)
+        if not use_mc:
+            gain = leaf_gain(lg, lh, params) + leaf_gain(rg, rh, params)
+            return jnp.where(ok, gain, NEG_INF)
+        # monotone mode (reference: GetSplitGains with USE_MC +
+        # BasicLeafConstraints clamp, feature_histogram.hpp:782-830): leaf
+        # outputs are clamped to the leaf's [min, max] bound, the gain is
+        # evaluated at the clamped outputs, and a split violating the
+        # feature's monotone direction is rejected.
+        out_l = jnp.clip(leaf_output(lg, lh, params), constraint[0], constraint[1])
+        out_r = jnp.clip(leaf_output(rg, rh, params), constraint[0], constraint[1])
+        mono = meta.monotone_type[:, None]             # (F, 1)
+        violates = ((mono > 0) & (out_l > out_r)) | ((mono < 0) & (out_l < out_r))
+        gain = (leaf_gain_given_output(lg, lh, out_l, params)
+                + leaf_gain_given_output(rg, rh, out_r, params))
+        return jnp.where(ok & (~violates), gain, NEG_INF)
 
-    base_valid = (t_idx <= nb - 2) & feature_mask[:, None] & meta.usable[:, None]
+    numerical_ok = feature_mask[:, None] & meta.usable[:, None] & (
+        ~meta.is_categorical[:, None])
+    base_valid = (t_idx <= nb - 2) & numerical_ok
     gain_a = jnp.where(base_valid, eval_direction(left_a), NEG_INF)
     gain_b = jnp.where(
         base_valid & has_nan_dir, eval_direction(left_b), NEG_INF
     )
 
     gains = jnp.stack([gain_a, gain_b])               # (2, F, B)
+    if use_mc and monotone_penalty > 0:
+        # reference: ComputeBestSplitForFeature multiplies the relative gain
+        # by the depth penalty for monotone features
+        # (serial_tree_learner.cpp:701-736)
+        pg = leaf_gain(total_g, total_h, params)
+        factor = monotone_penalty_factor(jnp.asarray(depth), monotone_penalty)
+        mono_f = (meta.monotone_type != 0)[None, :, None]
+        gains = jnp.where(
+            jnp.isfinite(gains) & mono_f, (gains - pg) * factor + pg, gains)
     flat = gains.reshape(-1)
     best = jnp.argmax(flat)
     best_gain = flat[best]
@@ -166,6 +366,25 @@ def find_best_split(
 
     left = jnp.where(direction == 0, left_a[feature, threshold],
                      left_b[feature, threshold])
+
+    # categorical candidates (compiled in only when the dataset has any —
+    # meta arrays are trace-time constants via the grower closure)
+    has_cat = bool(np.asarray(meta.is_categorical).any())
+    W = -(-B // 32)
+    if has_cat:
+        cgain, cfeat, cleft, cbitset = _best_categorical(
+            hist, parent_sum, meta, feature_mask, params)
+        use_cat = cgain > best_gain
+        best_gain = jnp.maximum(best_gain, cgain)
+        feature = jnp.where(use_cat, cfeat, feature)
+        threshold = jnp.where(use_cat, 0, threshold)
+        left = jnp.where(use_cat, cleft, left)
+        is_cat = use_cat
+        cat_bitset = jnp.where(use_cat, cbitset, jnp.zeros(W, jnp.uint32))
+    else:
+        is_cat = jnp.asarray(False)
+        cat_bitset = jnp.zeros(W, jnp.uint32)
+
     right = parent_sum - left
 
     # default direction for missing values at prediction time
@@ -175,6 +394,7 @@ def find_best_split(
         direction == 1,
         jnp.where(mtype == MISSING_ZERO, meta.zero_bin[feature] <= threshold, False),
     )
+    default_left = default_left & (~is_cat)
 
     parent_gain = leaf_gain(total_g, total_h, params)
     rel_gain = best_gain - parent_gain - params.min_gain_to_split
@@ -187,8 +407,12 @@ def find_best_split(
         default_left=default_left,
         left_sum=left.astype(jnp.float32),
         right_sum=right.astype(jnp.float32),
+        is_cat=is_cat,
+        cat_bitset=cat_bitset,
     )
 
 
-# vmapped over a batch of leaves: hist (K, F, B, 3), parent (K, 3), mask (K, F)
-find_best_split_batch = jax.vmap(find_best_split, in_axes=(0, 0, None, 0, None))
+# vmapped over a batch of leaves: hist (K, F, B, 3), parent (K, 3), mask (K, F),
+# constraint (K, 2); depth/penalty shared
+find_best_split_batch = jax.vmap(
+    find_best_split, in_axes=(0, 0, None, 0, None, 0, None, None))

@@ -61,11 +61,16 @@ def _make_mesh(num_shards: int, axis: str) -> Mesh:
 
 
 def _pack_split(res: SplitResult) -> jnp.ndarray:
+    """SplitInfo wire format for the cross-shard argmax (reference:
+    SplitInfo::CopyTo, split_info.hpp — fixed-size serialization). The
+    categorical bitset words ride along bit-exactly via a f32 bitcast."""
+    bits_f32 = lax.bitcast_convert_type(res.cat_bitset, jnp.float32)
     return jnp.concatenate([
         jnp.stack([res.gain, res.feature.astype(jnp.float32),
                    res.threshold_bin.astype(jnp.float32),
-                   res.default_left.astype(jnp.float32)]),
-        res.left_sum, res.right_sum,
+                   res.default_left.astype(jnp.float32),
+                   res.is_cat.astype(jnp.float32)]),
+        res.left_sum, res.right_sum, bits_f32,
     ])
 
 
@@ -75,9 +80,28 @@ def _unpack_split(v: jnp.ndarray) -> SplitResult:
         feature=v[1].astype(jnp.int32),
         threshold_bin=v[2].astype(jnp.int32),
         default_left=v[3] > 0.5,
-        left_sum=v[4:7],
-        right_sum=v[7:10],
+        left_sum=v[5:8],
+        right_sum=v[8:11],
+        is_cat=v[4] > 0.5,
+        cat_bitset=lax.bitcast_convert_type(v[11:], jnp.uint32),
     )
+
+
+def _warn_unimplemented(config: Config) -> None:
+    """Loudly reject accepted-but-unimplemented parameters instead of
+    silently ignoring them (the reference either enforces or rejects)."""
+    checks = [
+        ("cegb_tradeoff", config.cegb_tradeoff != 1.0),
+        ("cegb_penalty_split", config.cegb_penalty_split != 0.0),
+        ("cegb_penalty_feature_lazy", bool(config.cegb_penalty_feature_lazy)),
+        ("cegb_penalty_feature_coupled",
+         bool(config.cegb_penalty_feature_coupled)),
+    ]
+    for name, is_set in checks:
+        if is_set:
+            log_warning(
+                f"{name} is set but cost-effective gradient boosting is not "
+                "implemented in this build — the parameter has NO effect")
 
 
 def build_trainer(
@@ -92,7 +116,7 @@ def build_trainer(
     serial grower's signature; ``binned_device`` is already placed/padded
     for the chosen topology."""
     learner = config.tree_learner
-    method = default_hist_method(config.hist_method)
+    method = default_hist_method(config.hist_method, binned_np.dtype)
     precision = config.hist_dtype
     F, N = binned_np.shape
     B = num_bins
@@ -110,6 +134,14 @@ def build_trainer(
         return hist_frontier(binned, g3, leaf_id, L_level, B,
                              method=method, precision=precision)
 
+    if config.monotone_constraints and \
+            config.monotone_constraints_method not in ("basic", ""):
+        log_warning(
+            f"monotone_constraints_method="
+            f"{config.monotone_constraints_method} is not implemented; "
+            "using 'basic' (reference BasicLeafConstraints semantics)")
+    _warn_unimplemented(config)
+
     common = dict(
         num_leaves=config.num_leaves,
         num_bins=B,
@@ -117,6 +149,7 @@ def build_trainer(
         params=params,
         max_depth=config.max_depth,
         feature_fraction_bynode=config.feature_fraction_bynode,
+        monotone_penalty=config.monotone_penalty,
     )
 
     if learner in ("serial", ""):
@@ -209,6 +242,7 @@ def build_trainer(
             zero_bin=jnp.pad(meta.zero_bin, (0, pad_f)),
             is_categorical=jnp.pad(meta.is_categorical, (0, pad_f)),
             usable=jnp.pad(meta.usable, (0, pad_f)),
+            monotone_type=jnp.pad(meta.monotone_type, (0, pad_f)),
         )
         log_info(f"Feature-parallel training over {ndev} devices "
                  f"({F_loc} features/device)")
@@ -223,7 +257,7 @@ def build_trainer(
             full = jnp.zeros((F_pad, B, 3), jnp.float32)
             return lax.dynamic_update_slice(full, h, (lo, 0, 0))
 
-        def split_fn(hist, parent, mask, key, uid):
+        def split_fn(hist, parent, mask, key, uid, constraint, depth):
             # search only this device's features, then Allreduce-max over
             # packed SplitInfo (reference SyncUpGlobalBestSplit)
             lo = lax.axis_index("feature") * F_loc
@@ -232,7 +266,9 @@ def build_trainer(
             ) & (
                 lax.broadcasted_iota(jnp.int32, (F_pad, 1), 0)[:, 0] < lo + F_loc
             )
-            local = find_best_split(hist, parent, meta_p, mask & in_shard, params)
+            local = find_best_split(hist, parent, meta_p, mask & in_shard,
+                                    params, constraint, depth,
+                                    config.monotone_penalty)
             packed = _pack_split(local)
             allp = lax.all_gather(packed, "feature")        # (ndev, 10)
             best = jnp.argmax(allp[:, 0])
@@ -243,6 +279,7 @@ def build_trainer(
             num_leaves=config.num_leaves, num_bins=B, meta=meta_p,
             params=params, max_depth=config.max_depth,
             feature_fraction_bynode=config.feature_fraction_bynode,
+            monotone_penalty=config.monotone_penalty,
         )
         sharded = shard_map(
             grow,

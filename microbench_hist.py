@@ -1,0 +1,66 @@
+"""Micro-benchmark of histogram implementations on the current backend.
+
+Not part of the test suite; a profiling tool for the perf work.
+Usage: python microbench_hist.py [N] [F] [B]
+"""
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def timeit(fn, *args, reps=5):
+    out = fn(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / reps
+
+
+def main():
+    N = int(sys.argv[1]) if len(sys.argv) > 1 else 1_000_000
+    F = int(sys.argv[2]) if len(sys.argv) > 2 else 28
+    B = int(sys.argv[3]) if len(sys.argv) > 3 else 64
+
+    from lightgbmv1_tpu.ops.histogram import (
+        hist_leaves_onehot, hist_leaves_scatter,
+    )
+    from lightgbmv1_tpu.ops.hist_pallas import hist_leaves_pallas
+
+    rng = np.random.RandomState(0)
+    binned = jnp.asarray(rng.randint(0, B, size=(F, N), dtype=np.uint8))
+    g3 = jnp.asarray(rng.randn(N, 3).astype(np.float32))
+    print(f"backend={jax.default_backend()} N={N} F={F} B={B}", flush=True)
+
+    for L in (1, 2, 16, 64, 128, 256):
+        leaf = jnp.asarray(rng.randint(0, L, size=N).astype(np.int32))
+        row = {"L": L}
+        for name, fn in [
+            ("onehot", lambda: hist_leaves_onehot(binned, g3, leaf, L, B)),
+            ("pallas", lambda: hist_leaves_pallas(binned, g3, leaf, L, B)),
+        ]:
+            try:
+                dt = timeit(fn)
+                # useful throughput + achieved MXU FLOPs
+                flops = 2 * (L + 1) * 3 * N * F * B * 2  # bf16x2 = 2 passes
+                row[name] = f"{dt*1e3:8.2f}ms {N/dt/1e6:8.1f}Mrow/s {flops/dt/1e12:6.1f}TF/s"
+            except Exception as e:  # noqa
+                row[name] = f"FAIL {type(e).__name__}: {e}"[:120]
+        print(row, flush=True)
+
+    # scatter once for reference at L=256 (slow on TPU presumably)
+    L = 256
+    leaf = jnp.asarray(rng.randint(0, L, size=N).astype(np.int32))
+    try:
+        dt = timeit(lambda: hist_leaves_scatter(binned, g3, leaf, L, B), reps=2)
+        print({"L": L, "scatter": f"{dt*1e3:8.2f}ms {N/dt/1e6:8.1f}Mrow/s"}, flush=True)
+    except Exception as e:
+        print("scatter FAIL", e)
+
+
+if __name__ == "__main__":
+    main()

@@ -53,7 +53,7 @@ def test_model_text_roundtrip(tmp_path):
     text = open(path).read()
     for marker in ("tree\nversion=v3", "num_class=1", "feature_names=",
                    "tree_sizes=", "Tree=0", "end of trees",
-                   "feature importances:", "parameters:", "pandas_categorical:null"):
+                   "feature_importances:", "parameters:", "pandas_categorical:null"):
         assert marker in text, marker
 
 

@@ -1,0 +1,95 @@
+"""Continued training (init_model) and refit tests.
+
+reference: continued training via input_model
+(src/boosting/boosting.cpp:46+, application.cpp:90-93, engine.py:18
+init_model path) and refit (basic.py:2873, GBDT::RefitTree gbdt.cpp:266);
+engine tests test_continue_train* (test_engine.py:592-678), refit (:1312).
+"""
+
+import numpy as np
+import pytest
+
+import lightgbmv1_tpu as lgb
+from tests.conftest import make_binary_problem, make_regression_problem
+
+PARAMS = {"objective": "binary", "num_leaves": 15, "min_data_in_leaf": 20,
+          "learning_rate": 0.1, "metric": "binary_logloss", "verbosity": -1}
+
+
+def _logloss(pred, y):
+    p = np.clip(pred, 1e-12, 1 - 1e-12)
+    return -np.mean(y * np.log(p) + (1 - y) * np.log(1 - p))
+
+
+def test_continue_training_matches_straight_run(tmp_path):
+    X, y = make_binary_problem(n=2000)
+    ds = lgb.Dataset(X, label=y)
+
+    full = lgb.train(PARAMS, ds, num_boost_round=40)
+    loss_full = _logloss(full.predict(X), y)
+
+    half = lgb.train(PARAMS, lgb.Dataset(X, label=y), num_boost_round=20)
+    path = str(tmp_path / "half.txt")
+    half.save_model(path)
+
+    resumed = lgb.train(PARAMS, lgb.Dataset(X, label=y), num_boost_round=20,
+                        init_model=path)
+    assert resumed.num_trees() == 40
+    loss_resumed = _logloss(resumed.predict(X), y)
+
+    # train 20 + resume 20 ≈ train 40 (small drift from f32 score cache)
+    assert abs(loss_resumed - loss_full) < 0.02
+    loss_half = _logloss(half.predict(X), y)
+    assert loss_resumed < loss_half - 0.01   # resuming actually helped
+
+
+def test_continue_training_from_booster_object():
+    X, y = make_binary_problem(n=1500)
+    first = lgb.train(PARAMS, lgb.Dataset(X, label=y), num_boost_round=10)
+    second = lgb.train(PARAMS, lgb.Dataset(X, label=y), num_boost_round=10,
+                       init_model=first)
+    assert second.num_trees() == 20
+    assert _logloss(second.predict(X), y) < _logloss(first.predict(X), y)
+
+
+def test_continue_training_saved_model_contains_all_trees(tmp_path):
+    X, y = make_binary_problem(n=1500)
+    first = lgb.train(PARAMS, lgb.Dataset(X, label=y), num_boost_round=7)
+    resumed = lgb.train(PARAMS, lgb.Dataset(X, label=y), num_boost_round=5,
+                        init_model=first)
+    path = str(tmp_path / "resumed.txt")
+    resumed.save_model(path)
+    loaded = lgb.Booster(model_file=path)
+    assert loaded.num_trees() == 12
+    np.testing.assert_allclose(loaded.predict(X), resumed.predict(X),
+                               rtol=1e-6, atol=1e-7)
+
+
+def test_continue_training_with_valid_set():
+    X, y = make_binary_problem(n=2000)
+    Xv, yv = make_binary_problem(n=500, seed=9)
+    first = lgb.train(PARAMS, lgb.Dataset(X, label=y), num_boost_round=10)
+    res = {}
+    lgb.train(PARAMS, lgb.Dataset(X, label=y), num_boost_round=5,
+              init_model=first,
+              valid_sets=[lgb.Dataset(Xv, label=yv, reference=None)],
+              valid_names=["v"], evals_result=res, verbose_eval=False)
+    # valid metric at iteration 0 of the resumed run must already reflect
+    # the loaded trees (score cache resumed, not restarted)
+    first_val = res["v"]["binary_logloss"][0]
+    fresh_val = _logloss(0.5 * np.ones(len(yv)), yv)
+    assert first_val < fresh_val
+
+
+def test_refit_leaf_values():
+    X, y = make_binary_problem(n=2000)
+    bst = lgb.train(PARAMS, lgb.Dataset(X, label=y), num_boost_round=10)
+    X2, y2 = make_binary_problem(n=2000, seed=5)
+    refitted = bst.refit(X2, y2, decay_rate=0.5)
+    assert refitted.num_trees() == bst.num_trees()
+    # structures unchanged (leaf counts equal), outputs changed
+    p_old = bst.predict(X2)
+    p_new = refitted.predict(X2)
+    assert not np.allclose(p_old, p_new)
+    # refit toward the new data must not make its loss much worse
+    assert _logloss(p_new, y2) <= _logloss(p_old, y2) + 0.02

@@ -81,3 +81,78 @@ def test_padded_rows_dropped(rng):
     ref = hist_leaves_scatter(binned, g3, leaf_id, 3, 8)
     got = hist_leaves_onehot(binned, g3, leaf_id, 3, 8, precision="f32", row_chunk=256)
     np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=1e-4, atol=1e-4)
+
+
+# ---------------------------------------------------------------------------
+# Pallas kernel equality vs the scatter oracle (interpret mode on CPU; the
+# same tests run against real hardware when a TPU backend is present) —
+# the CompareHistograms analog for the Pallas path.
+# ---------------------------------------------------------------------------
+
+_PALLAS_INTERPRET = jax.default_backend() != "tpu"
+
+
+@pytest.mark.parametrize("precision", ["f32", "bf16x2", "bf16", "int8"])
+def test_pallas_matches_scatter(rng, precision):
+    from lightgbmv1_tpu.ops.hist_pallas import hist_leaves_pallas
+
+    N, F, B, L = 1777, 6, 32, 5   # non-divisible N exercises row padding
+    binned, g3, leaf_id = make_inputs(rng, N=N, F=F, B=B, L=L)
+    g3 = g3.at[:, 2].set(1.0)     # count channel carries the 0/1 row mask
+    ref = np.asarray(hist_leaves_scatter(binned, g3, leaf_id, L, B))
+    got = np.asarray(hist_leaves_pallas(
+        binned, g3, leaf_id, L, B, precision=precision,
+        interpret=_PALLAS_INTERPRET))
+    # counts are exact in every mode (int8 uses a power-of-two count scale)
+    np.testing.assert_array_equal(got[..., 2], ref[..., 2])
+    if precision == "f32":
+        np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-4)
+    elif precision == "bf16x2":
+        np.testing.assert_allclose(got, ref, rtol=1e-2, atol=1e-2)
+    else:  # single-pass bf16 / quantized int8: coarse but bounded
+        assert np.abs(got - ref).max() < 0.5
+        np.testing.assert_allclose(got.sum((0, 2)), ref.sum((0, 2)),
+                                   rtol=5e-2, atol=5e-1)
+
+
+def test_pallas_feature_padding_and_big_bins(rng):
+    """F not a multiple of the feature block and B=256 (max uint8 bins)."""
+    from lightgbmv1_tpu.ops.hist_pallas import hist_leaves_pallas
+
+    N, F, B, L = 513, 3, 256, 2
+    binned, g3, leaf_id = make_inputs(rng, N=N, F=F, B=B, L=L)
+    ref = np.asarray(hist_leaves_scatter(binned, g3, leaf_id, L, B))
+    got = np.asarray(hist_leaves_pallas(
+        binned, g3, leaf_id, L, B, precision="f32",
+        interpret=_PALLAS_INTERPRET))
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-4)
+
+
+def test_pallas_rejects_int16_bins(rng):
+    from lightgbmv1_tpu.ops.hist_pallas import hist_leaves_pallas
+
+    binned = jnp.zeros((2, 64), jnp.int16)
+    g3 = jnp.zeros((64, 3), jnp.float32)
+    leaf = jnp.zeros(64, jnp.int32)
+    with pytest.raises(ValueError, match="uint8"):
+        hist_leaves_pallas(binned, g3, leaf, 2, 300,
+                           interpret=_PALLAS_INTERPRET)
+
+
+def test_pallas_single_leaf_masks_rows(rng):
+    """hist_one_leaf through the pallas method (the leafwise smaller-child
+    pass) must equal the scatter slice."""
+    binned, g3, leaf_id = make_inputs(rng, N=700, F=4, B=16, L=3)
+    full = np.asarray(hist_leaves_scatter(binned, g3, leaf_id, 3, 16))
+    import lightgbmv1_tpu.ops.hist_pallas as hp
+    import functools
+    orig = hp.hist_leaves_pallas
+    patched = functools.partial(orig, interpret=_PALLAS_INTERPRET,
+                                precision="f32")
+    hp.hist_leaves_pallas = patched
+    try:
+        one = np.asarray(hist_one_leaf(binned, g3, leaf_id, jnp.asarray(2), 16,
+                                       method="pallas"))
+    finally:
+        hp.hist_leaves_pallas = orig
+    np.testing.assert_allclose(one, full[2], rtol=1e-4, atol=1e-4)

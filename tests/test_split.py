@@ -26,6 +26,7 @@ def make_meta(num_bins, missing=None):
         zero_bin=jnp.asarray([0] * F, jnp.int32),
         is_categorical=jnp.zeros(F, bool),
         usable=jnp.ones(F, bool),
+        monotone_type=jnp.zeros(F, jnp.int32),
     )
 
 
