@@ -1,0 +1,8 @@
+"""``python -m lightgbmv1_tpu config=train.conf`` — the reference CLI entry
+point (reference: src/main.cpp:11-42)."""
+
+import sys
+
+from .cli import main
+
+sys.exit(main())

@@ -559,22 +559,17 @@ class Booster:
         return new_booster
 
     def _predict_contrib(self, X, trees, K):
-        """SHAP-style feature contributions via per-tree path attribution
-        (reference: Tree::PredictContrib tree.h:138). Simplified: uses the
-        Saabas attribution (internal_value deltas along the decision path)."""
+        """Exact TreeSHAP feature contributions (reference:
+        Tree::PredictContrib tree.h:138, src/io/tree.cpp TreeSHAP); the
+        last column per class is the expected value (base)."""
+        from .models.treeshap import tree_shap
+
         n, F = X.shape
         out = np.zeros((n, K * (F + 1)), dtype=np.float64)
         for ti, t in enumerate(trees):
             k = ti % K
-            if t.num_leaves <= 1:
-                # constant tree (e.g. the embedded boost-from-average init):
-                # its value belongs in the base-value column
-                if t.num_leaves == 1:
-                    out[:, k * (F + 1) + F] += float(t.leaf_value[0])
-                continue
-            contribs = _tree_saabas_contrib(t, X)
-            out[:, k * (F + 1): k * (F + 1) + F] += contribs[:, :F]
-            out[:, k * (F + 1) + F] += contribs[:, F]
+            contribs = tree_shap(t, X)
+            out[:, k * (F + 1): k * (F + 1) + F + 1] += contribs
         return out[:, : F + 1] if K == 1 else out
 
     # ------------------------------------------------------------------
@@ -682,42 +677,3 @@ class Booster:
 
     def free_network(self) -> "Booster":
         return self
-
-
-def _tree_saabas_contrib(tree: HostTree, X: np.ndarray) -> np.ndarray:
-    """Per-feature contribution by walking the path and attributing value
-    deltas to split features; column F holds the root expected value."""
-    n, F = X.shape
-    out = np.zeros((n, F + 1))
-    from .io.binning import K_ZERO_THRESHOLD, MISSING_NAN, MISSING_ZERO
-
-    node = np.zeros(n, dtype=np.int64)
-    active = np.ones(n, dtype=bool)
-    cur_val = np.full(n, tree.internal_value[0] if tree.num_leaves > 1 else 0.0)
-    out[:, F] = cur_val
-    while active.any():
-        nd = node[active]
-        f = tree.split_feature[nd]
-        v = X[active, f]
-        t_ = tree.threshold[nd]
-        dl = tree.default_left[nd]
-        mt = tree.missing_type[nd]
-        isnan = np.isnan(v)
-        v0 = np.where(isnan, 0.0, v)
-        miss = np.where(mt == MISSING_NAN, isnan,
-                        np.where(mt == MISSING_ZERO,
-                                 isnan | (np.abs(v0) <= K_ZERO_THRESHOLD), False))
-        go_left = np.where(miss, dl, v0 <= t_)
-        nxt = np.where(go_left, tree.left_child[nd], tree.right_child[nd])
-        nxt_val = np.where(
-            nxt < 0, tree.leaf_value[np.minimum(-nxt - 1, tree.num_leaves - 1)],
-            tree.internal_value[np.maximum(nxt, 0)]
-        )
-        idx = np.flatnonzero(active)
-        delta = nxt_val - cur_val[idx]
-        out[idx, f] += delta
-        cur_val[idx] = nxt_val
-        node[idx] = nxt
-        done = nxt < 0
-        active[idx[done]] = False
-    return out

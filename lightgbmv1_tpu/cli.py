@@ -1,0 +1,195 @@
+"""Command-line application.
+
+TPU-native equivalent of the reference CLI
+(reference: ``src/main.cpp:11-42`` → ``src/application/application.cpp`` —
+parameter loading :49-82, LoadData :84-162, InitTrain :164-199, Train :201,
+Predict :213 → ``src/application/predictor.hpp:29-160``; model conversion
+``ModelToIfElse``, src/boosting/gbdt_model_text.cpp:122-304).
+
+Usage matches the reference:
+
+    python -m lightgbmv1_tpu config=train.conf [key=value ...]
+
+Tasks: ``train`` (default), ``predict`` / ``prediction``, ``refit``,
+``convert_model``.  The reference's example configs
+(``/root/reference/examples/*/train.conf``) run unmodified.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import sys
+import time
+from typing import List, Optional
+
+import numpy as np
+
+from .basic import Booster, Dataset
+from .config import Config
+from .io.parser import load_data_file
+from .utils.log import log_fatal, log_info, log_warning
+
+
+def _config_to_params(config: Config) -> dict:
+    """Round-trip a Config into the params-dict form the Booster takes."""
+    return dataclasses.asdict(config)
+
+
+def _load_dataset(config: Config, path: str,
+                  reference: Optional[Dataset] = None) -> Dataset:
+    df = load_data_file(
+        path,
+        has_header=config.header,
+        label_column=config.label_column,
+        weight_column=config.weight_column,
+        group_column=config.group_column,
+        ignore_column=config.ignore_column,
+    )
+    cat = "auto"
+    if config.categorical_feature:
+        cat = [int(x) for x in
+               str(config.categorical_feature).replace(",", " ").split()]
+    return Dataset(
+        df.X, label=df.label, weight=df.weight, group=df.group,
+        params=_config_to_params(config), reference=reference,
+        feature_name=df.feature_names or "auto",
+        categorical_feature=cat,
+    )
+
+
+def run_train(config: Config) -> Booster:
+    """reference: Application::InitTrain + Train, application.cpp:164-211."""
+    if not config.data:
+        log_fatal("No training data: set data=<file>")
+    t0 = time.time()
+    train_set = _load_dataset(config, config.data)
+    booster = Booster(params=_config_to_params(config), train_set=train_set,
+                      init_model=config.input_model or None)
+    valid_names: List[str] = []
+    for i, vpath in enumerate(config.valid):
+        name = os.path.basename(vpath)
+        booster.add_valid(_load_dataset(config, vpath, reference=train_set),
+                          name)
+        valid_names.append(name)
+    log_info(f"Finished loading data in {time.time() - t0:.6f} seconds")
+
+    n_iter = config.num_iterations
+    t0 = time.time()
+    for i in range(n_iter):
+        finished = booster.update()
+        if config.metric_freq > 0 and (i + 1) % config.metric_freq == 0:
+            for data_name, metric, value, _ in booster.eval_train():
+                log_info(f"Iteration:{i + 1}, {data_name} {metric} : {value:g}")
+            for data_name, metric, value, _ in booster.eval_valid():
+                log_info(f"Iteration:{i + 1}, {data_name} {metric} : {value:g}")
+        log_info(f"{time.time() - t0:.6f} seconds elapsed, "
+                 f"finished iteration {i + 1}")
+        # snapshots (reference: GBDT::Train, gbdt.cpp:258-262)
+        if config.snapshot_freq > 0 and (i + 1) % config.snapshot_freq == 0:
+            snap = f"{config.output_model}.snapshot_iter_{i + 1}"
+            booster.save_model(snap)
+            log_info(f"Saved snapshot to {snap}")
+        if finished:
+            break
+    if config.output_model:
+        booster.save_model(config.output_model)
+    log_info("Finished training")
+    return booster
+
+
+def run_predict(config: Config) -> None:
+    """reference: Application::Predict → Predictor, predictor.hpp:29-160."""
+    if not config.input_model:
+        log_fatal("No model file: set input_model=<file>")
+    if not config.data:
+        log_fatal("No prediction data: set data=<file>")
+    booster = Booster(model_file=config.input_model)
+    log_info("Finished initializing prediction, total used "
+             f"{booster.current_iteration()} iterations")
+    # honor the same loader options as training (header/label/ignore cols)
+    df = load_data_file(
+        config.data,
+        has_header=config.header,
+        label_column=config.label_column,
+        weight_column=config.weight_column,
+        group_column=config.group_column,
+        ignore_column=config.ignore_column,
+        is_predict=True,
+    )
+    X = df.X
+    if X.shape[1] == booster.num_feature() + 1:
+        X = X[:, 1:]   # prediction files may still carry the label column
+    out = booster.predict(
+        X,
+        raw_score=config.predict_raw_score,
+        pred_leaf=config.predict_leaf_index,
+        pred_contrib=config.predict_contrib,
+        num_iteration=(config.num_iteration_predict
+                       if config.num_iteration_predict > 0 else None),
+    )
+    out = np.asarray(out)
+    if out.ndim == 1:
+        out = out[:, None]
+    fmt = "%d" if config.predict_leaf_index else "%.18g"
+    np.savetxt(config.output_result, out, fmt=fmt, delimiter="\t")
+    log_info("Finished prediction")
+
+
+def run_refit(config: Config) -> None:
+    """reference: Application::Run task=refit (application.h) —
+    re-estimate the leaf values of input_model on new data."""
+    if not config.input_model:
+        log_fatal("No model file: set input_model=<file>")
+    booster = Booster(model_file=config.input_model)
+    df = load_data_file(config.data, has_header=config.header,
+                        label_column=config.label_column)
+    refitted = booster.refit(df.X, df.label,
+                             decay_rate=config.refit_decay_rate)
+    refitted.save_model(config.output_model)
+    log_info(f"Finished refit; model saved to {config.output_model}")
+
+
+def run_convert_model(config: Config) -> None:
+    """reference: GBDT::SaveModelToIfElse, gbdt_model_text.cpp:122-304 —
+    compile the model into standalone C++ if-else code."""
+    from .io.model_codegen import model_to_cpp
+
+    if not config.input_model:
+        log_fatal("No model file: set input_model=<file>")
+    booster = Booster(model_file=config.input_model)
+    code = model_to_cpp(booster._loaded)
+    out = config.convert_model or "gbdt_prediction.cpp"
+    with open(out, "w") as fh:
+        fh.write(code)
+    log_info(f"Converted model to C++ code at {out}")
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if not argv:
+        print(__doc__)
+        return 1
+    config = Config.from_cli(argv)
+    if config.num_machines > 1 or config.machines:
+        log_warning(
+            "machines/num_machines: multi-host training is driven through "
+            "jax.distributed (parallel/cluster.py), not the CLI socket "
+            "options; running single-process with tree_learner="
+            f"{config.tree_learner or 'serial'}")
+    task = config.task
+    if task == "train":
+        run_train(config)
+    elif task in ("predict", "prediction", "test"):
+        run_predict(config)
+    elif task == "refit":
+        run_refit(config)
+    elif task == "convert_model":
+        run_convert_model(config)
+    else:
+        log_fatal(f"Unknown task: {task}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -351,9 +351,10 @@ class Config:
     # -- network ------------------------------------------------------------
     num_machines: int = 1
     local_listen_port: int = 12400
+    machines: str = ""            # host:port list (reference socket linker);
+                                  # multi-host here goes via jax.distributed
     time_out: int = 120
     machine_list_filename: str = ""
-    machines: str = ""
 
     # ------------------------------------------------------------------
     def __post_init__(self):

@@ -46,15 +46,20 @@ MAX_LANES = 2048          # lanes per one-hot block: FBLK * num_bins
 _COUNT_SCALE = 64.0       # power-of-two count quantizer => exact counts
 
 
-def _row_tile_for(m_pad: int, num_lanes: int) -> int:
+def _row_tile_for(m_pad: int, num_lanes: int, num_bins: int) -> int:
     """Row-tile size keeping the VMEM working set (chunked one-hot + repeat
-    buffer + lg rows + out accumulator) within budget as leaves grow."""
+    buffer + lg rows + out accumulator) within Mosaic's ~16MB scoped-vmem
+    budget.  The estimate is deliberately conservative: per-chunk f32
+    temporaries (repeat buffer, compare, select, cast) can coexist, and
+    narrow feature blocks pay lane-padding amplification (observed OOM at
+    B=256 with 3 features and T=1024)."""
     out_bytes = m_pad * num_lanes * 4
-    per_row = 512 * 6 + m_pad * 12
-    for t in (1024, 512, 256):
-        if out_bytes + t * per_row <= 10 * 2**20:
+    per_row = 14 * min(num_lanes, 512) + 16 * m_pad
+    t0 = 1024 if num_bins <= 64 else 512
+    for t in (1024, 512, 256, 128):
+        if t <= t0 and out_bytes + t * per_row <= 8 * 2**20:
             return t
-    return 256
+    return 128
 
 
 def _kernel(iota_ref, bins_ref, g3_ref, leaf_ref, out_ref, *, lpad, num_bins,
@@ -174,7 +179,7 @@ def hist_leaves_pallas(
     f_pad = nfb * fblk
     lpad = -(-L // 8) * 8
     m_pad = 3 * lpad
-    T = row_tile if row_tile > 0 else _row_tile_for(m_pad, fblk * B)
+    T = row_tile if row_tile > 0 else _row_tile_for(m_pad, fblk * B, B)
     nrt = -(-N // T)
     n_pad = nrt * T
 
@@ -194,19 +199,29 @@ def hist_leaves_pallas(
         _kernel, lpad=lpad, num_bins=B, fblk=fblk, precision=precision,
         interpret=interpret,
     )
-    out = pl.pallas_call(
-        kernel,
-        grid=(nfb, nrt),
-        in_specs=[
-            pl.BlockSpec((1, fblk * B), lambda fb, rt: (0, 0)),
-            pl.BlockSpec((T, fblk), lambda fb, rt: (rt, fb)),
-            pl.BlockSpec((3, T), lambda fb, rt: (0, rt)),
-            pl.BlockSpec((1, T), lambda fb, rt: (0, rt)),
-        ],
-        out_specs=pl.BlockSpec((1, m_pad, fblk * B), lambda fb, rt: (fb, 0, 0)),
-        out_shape=jax.ShapeDtypeStruct((nfb, m_pad, fblk * B), jnp.float32),
-        interpret=interpret,
-    )(iota_bins, binned_rm, g3t, leaf_p)
+
+    def one_block(bins_block):
+        # Mosaic requires the bins block's lane dim to equal the array dim
+        # (or be 128-divisible), so each feature block is its own call; the
+        # row-tile grid dimension does the accumulation.
+        return pl.pallas_call(
+            kernel,
+            grid=(1, nrt),
+            in_specs=[
+                pl.BlockSpec((1, fblk * B), lambda fb, rt: (0, 0)),
+                pl.BlockSpec((T, fblk), lambda fb, rt: (rt, 0)),
+                pl.BlockSpec((3, T), lambda fb, rt: (0, rt)),
+                pl.BlockSpec((1, T), lambda fb, rt: (0, rt)),
+            ],
+            out_specs=pl.BlockSpec((1, m_pad, fblk * B),
+                                   lambda fb, rt: (0, 0, 0)),
+            out_shape=jax.ShapeDtypeStruct((1, m_pad, fblk * B), jnp.float32),
+            interpret=interpret,
+        )(iota_bins, bins_block, g3t, leaf_p)
+
+    blocks = [one_block(binned_rm[:, fb * fblk:(fb + 1) * fblk])
+              for fb in range(nfb)]
+    out = jnp.concatenate(blocks, axis=0) if nfb > 1 else blocks[0]
 
     # (nfb, 3*Lpad, B*fblk) -> (L, F, B, 3)
     h = out.reshape(nfb, lpad, 3, B, fblk)

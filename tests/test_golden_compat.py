@@ -39,6 +39,20 @@ def test_load_reference_model_and_match_predictions():
     np.testing.assert_allclose(pred, ref_pred, rtol=1e-6, atol=1e-7)
 
 
+def test_tree_shap_matches_reference_contribs():
+    """Exact TreeSHAP parity: predict_contrib output of the reference CLI
+    (predict_contrib=true on the golden model) vs ours — including the
+    categorical bitset nodes.  reference: Tree::PredictContrib tree.h:138."""
+    X, y = load_golden()
+    ref = np.loadtxt(os.path.join(DATA_DIR, "golden_ref_contrib.txt"))
+    bst = lgb.Booster(model_file=os.path.join(DATA_DIR, "golden_ref_model.txt"))
+    ours = bst.predict(X, pred_contrib=True)
+    np.testing.assert_allclose(ours, ref, rtol=1e-9, atol=1e-12)
+    # SHAP invariant: contributions + base sum to the raw prediction
+    raw = bst.predict(X, raw_score=True)
+    np.testing.assert_allclose(ours.sum(axis=1), raw, rtol=1e-9, atol=1e-12)
+
+
 def test_reference_model_metadata():
     bst = lgb.Booster(model_file=os.path.join(DATA_DIR, "golden_ref_model.txt"))
     assert bst.num_trees() == 5
