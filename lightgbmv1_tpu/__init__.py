@@ -34,6 +34,11 @@ __all__ = [
     "log_evaluation",
     "record_evaluation",
     "reset_parameter",
+    "plot_importance",
+    "plot_metric",
+    "plot_split_value_histogram",
+    "plot_tree",
+    "create_tree_digraph",
 ]
 
 
@@ -56,4 +61,9 @@ def __getattr__(name):
         from . import callback
 
         return getattr(callback, name)
+    if name in ("plot_importance", "plot_metric", "plot_split_value_histogram",
+                "plot_tree", "create_tree_digraph"):
+        from . import plotting
+
+        return getattr(plotting, name)
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

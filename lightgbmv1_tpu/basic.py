@@ -85,7 +85,19 @@ class Dataset:
         self.feature_name = feature_name
         self.categorical_feature = categorical_feature
 
-        if isinstance(data, (str, os.PathLike)):
+        if isinstance(data, (str, os.PathLike)) and \
+                BinnedDataset.is_binary_file(str(data)):
+            # binary dataset cache (reference LoadFromBinFile,
+            # dataset_loader.cpp:273): skips parsing and binning entirely
+            self._binned = BinnedDataset.load_binary(str(data))
+            self.data = None
+            meta = self._binned.metadata
+            label = meta.label if label is None else label
+            weight = meta.weight if weight is None else weight
+            group = meta.group if group is None else group
+            init_score = meta.init_score if init_score is None else init_score
+            self.feature_name = list(self._binned.feature_names)
+        elif isinstance(data, (str, os.PathLike)):
             cfg = Config.from_dict(self.params)
             df = load_data_file(
                 str(data),
@@ -109,6 +121,16 @@ class Dataset:
         self.weight = None if weight is None else np.asarray(weight, dtype=np.float64).ravel()
         self.group = None if group is None else np.asarray(group, dtype=np.int64).ravel()
         self.init_score = None if init_score is None else np.asarray(init_score, dtype=np.float64)
+        if self._binned is not None:
+            # binary-cache path: explicit fields override the cached metadata
+            if label is not None:
+                self.set_label(self.label)
+            if weight is not None:
+                self.set_weight(self.weight)
+            if group is not None:
+                self.set_group(self.group)
+            if init_score is not None:
+                self.set_init_score(self.init_score)
 
     # ------------------------------------------------------------------
     def construct(self) -> "Dataset":
@@ -147,6 +169,14 @@ class Dataset:
         if self.data is not None:
             return [f"Column_{i}" for i in range(self.data.shape[1])]
         return None
+
+    # ------------------------------------------------------------------
+    def save_binary(self, filename: str) -> "Dataset":
+        """Save the binned dataset cache (reference basic.py save_binary →
+        Dataset::SaveBinaryFile)."""
+        self.construct()
+        self._binned.save_binary(str(filename))
+        return self
 
     # ------------------------------------------------------------------
     def create_valid(self, data, label=None, weight=None, group=None,
@@ -474,8 +504,37 @@ class Booster:
 
         n = X.shape[0]
         raw = np.zeros((n, K), dtype=np.float64)
-        for i, t in enumerate(trees):
-            raw[:, i % K] += t.predict(X)
+        es = bool(kwargs.get("pred_early_stop",
+                             self.params.get("pred_early_stop", False)))
+        es_freq = int(kwargs.get("pred_early_stop_freq",
+                                 self.params.get("pred_early_stop_freq", 10)))
+        es_margin = float(kwargs.get(
+            "pred_early_stop_margin",
+            self.params.get("pred_early_stop_margin", 10.0)))
+        if es and not raw_score:
+            # reference: PredictionEarlyStopInstance
+            # (src/boosting/prediction_early_stop.cpp:75) — every freq trees,
+            # rows whose decision margin exceeds the threshold stop
+            # accumulating further trees
+            active = np.ones(n, dtype=bool)
+            n_iters = len(trees) // K if K else 0
+            for it in range(n_iters):
+                idx = np.flatnonzero(active)
+                if idx.size == 0:
+                    break
+                for k in range(K):
+                    t = trees[it * K + k]
+                    raw[idx, k] += t.predict(X[idx])
+                if (it + 1) % es_freq == 0:
+                    if K == 1:
+                        margin = 2.0 * np.abs(raw[idx, 0])
+                    else:
+                        part = np.partition(raw[idx], K - 2, axis=1)
+                        margin = part[:, K - 1] - part[:, K - 2]
+                    active[idx[margin >= es_margin]] = False
+        else:
+            for i, t in enumerate(trees):
+                raw[:, i % K] += t.predict(X)
         # the boost-from-average constant lives inside tree leaf values
         # (AddBias, reference gbdt.cpp:381-383), so no base term is added
         from .models.gbdt import RF

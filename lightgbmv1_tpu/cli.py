@@ -29,6 +29,7 @@ from .basic import Booster, Dataset
 from .config import Config
 from .io.parser import load_data_file
 from .utils.log import log_fatal, log_info, log_warning
+from .utils.timer import global_timer
 
 
 def _config_to_params(config: Config) -> dict:
@@ -38,6 +39,11 @@ def _config_to_params(config: Config) -> dict:
 
 def _load_dataset(config: Config, path: str,
                   reference: Optional[Dataset] = None) -> Dataset:
+    from .io.dataset import BinnedDataset
+
+    if BinnedDataset.is_binary_file(path):
+        return Dataset(path, params=_config_to_params(config),
+                       reference=reference)
     df = load_data_file(
         path,
         has_header=config.header,
@@ -64,6 +70,9 @@ def run_train(config: Config) -> Booster:
         log_fatal("No training data: set data=<file>")
     t0 = time.time()
     train_set = _load_dataset(config, config.data)
+    if config.save_binary:
+        # reference: is_save_binary_file → SaveBinaryFile(data + ".bin")
+        train_set.save_binary(config.data + ".bin")
     booster = Booster(params=_config_to_params(config), train_set=train_set,
                       init_model=config.input_model or None)
     valid_names: List[str] = []
@@ -127,6 +136,9 @@ def run_predict(config: Config) -> None:
         pred_contrib=config.predict_contrib,
         num_iteration=(config.num_iteration_predict
                        if config.num_iteration_predict > 0 else None),
+        pred_early_stop=config.pred_early_stop,
+        pred_early_stop_freq=config.pred_early_stop_freq,
+        pred_early_stop_margin=config.pred_early_stop_margin,
     )
     out = np.asarray(out)
     if out.ndim == 1:
@@ -171,12 +183,15 @@ def main(argv: Optional[List[str]] = None) -> int:
         print(__doc__)
         return 1
     config = Config.from_cli(argv)
+    # phase timing (reference: USE_TIMETAG global_timer, common.h:1054-1138;
+    # scopes live in gbdt.py/cli.py; report printed at exit)
+    global_timer.enabled = config.verbosity >= 1
     if config.num_machines > 1 or config.machines:
-        log_warning(
-            "machines/num_machines: multi-host training is driven through "
-            "jax.distributed (parallel/cluster.py), not the CLI socket "
-            "options; running single-process with tree_learner="
-            f"{config.tree_learner or 'serial'}")
+        # reference: Application::InitTrain -> Network::Init
+        # (application.cpp:167); here the cluster bring-up is jax.distributed
+        from .parallel.cluster import init_cluster
+
+        init_cluster(config)
     task = config.task
     if task == "train":
         run_train(config)
@@ -188,6 +203,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         run_convert_model(config)
     else:
         log_fatal(f"Unknown task: {task}")
+    if global_timer.enabled and global_timer.totals:
+        log_info(global_timer.report())
     return 0
 
 

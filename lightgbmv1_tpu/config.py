@@ -283,6 +283,8 @@ class Config:
     cegb_penalty_feature_lazy: List[float] = field(default_factory=list)
     cegb_penalty_feature_coupled: List[float] = field(default_factory=list)
     path_smooth: float = 0.0
+    interaction_constraints: str = ""   # e.g. "[0,1,2],[2,3]" (reference
+                                        # config.h:517)
     verbosity: int = 1
 
     # -- TPU-specific (new; no reference equivalent) ------------------------

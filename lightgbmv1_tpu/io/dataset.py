@@ -198,6 +198,102 @@ class BinnedDataset:
         return ds
 
     # ------------------------------------------------------------------
+    # Binary dataset cache (reference: Dataset::SaveBinaryFile dataset.h:473,
+    # DatasetLoader::LoadFromBinFile dataset_loader.cpp:273) — skips
+    # re-parsing and re-binning on subsequent runs.  Serialized with numpy's
+    # npz container; the bin mappers ride as flat arrays via
+    # BinMapper.to_arrays/from_arrays (also the wire format a distributed
+    # bin-finding allgather would exchange, dataset_loader.cpp:913-996).
+    # ------------------------------------------------------------------
+    BINARY_MAGIC = "lightgbmv1_tpu.dataset.v1"
+
+    def save_binary(self, path: str) -> None:
+        ubounds = [np.asarray(m.bin_upper_bound, np.float64)
+                   for m in self.bin_mappers]
+        cats = [np.asarray(m.bin_2_categorical, np.int64)
+                for m in self.bin_mappers]
+        scalars = np.array(
+            [[m.num_bin, m.missing_type, m.bin_type, int(m.is_trivial)]
+             for m in self.bin_mappers], dtype=np.int64)
+        floats = np.array(
+            [[m.sparse_rate, m.min_value, m.max_value]
+             for m in self.bin_mappers], dtype=np.float64)
+        meta = self.metadata
+        fh = open(path, "wb")   # keep the exact filename (savez appends .npz
+                                # to bare string paths)
+        np.savez_compressed(
+            fh,
+            magic=np.frombuffer(self.BINARY_MAGIC.encode(), dtype=np.uint8),
+            binned=self.binned,
+            max_bin=np.int64(self.max_bin),
+            feature_names=np.array(self.feature_names),
+            mapper_scalars=scalars,
+            mapper_floats=floats,
+            ubound_flat=np.concatenate(ubounds) if ubounds else np.zeros(0),
+            ubound_offsets=np.cumsum([0] + [len(u) for u in ubounds]),
+            cat_flat=np.concatenate(cats) if cats else np.zeros(0, np.int64),
+            cat_offsets=np.cumsum([0] + [len(c) for c in cats]),
+            label=meta.label if meta.label is not None else np.zeros(0),
+            weight=meta.weight if meta.weight is not None else np.zeros(0),
+            group=meta.group if meta.group is not None else np.zeros(0, np.int64),
+            init_score=(meta.init_score if meta.init_score is not None
+                        else np.zeros(0)),
+        )
+        fh.close()
+        log_info(f"Saved binary dataset cache to {path}")
+
+    @classmethod
+    def is_binary_file(cls, path: str) -> bool:
+        import zipfile
+
+        if not zipfile.is_zipfile(path):
+            return False
+        try:
+            with np.load(path, allow_pickle=False) as z:
+                return ("magic" in z and
+                        bytes(z["magic"]).decode() == cls.BINARY_MAGIC)
+        except Exception:
+            return False
+
+    @classmethod
+    def load_binary(cls, path: str) -> "BinnedDataset":
+        with np.load(path, allow_pickle=False) as z:
+            if bytes(z["magic"]).decode() != cls.BINARY_MAGIC:
+                log_fatal(f"{path} is not a lightgbmv1_tpu binary dataset")
+            scalars = z["mapper_scalars"]
+            floats = z["mapper_floats"]
+            uoff = z["ubound_offsets"]
+            coff = z["cat_offsets"]
+            mappers = []
+            for j in range(scalars.shape[0]):
+                mappers.append(BinMapper.from_arrays({
+                    "bin_upper_bound": z["ubound_flat"][uoff[j]:uoff[j + 1]],
+                    "num_bin": scalars[j, 0],
+                    "missing_type": scalars[j, 1],
+                    "bin_type": scalars[j, 2],
+                    "is_trivial": scalars[j, 3],
+                    "sparse_rate": floats[j, 0],
+                    "min_value": floats[j, 1],
+                    "max_value": floats[j, 2],
+                    "bin_2_categorical": z["cat_flat"][coff[j]:coff[j + 1]],
+                }))
+            meta = Metadata()
+            if z["label"].size:
+                meta.label = z["label"].astype(np.float32)
+            if z["weight"].size:
+                meta.weight = z["weight"].astype(np.float32)
+            if z["group"].size:
+                meta.set_group(z["group"])
+            if z["init_score"].size:
+                meta.init_score = z["init_score"]
+            ds = cls(z["binned"], mappers, meta,
+                     feature_names=[str(s) for s in z["feature_names"]],
+                     max_bin=int(z["max_bin"]))
+        log_info(f"Loaded binary dataset cache from {path}: "
+                 f"{ds.num_data} rows, {ds.num_features} features")
+        return ds
+
+    # ------------------------------------------------------------------
     def bin_raw_features(self, X: np.ndarray) -> np.ndarray:
         """Bin new raw data with this dataset's mappers → (F, N) bins."""
         X = np.asarray(X)

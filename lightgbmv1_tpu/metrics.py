@@ -196,6 +196,67 @@ class AUCMetric(Metric):
         return [(self.name, auc, True)]
 
 
+class AucMuMetric(Metric):
+    """Multiclass AUC-mu (Kleiman & Page 2019).
+
+    reference: AucMuMetric, src/metric/multiclass_metric.hpp:183-314 —
+    pairwise class separation measured along the hyperplane normal
+    ``v = w_i - w_j`` with the partition-loss weight matrix (default:
+    uniform off-diagonal).  The reference evaluates on raw scores; this
+    implementation uses log-probabilities, which is identical whenever the
+    pair's weight vector sums to zero (always true for the default uniform
+    matrix, since per-row softmax offsets cancel).
+    """
+
+    name = "auc_mu"
+    higher_better = True
+    _EPS = 1e-15
+
+    def eval(self, pred):
+        K = self.config.num_class
+        y = self.label.astype(np.int64)
+        scores = np.log(np.clip(np.asarray(pred, np.float64).reshape(-1, K),
+                                1e-300, None))
+        W = self.config.auc_mu_weights
+        if W:
+            cw = np.asarray(W, np.float64).reshape(K, K)
+            np.fill_diagonal(cw, 0.0)
+        else:
+            cw = np.ones((K, K)) - np.eye(K)
+        total = 0.0
+        for i in range(K):
+            for j in range(i + 1, K):
+                mask = (y == i) | (y == j)
+                if not mask.any():
+                    continue
+                yi = y[mask]
+                ni, nj = int((yi == i).sum()), int((yi == j).sum())
+                if ni == 0 or nj == 0:
+                    continue
+                v = cw[i] - cw[j]
+                t1 = v[i] - v[j]
+                dist = t1 * (scores[mask] @ v)
+                # vectorized ranking with half-credit ties (the AUCMetric
+                # tie-group technique): S = sum over class-i samples of
+                # (#j below) + 0.5*(#j tied)
+                pos = yi == i
+                order = np.argsort(dist, kind="mergesort")
+                d_s, p_s = dist[order], pos[order]
+                new_group = np.empty(len(d_s), dtype=bool)
+                new_group[0] = True
+                new_group[1:] = d_s[1:] != d_s[:-1]
+                gid = np.cumsum(new_group) - 1
+                ng = gid[-1] + 1
+                g_neg = np.bincount(gid, weights=(~p_s).astype(np.float64),
+                                    minlength=ng)
+                neg_before = np.concatenate([[0.0], np.cumsum(g_neg)])[:-1]
+                credit = neg_before[gid] + 0.5 * g_neg[gid]
+                s = float(credit[p_s].sum())
+                total += (s / ni) / nj
+        ans = (2.0 * total / K) / max(K - 1, 1)
+        return [(self.name, float(ans), True)]
+
+
 class MultiLoglossMetric(Metric):
     name = "multi_logloss"
 
@@ -316,6 +377,7 @@ _METRICS = {
     "softmax": MultiLoglossMetric,
     "multiclassova": MultiLoglossMetric,
     "multi_error": MultiErrorMetric,
+    "auc_mu": AucMuMetric,
     "cross_entropy": CrossEntropyMetric,
     "xentropy": CrossEntropyMetric,
     "ndcg": NDCGMetric,

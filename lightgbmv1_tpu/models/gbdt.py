@@ -105,6 +105,8 @@ class GBDT:
             max_cat_threshold=int(config.max_cat_threshold),
             max_cat_to_onehot=int(config.max_cat_to_onehot),
             min_data_per_group=float(config.min_data_per_group),
+            path_smooth=float(config.path_smooth),
+            extra_trees=bool(config.extra_trees),
         )
 
         self._build_trainer()
@@ -169,6 +171,7 @@ class GBDT:
             self.meta,
             self.split_params,
             self.num_bins,
+            bin_mappers=self.train_set.bin_mappers,
         )
         self._step = None  # fused per-iteration step, built lazily
 
@@ -213,9 +216,12 @@ class GBDT:
         cfg = self.config
         K = self.num_class
         rate = cfg.learning_rate if not isinstance(self, RF) else 1.0
-        valid_binned = list(self._valid_binned)
 
-        def step(train_score, valid_scores, iteration, feat_masks):
+        def step(binned, valid_binned, train_score, valid_scores, iteration,
+                 feat_masks):
+            # binned/valid_binned ride as arguments (NOT closure constants):
+            # closed-over process-spanning global arrays cannot be baked into
+            # the jaxpr on multi-host meshes
             s = train_score[:, 0] if K == 1 else train_score
             grad, hess = self._objective_grads(s)
             if grad.ndim == 1:
@@ -227,7 +233,7 @@ class GBDT:
                 g3 = self._sample_g3(grad[:, k], hess[:, k], bag, iteration)
                 key = jax.random.fold_in(self._rng_key, iteration * K + k)
                 tree_dev, leaf_id, _ = self._grow(
-                    self._grow_binned, g3, feat_masks[k], key
+                    binned, g3, feat_masks[k], key
                 )
                 shrunk = tree_dev._replace(leaf_value=tree_dev.leaf_value * rate)
                 train_score = train_score.at[:, k].add(shrunk.leaf_value[leaf_id])
@@ -267,10 +273,12 @@ class GBDT:
         if getattr(self, "_scan", None) is None:
             step_fn = self._step_fn
 
-            def scan_fn(train_score, valid_scores, start_iter, feat_masks_all):
+            def scan_fn(binned, valid_binned, train_score, valid_scores,
+                        start_iter, feat_masks_all):
                 def body(carry, fm):
                     ts, vs, it = carry
-                    ts, vs, stacked, _ = step_fn(ts, vs, it, fm)
+                    ts, vs, stacked, _ = step_fn(binned, valid_binned,
+                                                 ts, vs, it, fm)
                     return (ts, vs, it + 1), stacked
 
                 (ts, vs, _), trees = jax.lax.scan(
@@ -287,10 +295,12 @@ class GBDT:
         ]))
         vscores = tuple(vs.score for vs in self._valid_scores)
         self._save_rollback_state()
-        new_train, new_valid, trees = self._scan(
-            self._train_scores.score, vscores,
-            jnp.asarray(self.iter, jnp.int32), feat_masks,
-        )
+        with global_timer.section("GBDT::TrainIters(dispatch)"):
+            new_train, new_valid, trees = self._scan(
+                self._grow_binned, tuple(self._valid_binned),
+                self._train_scores.score, vscores,
+                jnp.asarray(self.iter, jnp.int32), feat_masks,
+            )
         self._train_scores.score = new_train
         for vs, s in zip(self._valid_scores, new_valid):
             vs.score = s
@@ -313,10 +323,12 @@ class GBDT:
             np.stack([self._tree_feature_mask() for _ in range(self.num_class)])
         )
         vscores = tuple(vs.score for vs in self._valid_scores)
-        new_train, new_valid, stacked, leaf_ids = self._step(
-            self._train_scores.score, vscores,
-            jnp.asarray(self.iter, jnp.int32), feat_masks,
-        )
+        with global_timer.section("GBDT::TrainOneIter(dispatch)"):
+            new_train, new_valid, stacked, leaf_ids = self._step(
+                self._grow_binned, tuple(self._valid_binned),
+                self._train_scores.score, vscores,
+                jnp.asarray(self.iter, jnp.int32), feat_masks,
+            )
         self._train_scores.score = new_train
         for vs, s in zip(self._valid_scores, new_valid):
             vs.score = s
@@ -518,7 +530,8 @@ class GBDT:
         """Fetch all not-yet-materialized trees in one batched transfer."""
         idxs = [i for i, m in enumerate(self.models) if m is None]
         if idxs:
-            fetched = jax.device_get([self._device_trees[i] for i in idxs])
+            with global_timer.section("GBDT::MaterializeHostTrees"):
+                fetched = jax.device_get([self._device_trees[i] for i in idxs])
             for i, arrays in zip(idxs, fetched):
                 ht = HostTree(arrays)
                 # device leaf values already include shrinkage
@@ -603,6 +616,10 @@ class GBDT:
         return np.asarray(s, dtype=np.float64)
 
     def eval_train(self):
+        with global_timer.section("GBDT::EvalTrain"):
+            return self._eval_train_inner()
+
+    def _eval_train_inner(self):
         pred = self._converted_pred(self._train_scores, self.objective)
         out = []
         for m in self.train_metrics:
@@ -611,6 +628,10 @@ class GBDT:
         return out
 
     def eval_valid(self):
+        with global_timer.section("GBDT::EvalValid"):
+            return self._eval_valid_inner()
+
+    def _eval_valid_inner(self):
         out = []
         for vname, vs, metrics in zip(
             self._valid_names, self._valid_scores, self._valid_metrics
@@ -623,7 +644,14 @@ class GBDT:
 
     # ------------------------------------------------------------------
     def raw_train_scores(self) -> np.ndarray:
-        return np.asarray(self._train_scores.score, dtype=np.float64)
+        score = self._train_scores.score
+        if jax.process_count() > 1:
+            # row-sharded across processes (data-parallel leaf_id output):
+            # gather the full array onto every host before fetching
+            from jax.experimental import multihost_utils
+
+            score = multihost_utils.process_allgather(score, tiled=True)
+        return np.asarray(score, dtype=np.float64)
 
     def num_trees(self) -> int:
         return len(self.models)

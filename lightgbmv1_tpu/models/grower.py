@@ -44,6 +44,7 @@ from ..ops.split import (
     SplitParams,
     find_best_split,
     leaf_output,
+    smooth_output,
 )
 from .tree import TreeArrays
 
@@ -63,6 +64,9 @@ class GrowerState(NamedTuple):
     best_bitset: jax.Array    # (L, W) uint32
     leaf_constr: jax.Array    # (L, 2) — per-leaf [min, max] output bound
                               # (reference BasicLeafConstraints entries_)
+    leaf_out: jax.Array       # (L,) — current leaf output values (smoothing)
+    leaf_used: jax.Array      # (L, F) bool — branch features per leaf
+                              # (reference Tree::branch_features)
     tree: TreeArrays
     leaf_is_left: jax.Array   # (L,) bool
     num_leaves: jax.Array     # () int32
@@ -92,37 +96,69 @@ def make_leafwise_grower(
     max_depth: int = -1,
     feature_fraction_bynode: float = 1.0,
     monotone_penalty: float = 0.0,
+    interaction_groups=None,
+    forced_splits=None,
     hist_fn: Callable = None,
     split_fn: Callable = None,
     sums_fn: Callable = None,
 ):
     """Build the jittable ``grow(binned, g3, base_mask, key)`` function.
 
+    ``forced_splits``: optional (S, 4) int array [leaf, feature, bin,
+    default_left] applied as the first S steps in BFS order (reference:
+    SerialTreeLearner::ForceSplits, serial_tree_learner.cpp:427-539).
+
     ``hist_fn(binned, g3, leaf_id, target_leaf) -> (F, B, 3)`` — histogram of
     one leaf's rows (globally summed in distributed mode).
-    ``split_fn(hist, parent_sum, feature_mask, key, uid, constraint, depth)
-    -> SplitResult`` — defaults to the local vectorized search; the
-    feature-parallel learner substitutes a sharded search + cross-shard
-    argmax.  ``constraint`` is the leaf's monotone [min, max] output bound.
+    ``split_fn(hist, parent_sum, feature_mask, key, uid, constraint, depth,
+    parent_output) -> SplitResult`` — defaults to the local vectorized
+    search; the feature-parallel learner substitutes a sharded search +
+    cross-shard argmax.  ``constraint`` is the leaf's monotone [min, max]
+    output bound; ``parent_output`` the leaf's current value (path
+    smoothing).
     ``sums_fn(g3) -> (3,)`` — root grad/hess/count totals (psum over the row
     mesh axis in data-parallel mode; the analog of the reference's root
     sum Allreduce, data_parallel_tree_learner.cpp:126-151).
+    ``interaction_groups``: optional (G, F) bool matrix of interaction
+    constraints (reference ColSampler::GetByNode, col_sampler.hpp:92-112).
     """
     L = num_leaves
     L1 = max(L - 1, 1)
     use_mc = bool(np.asarray(meta.monotone_type).any())
+    groups = (jnp.asarray(interaction_groups)
+              if interaction_groups is not None else None)
+    S_forced = 0 if forced_splits is None else min(len(forced_splits), L - 1)
+    if S_forced:
+        f_leaf = jnp.asarray(forced_splits[:S_forced, 0], jnp.int32)
+        f_feat = jnp.asarray(forced_splits[:S_forced, 1], jnp.int32)
+        f_bin = jnp.asarray(forced_splits[:S_forced, 2], jnp.int32)
+        f_dl = jnp.asarray(forced_splits[:S_forced, 3] != 0)
 
     if split_fn is None:
-        def split_fn(hist, parent, mask, key, uid, constraint, depth):
+        def split_fn(hist, parent, mask, key, uid, constraint, depth,
+                     parent_output):
+            rk = jax.random.fold_in(key, uid + 1_000_003) \
+                if params.extra_trees else None
             return find_best_split(hist, parent, meta, mask, params,
-                                   constraint, depth, monotone_penalty)
+                                   constraint, depth, monotone_penalty,
+                                   parent_output, rk)
+
+    def allowed_features(used):
+        """reference GetByNode: branch features + union of constraint
+        groups containing ALL branch features."""
+        if groups is None:
+            return jnp.ones_like(used)
+        fits = jnp.all(groups | ~used[None, :], axis=1)       # (G,)
+        return used | jnp.any(groups & fits[:, None], axis=0)
 
     if sums_fn is None:
         def sums_fn(g3):
             return g3.sum(axis=0)
 
-    def clamp_out(sums, constr):
+    def clamp_out(sums, constr, parent_out=0.0):
         out = leaf_output(sums[0], sums[1], params)
+        if params.path_smooth > 0:
+            out = smooth_output(out, sums[2], parent_out, params)
         if not use_mc:
             return out
         return jnp.clip(out, constr[0], constr[1])
@@ -150,7 +186,12 @@ def make_leafwise_grower(
         root_sum = sums_fn(g3)
         mask0 = _node_feature_mask(key, 0, base_mask, feature_fraction_bynode)
         no_constr = jnp.asarray(NO_CONSTRAINT, jnp.float32)
-        res0 = split_fn(hist0, root_sum, mask0, key, 0, no_constr, 0)
+        used0 = jnp.zeros(F, bool)
+        mask0 = mask0 & allowed_features(used0)
+        out0 = leaf_output(root_sum[0], root_sum[1], params)
+        if params.path_smooth > 0:
+            out0 = smooth_output(out0, root_sum[2], 0.0, params)
+        res0 = split_fn(hist0, root_sum, mask0, key, 0, no_constr, 0, out0)
 
         from ..models.tree import empty_tree
 
@@ -169,6 +210,8 @@ def make_leafwise_grower(
             best_iscat=jnp.zeros(L, bool).at[0].set(res0.is_cat),
             best_bitset=jnp.zeros((L, W), jnp.uint32).at[0].set(res0.cat_bitset),
             leaf_constr=jnp.tile(jnp.asarray(NO_CONSTRAINT, jnp.float32), (L, 1)),
+            leaf_out=jnp.zeros(L, jnp.float32).at[0].set(out0),
+            leaf_used=jnp.zeros((L, F), bool),
             tree=empty_tree(L, W),
             leaf_is_left=jnp.zeros(L, bool),
             num_leaves=jnp.asarray(1, jnp.int32),
@@ -178,7 +221,29 @@ def make_leafwise_grower(
         def body(s, st: GrowerState) -> GrowerState:
             leaf = jnp.argmax(st.best_gain).astype(jnp.int32)
             gain = st.best_gain[leaf]
-            active = (~st.done) & (gain > 0)
+            is_forced = jnp.asarray(False)
+            if S_forced:
+                # forced splits occupy the first S steps (reference
+                # ForceSplits BFS, serial_tree_learner.cpp:427-539); a forced
+                # split that would create an empty child is skipped
+                sidx = jnp.minimum(s, S_forced - 1)
+                maybe = s < S_forced
+                fleaf, ffeat = f_leaf[sidx], f_feat[sidx]
+                fthr, fdl = f_bin[sidx], f_dl[sidx]
+                hf = st.hist_pool[fleaf, ffeat]               # (B, 3)
+                cumf = jnp.cumsum(hf, axis=0)
+                has_nan = meta.missing_type[ffeat] == MISSING_NAN
+                nan_c = hf[jnp.maximum(meta.nan_bin[ffeat], 0)] * jnp.where(
+                    has_nan, 1.0, 0.0)
+                in_cum = has_nan & (meta.nan_bin[ffeat] <= fthr)
+                flsum = cumf[fthr] + nan_c * (
+                    fdl.astype(jnp.float32) - in_cum.astype(jnp.float32))
+                frsum = st.leaf_sums[fleaf] - flsum
+                ok_f = maybe & (flsum[2] > 0) & (frsum[2] > 0)
+                is_forced = ok_f
+                leaf = jnp.where(ok_f, fleaf, leaf)
+                gain = jnp.where(ok_f, jnp.float32(0.0), gain)
+            active = (~st.done) & ((gain > 0) | is_forced)
 
             def do_split(st: GrowerState) -> GrowerState:
                 nl = st.num_leaves                    # new (right-child) leaf index
@@ -190,6 +255,16 @@ def make_leafwise_grower(
                 rsum = st.best_right[leaf]
                 iscat = st.best_iscat[leaf]
                 bitset = st.best_bitset[leaf]
+                if S_forced:
+                    sidx2 = jnp.minimum(s, S_forced - 1)
+                    feat = jnp.where(is_forced, f_feat[sidx2], feat)
+                    thr = jnp.where(is_forced, f_bin[sidx2], thr)
+                    dl = jnp.where(is_forced, f_dl[sidx2], dl)
+                    lsum = jnp.where(is_forced, flsum, lsum)
+                    rsum = jnp.where(is_forced, frsum, rsum)
+                    iscat = iscat & (~is_forced)
+                    bitset = jnp.where(is_forced,
+                                       jnp.zeros_like(bitset), bitset)
                 parent_sum = st.leaf_sums[leaf]
 
                 leaf_id = apply_decision(binned, st.leaf_id, leaf, nl, feat,
@@ -198,8 +273,9 @@ def make_leafwise_grower(
                 # monotone constraint propagation (reference:
                 # BasicLeafConstraints::Update, monotone_constraints.hpp:99-117)
                 pconstr = st.leaf_constr[leaf]
-                out_l = clamp_out(lsum, pconstr)
-                out_r = clamp_out(rsum, pconstr)
+                pout = st.leaf_out[leaf]
+                out_l = clamp_out(lsum, pconstr, pout)
+                out_r = clamp_out(rsum, pconstr, pout)
                 if use_mc:
                     mono = meta.monotone_type[feat]
                     mid = 0.5 * (out_l + out_r)
@@ -229,16 +305,18 @@ def make_leafwise_grower(
                 d = st.leaf_depth[leaf] + 1
                 depth_ok = (max_depth <= 0) | (d < max_depth)
 
+                used_child = st.leaf_used[leaf].at[feat].set(True)
+                allow_child = allowed_features(used_child)
                 mask_l = _node_feature_mask(
                     key, 2 * s + 1, base_mask, feature_fraction_bynode
-                )
+                ) & allow_child
                 mask_r = _node_feature_mask(
                     key, 2 * s + 2, base_mask, feature_fraction_bynode
-                )
+                ) & allow_child
                 res_l = split_fn(h_left, lsum, mask_l, key, 2 * s + 1,
-                                 constr_l, d)
+                                 constr_l, d, out_l)
                 res_r = split_fn(h_right, rsum, mask_r, key, 2 * s + 2,
-                                 constr_r, d)
+                                 constr_r, d, out_r)
                 gain_l = jnp.where(depth_ok, res_l.gain, -jnp.inf)
                 gain_r = jnp.where(depth_ok, res_r.gain, -jnp.inf)
 
@@ -267,9 +345,7 @@ def make_leafwise_grower(
                     left_child=lc,
                     right_child=rc,
                     split_gain=t.split_gain.at[node].set(gain),
-                    internal_value=t.internal_value.at[node].set(
-                        clamp_out(parent_sum, pconstr)
-                    ),
+                    internal_value=t.internal_value.at[node].set(pout),
                     internal_weight=t.internal_weight.at[node].set(parent_sum[1]),
                     internal_count=t.internal_count.at[node].set(parent_sum[2]),
                     leaf_value=t.leaf_value.at[leaf].set(out_l).at[nl].set(out_r),
@@ -295,6 +371,9 @@ def make_leafwise_grower(
                     best_iscat=st.best_iscat.at[leaf].set(res_l.is_cat).at[nl].set(res_r.is_cat),
                     best_bitset=st.best_bitset.at[leaf].set(res_l.cat_bitset).at[nl].set(res_r.cat_bitset),
                     leaf_constr=st.leaf_constr.at[leaf].set(constr_l).at[nl].set(constr_r),
+                    leaf_out=st.leaf_out.at[leaf].set(out_l).at[nl].set(out_r),
+                    leaf_used=st.leaf_used.at[leaf].set(used_child)
+                    .at[nl].set(used_child),
                     tree=tree,
                     leaf_is_left=st.leaf_is_left.at[leaf].set(True).at[nl].set(False),
                     num_leaves=nl + 1,
@@ -326,6 +405,7 @@ def make_levelwise_grower(
     max_depth: int = -1,
     feature_fraction_bynode: float = 1.0,
     monotone_penalty: float = 0.0,
+    interaction_groups=None,
     hist_frontier_fn: Callable = None,
     split_fn: Callable = None,
     sums_fn: Callable = None,
@@ -354,18 +434,32 @@ def make_levelwise_grower(
     if max_depth > 0:
         levels = min(levels, max_depth)
     use_mc = bool(np.asarray(meta.monotone_type).any())
+    groups_lw = (jnp.asarray(interaction_groups)
+                 if interaction_groups is not None else None)
 
     if split_fn is None:
-        def split_fn(hist, parent, mask, key, uid, constraint, depth):
+        def split_fn(hist, parent, mask, key, uid, constraint, depth,
+                     parent_output):
+            rk = jax.random.fold_in(key, uid + 1_000_003) \
+                if params.extra_trees else None
             return find_best_split(hist, parent, meta, mask, params,
-                                   constraint, depth, monotone_penalty)
+                                   constraint, depth, monotone_penalty,
+                                   parent_output, rk)
 
     if sums_fn is None:
         def sums_fn(g3):
             return g3.sum(axis=0)
 
-    def clamp_out_batch(sums, constr):
+    def allowed_features_batch(used):
+        if groups_lw is None:
+            return jnp.ones_like(used)
+        fits = jnp.all(groups_lw[None] | ~used[:, None, :], axis=2)  # (K, G)
+        return used | jnp.any(groups_lw[None] & fits[:, :, None], axis=1)
+
+    def clamp_out_batch(sums, constr, parent_out=None):
         out = jax.vmap(lambda s: leaf_output(s[0], s[1], params))(sums)
+        if params.path_smooth > 0 and parent_out is not None:
+            out = smooth_output(out, sums[:, 2], parent_out, params)
         if not use_mc:
             return out
         return jnp.clip(out, constr[:, 0], constr[:, 1])
@@ -381,6 +475,11 @@ def make_levelwise_grower(
         tree = empty_tree(L, W)
         leaf_sums = jnp.zeros((L, 3), jnp.float32).at[0].set(root_sum)
         leaf_constr = jnp.tile(jnp.asarray(NO_CONSTRAINT, jnp.float32), (L, 1))
+        out_root = leaf_output(root_sum[0], root_sum[1], params)
+        if params.path_smooth > 0:
+            out_root = smooth_output(out_root, root_sum[2], 0.0, params)
+        leaf_out = jnp.zeros(L, jnp.float32).at[0].set(out_root)
+        leaf_used = jnp.zeros((L, F), bool)
         leaf_active = jnp.zeros(L, bool).at[0].set(True)
         leaf_is_left = jnp.zeros(L, bool)
         num_leaves_cur = jnp.asarray(1, jnp.int32)
@@ -397,9 +496,10 @@ def make_levelwise_grower(
                 ])
             else:
                 masks = jnp.broadcast_to(base_mask, (Ld, F))
+            masks = masks & allowed_features_batch(leaf_used[:Ld])
             res = jax.vmap(
-                lambda h, p, m, c: split_fn(h, p, m, key, d, c, d)
-            )(hist, leaf_sums[:Ld], masks, leaf_constr[:Ld])
+                lambda h, p, m, c, po: split_fn(h, p, m, key, d, c, d, po)
+            )(hist, leaf_sums[:Ld], masks, leaf_constr[:Ld], leaf_out[:Ld])
 
             gains = jnp.where(leaf_active[:Ld], res.gain, -jnp.inf)
             want = gains > 0
@@ -438,9 +538,9 @@ def make_levelwise_grower(
             nl = jnp.where(split_mask, new_leaf, L + 1)
             ld_idx = jnp.where(split_mask, jnp.arange(Ld), L + 1)
             pconstr = leaf_constr[:Ld]
-            parent_out = clamp_out_batch(leaf_sums[:Ld], pconstr)
-            left_out = clamp_out_batch(res.left_sum, pconstr)
-            right_out = clamp_out_batch(res.right_sum, pconstr)
+            parent_out = leaf_out[:Ld]
+            left_out = clamp_out_batch(res.left_sum, pconstr, parent_out)
+            right_out = clamp_out_batch(res.right_sum, pconstr, parent_out)
             if use_mc:
                 # BasicLeafConstraints::Update, vectorized over the level
                 mono = meta.monotone_type[res.feature]
@@ -500,6 +600,12 @@ def make_levelwise_grower(
                 .at[nl].set(res.right_sum, mode="drop")
             leaf_constr = leaf_constr.at[ld_idx].set(constr_l, mode="drop") \
                 .at[nl].set(constr_r, mode="drop")
+            leaf_out = leaf_out.at[ld_idx].set(left_out, mode="drop") \
+                .at[nl].set(right_out, mode="drop")
+            used_child = leaf_used[:Ld] | jax.nn.one_hot(
+                res.feature, F, dtype=bool)
+            leaf_used = leaf_used.at[ld_idx].set(used_child, mode="drop") \
+                .at[nl].set(used_child, mode="drop")
             leaf_is_left = leaf_is_left.at[ld_idx].set(True, mode="drop") \
                 .at[nl].set(False, mode="drop")
             leaf_active = (leaf_active & jnp.pad(split_mask, (0, L - Ld))

@@ -52,6 +52,10 @@ class SplitParams(NamedTuple):
     max_cat_threshold: int = 32
     max_cat_to_onehot: int = 4
     min_data_per_group: float = 100.0
+    # path smoothing (reference CalculateSplittedLeafOutput USE_SMOOTHING,
+    # feature_histogram.hpp:756-760) and extremely-randomized trees
+    path_smooth: float = 0.0
+    extra_trees: bool = False
 
 
 class SplitResult(NamedTuple):
@@ -130,6 +134,13 @@ def leaf_gain_given_output(g, h, out, p: SplitParams):
     optimum)."""
     t = threshold_l1(g, p.lambda_l1)
     return -(2.0 * t * out + (h + p.lambda_l2) * out * out)
+
+
+def smooth_output(raw_out, count, parent_output, p: SplitParams):
+    """Path smoothing (reference feature_histogram.hpp:756-760):
+    ``out*(n/a)/(n/a+1) + parent/(n/a+1)`` with a = path_smooth."""
+    w = count / p.path_smooth
+    return raw_out * w / (w + 1.0) + parent_output / (w + 1.0)
 
 
 def monotone_penalty_factor(depth, penalization):
@@ -289,11 +300,14 @@ def find_best_split(
     constraint: Optional[jax.Array] = None,  # (2,) [min, max] leaf output bound
     depth=0,                  # leaf depth (monotone_penalty)
     monotone_penalty: float = 0.0,
+    parent_output=0.0,        # this leaf's current output (path smoothing)
+    rand_key: Optional[jax.Array] = None,    # extra_trees threshold sampling
 ) -> SplitResult:
     F, B, _ = hist.shape
     total_g, total_h, total_c = parent_sum[0], parent_sum[1], parent_sum[2]
 
     use_mc = bool(np.asarray(meta.monotone_type).any())
+    use_smooth = params.path_smooth > 0
     if constraint is None:
         constraint = jnp.asarray(NO_CONSTRAINT, jnp.float32)
 
@@ -322,25 +336,40 @@ def find_best_split(
             & (lh >= params.min_sum_hessian_in_leaf)
             & (rh >= params.min_sum_hessian_in_leaf)
         )
-        if not use_mc:
+        if not use_mc and not use_smooth:
             gain = leaf_gain(lg, lh, params) + leaf_gain(rg, rh, params)
             return jnp.where(ok, gain, NEG_INF)
-        # monotone mode (reference: GetSplitGains with USE_MC +
-        # BasicLeafConstraints clamp, feature_histogram.hpp:782-830): leaf
-        # outputs are clamped to the leaf's [min, max] bound, the gain is
-        # evaluated at the clamped outputs, and a split violating the
-        # feature's monotone direction is rejected.
-        out_l = jnp.clip(leaf_output(lg, lh, params), constraint[0], constraint[1])
-        out_r = jnp.clip(leaf_output(rg, rh, params), constraint[0], constraint[1])
-        mono = meta.monotone_type[:, None]             # (F, 1)
-        violates = ((mono > 0) & (out_l > out_r)) | ((mono < 0) & (out_l < out_r))
+        # constrained/smoothed mode (reference: GetSplitGains with USE_MC /
+        # USE_SMOOTHING, feature_histogram.hpp:782-830): leaf outputs are
+        # smoothed toward the parent's output and clamped to the leaf's
+        # [min, max] bound; the gain is evaluated at those outputs, and a
+        # split violating the feature's monotone direction is rejected.
+        out_l = leaf_output(lg, lh, params)
+        out_r = leaf_output(rg, rh, params)
+        if use_smooth:
+            out_l = smooth_output(out_l, lc, parent_output, params)
+            out_r = smooth_output(out_r, rc, parent_output, params)
+        if use_mc:
+            out_l = jnp.clip(out_l, constraint[0], constraint[1])
+            out_r = jnp.clip(out_r, constraint[0], constraint[1])
         gain = (leaf_gain_given_output(lg, lh, out_l, params)
                 + leaf_gain_given_output(rg, rh, out_r, params))
-        return jnp.where(ok & (~violates), gain, NEG_INF)
+        if use_mc:
+            mono = meta.monotone_type[:, None]         # (F, 1)
+            violates = ((mono > 0) & (out_l > out_r)) | (
+                (mono < 0) & (out_l < out_r))
+            ok = ok & (~violates)
+        return jnp.where(ok, gain, NEG_INF)
 
     numerical_ok = feature_mask[:, None] & meta.usable[:, None] & (
         ~meta.is_categorical[:, None])
     base_valid = (t_idx <= nb - 2) & numerical_ok
+    if params.extra_trees and rand_key is not None:
+        # extremely-randomized trees (reference USE_RAND: one random
+        # threshold per feature per node, feature_histogram.hpp:919-930)
+        u = jax.random.uniform(rand_key, (F,))
+        rand_bin = (u * jnp.maximum(meta.num_bins - 1, 1)).astype(jnp.int32)
+        base_valid = base_valid & (t_idx == rand_bin[:, None])
     gain_a = jnp.where(base_valid, eval_direction(left_a), NEG_INF)
     gain_b = jnp.where(
         base_valid & has_nan_dir, eval_direction(left_b), NEG_INF
@@ -396,7 +425,13 @@ def find_best_split(
     )
     default_left = default_left & (~is_cat)
 
-    parent_gain = leaf_gain(total_g, total_h, params)
+    if use_smooth:
+        # reference: with smoothing the gain shift is the leaf's gain AT its
+        # current (already-smoothed) output value
+        parent_gain = leaf_gain_given_output(total_g, total_h,
+                                             parent_output, params)
+    else:
+        parent_gain = leaf_gain(total_g, total_h, params)
     rel_gain = best_gain - parent_gain - params.min_gain_to_split
     rel_gain = jnp.where(jnp.isfinite(best_gain), rel_gain, NEG_INF)
 
@@ -412,7 +447,45 @@ def find_best_split(
     )
 
 
+def per_feature_best_gain(
+    hist: jax.Array,          # (F, B, 3)
+    parent_sum: jax.Array,    # (3,)
+    meta: FeatureMeta,
+    feature_mask: jax.Array,  # (F,) bool
+    params: SplitParams,
+) -> jax.Array:               # (F,) best split gain per feature (-inf if none)
+    """Per-feature best numerical gain — the PV-Tree voting score
+    (reference: VotingParallelTreeLearner computes local best splits per
+    feature before voting, voting_parallel_tree_learner.cpp:300-310)."""
+    F, B, _ = hist.shape
+    total_g, total_h, total_c = parent_sum[0], parent_sum[1], parent_sum[2]
+    cum = jnp.cumsum(hist, axis=1)
+    t_idx = lax.broadcasted_iota(jnp.int32, (F, B), 1)
+    nb = meta.num_bins[:, None]
+    nan_contrib = jnp.take_along_axis(
+        hist, jnp.maximum(meta.nan_bin, 0)[:, None, None].repeat(3, axis=2),
+        axis=1)[:, 0, :]
+    has_nan_dir = (meta.missing_type == MISSING_NAN)[:, None]
+
+    def gains_for(left):
+        lg, lh, lc = left[..., 0], left[..., 1], left[..., 2]
+        rg, rh, rc = total_g - lg, total_h - lh, total_c - lc
+        ok = ((lc >= params.min_data_in_leaf)
+              & (rc >= params.min_data_in_leaf)
+              & (lh >= params.min_sum_hessian_in_leaf)
+              & (rh >= params.min_sum_hessian_in_leaf))
+        gain = leaf_gain(lg, lh, params) + leaf_gain(rg, rh, params)
+        return jnp.where(ok, gain, NEG_INF)
+
+    valid = (t_idx <= nb - 2) & feature_mask[:, None] & meta.usable[:, None] \
+        & (~meta.is_categorical[:, None])
+    ga = jnp.where(valid, gains_for(cum), NEG_INF)
+    gb = jnp.where(valid & has_nan_dir,
+                   gains_for(cum + nan_contrib[:, None, :]), NEG_INF)
+    return jnp.maximum(ga.max(axis=1), gb.max(axis=1))
+
+
 # vmapped over a batch of leaves: hist (K, F, B, 3), parent (K, 3), mask (K, F),
-# constraint (K, 2); depth/penalty shared
+# constraint (K, 2), parent_output (K,); depth/penalty/key shared
 find_best_split_batch = jax.vmap(
-    find_best_split, in_axes=(0, 0, None, 0, None, 0, None, None))
+    find_best_split, in_axes=(0, 0, None, 0, None, 0, None, None, 0, None))

@@ -1,0 +1,121 @@
+"""Multi-host (multi-process) cluster initialization.
+
+TPU-native replacement for the reference's Network/Linkers bring-up
+(reference: ``Network::Init`` src/network/network.cpp:30, socket linker
+``src/network/linkers_socket.cpp`` — machine-list parsing, rank discovery,
+TCP mesh connect; MPI linker ``linkers_mpi.cpp``).  Here the transport is
+jax.distributed's gRPC coordination service + the XLA runtime's ICI/DCN
+collectives; after ``init_cluster`` the data/feature/voting-parallel
+learners in ``trainer.py`` span every process's devices through the SAME
+shard_map code path (``jax.devices()`` becomes the global device list).
+
+Configuration mirrors the reference's network parameters:
+
+* ``machines``       — comma-separated ``host:port`` list; the first entry
+  is the coordinator (reference: config.h machines / machine_list_filename)
+* ``num_machines``   — world size
+* ``machine_rank``   — this process's rank; when absent it is discovered by
+  matching a local interface address against ``machines``, exactly like the
+  socket linker's rank discovery.
+
+Standard cluster launchers (SLURM, Cloud TPU pods) are auto-detected by
+``jax.distributed.initialize()`` when no explicit arguments are given.
+"""
+
+from __future__ import annotations
+
+import socket
+from typing import List, Optional
+
+from ..config import Config
+from ..utils.log import log_fatal, log_info, log_warning
+
+_initialized = False
+
+
+def _local_addresses() -> List[str]:
+    addrs = {"127.0.0.1", "localhost"}
+    try:
+        hostname = socket.gethostname()
+        addrs.add(hostname)
+        addrs.add(socket.gethostbyname(hostname))
+    except OSError:
+        pass
+    return list(addrs)
+
+
+def parse_machine_list(spec: str) -> List[str]:
+    """reference: socket linker machine-list parsing (machines config or
+    mlist file contents, one host:port per entry)."""
+    entries = [m.strip() for m in spec.replace("\n", ",").split(",")]
+    return [m for m in entries if m]
+
+
+def discover_rank(machines: List[str]) -> Optional[int]:
+    """Find this process's rank by local address match; multiple local
+    entries (several processes on one host) are disambiguated by port
+    bindability — the same trick the reference socket linker uses
+    (linkers_socket.cpp binds local_listen_port to claim a rank)."""
+    local = set(_local_addresses())
+    candidates = []
+    for i, m in enumerate(machines):
+        host, _, port = m.rpartition(":")
+        if (host or m) in local:
+            candidates.append((i, int(port) if port.isdigit() else 0))
+    if len(candidates) == 1:
+        return candidates[0][0]
+    for i, port in candidates:
+        if port <= 0:
+            continue
+        try:
+            with socket.socket() as s:
+                s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+                s.bind(("0.0.0.0", port))
+            return i
+        except OSError:
+            continue
+    return candidates[0][0] if candidates else None
+
+
+def init_cluster(
+    config: Optional[Config] = None,
+    *,
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> None:
+    """Initialize jax.distributed so a process-spanning Mesh is available.
+
+    Call once per process before building any trainer.  With a ``Config``
+    carrying ``machines``/``num_machines`` the reference CLI semantics
+    apply; with no arguments, jax's cluster auto-detection is used.
+    """
+    global _initialized
+    import jax
+
+    if _initialized:
+        log_warning("init_cluster called twice; ignoring")
+        return
+
+    if config is not None and config.machines and num_processes is None:
+        machines = parse_machine_list(config.machines)
+        if config.num_machines > 1 and len(machines) != config.num_machines:
+            log_fatal(f"machines lists {len(machines)} hosts but "
+                      f"num_machines={config.num_machines}")
+        coordinator_address = machines[0]
+        num_processes = len(machines)
+        process_id = discover_rank(machines)
+        if process_id is None:
+            log_fatal("Could not find the local machine in the machines "
+                      "list (reference rank discovery failed)")
+
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    _initialized = True
+    log_info(
+        f"Cluster initialized: process {jax.process_index()} of "
+        f"{jax.process_count()}, {jax.local_device_count()} local / "
+        f"{jax.device_count()} global devices")

@@ -18,14 +18,18 @@ stack:
   ``all_gather`` of packed SplitInfo + argmax — the analog of
   ``SyncUpGlobalBestSplit``'s Allreduce-max over serialized SplitInfo pairs
   (parallel_tree_learner.h:190-213).
-* ``tree_learner=voting`` — reduces to ``data`` for now (PV-Tree top-k
-  voting compression is a comm optimization over slow links; over ICI the
-  plain psum is already cheap). A warning is logged.
+* ``tree_learner=voting`` — VotingParallelTreeLearner (PV-Tree)
+  (reference: src/treelearner/voting_parallel_tree_learner.cpp): row-sharded
+  like ``data``, but each shard votes for its local top-k features, the
+  global top-2k winners are selected by a vote psum (``GlobalVoting``
+  :152-180), and only those features' histograms are reduced across shards
+  (``CopyLocalHistogram``) — comm drops from O(F·B) to O(2k·B) per split.
+  With ``top_k >= num_features`` it is exactly the data-parallel learner.
 
 The socket/MPI ``Network``/``Linkers`` machinery of the reference
 (src/network/) has no equivalent here by design: XLA emits the collectives
-over ICI/DCN. Multi-host scaling uses ``jax.distributed.initialize`` +
-a process-spanning Mesh with the same code path.
+over ICI/DCN. Multi-host scaling initializes ``jax.distributed`` through
+``parallel/cluster.py`` and spans the same Mesh across processes.
 """
 
 from __future__ import annotations
@@ -87,6 +91,25 @@ def _unpack_split(v: jnp.ndarray) -> SplitResult:
     )
 
 
+def parse_interaction_constraints(spec, num_features: int):
+    """'[0,1,2],[2,3]' -> (G, F) bool group matrix, or None when unset
+    (reference: config.h:517 interaction_constraints,
+    Config::Set -> interaction_constraints_vector)."""
+    import re
+
+    if not spec:
+        return None
+    groups = []
+    for m in re.findall(r"\[([\d,\s]*)\]", str(spec)):
+        idx = [int(x) for x in m.replace(",", " ").split()]
+        row = np.zeros(num_features, bool)
+        row[[i for i in idx if i < num_features]] = True
+        groups.append(row)
+    if not groups:
+        return None
+    return np.stack(groups)
+
+
 def _warn_unimplemented(config: Config) -> None:
     """Loudly reject accepted-but-unimplemented parameters instead of
     silently ignoring them (the reference either enforces or rejects)."""
@@ -104,12 +127,45 @@ def _warn_unimplemented(config: Config) -> None:
                 "implemented in this build — the parameter has NO effect")
 
 
+def parse_forced_splits(filename: str, bin_mappers, num_leaves: int):
+    """forcedsplits_filename JSON -> (S, 4) [leaf, feature, bin, dl] in BFS
+    order with the grower's leaf numbering (reference:
+    SerialTreeLearner::ForceSplits, serial_tree_learner.cpp:427-539; JSON
+    format {'feature': f, 'threshold': t, 'left': {...}, 'right': {...}})."""
+    import json
+
+    if not filename:
+        return None
+    with open(filename) as fh:
+        spec = json.load(fh)
+    if not spec:
+        return None
+    out = []
+    queue = [(spec, 0)]
+    step = 0
+    while queue and step < num_leaves - 1:
+        node, leaf = queue.pop(0)
+        f = int(node["feature"])
+        thr = float(node["threshold"])
+        b = int(bin_mappers[f].value_to_bin(np.asarray([thr]))[0])
+        dl = bool(node.get("default_left", False))
+        out.append([leaf, f, b, int(dl)])
+        new_leaf = step + 1
+        if node.get("left"):
+            queue.append((node["left"], leaf))
+        if node.get("right"):
+            queue.append((node["right"], new_leaf))
+        step += 1
+    return np.asarray(out, np.int64) if out else None
+
+
 def build_trainer(
     config: Config,
     binned_np: np.ndarray,           # (F, N) uint8/int16 host array
     meta: FeatureMeta,
     params: SplitParams,
     num_bins: int,
+    bin_mappers=None,
 ) -> Tuple[Callable, jax.Array, int]:
     """Return ``(grow_fn, binned_device, num_data)`` for the configured
     tree_learner.  ``grow_fn(binned_device, g3, base_mask, key)`` has the
@@ -150,24 +206,44 @@ def build_trainer(
         max_depth=config.max_depth,
         feature_fraction_bynode=config.feature_fraction_bynode,
         monotone_penalty=config.monotone_penalty,
+        interaction_groups=parse_interaction_constraints(
+            config.interaction_constraints, F),
     )
+    forced = None
+    if config.forcedsplits_filename:
+        if bin_mappers is None:
+            log_warning("forcedsplits_filename requires bin mappers; ignored")
+        elif levelwise:
+            log_warning("forcedsplits_filename is only supported by the "
+                        "leaf-wise grower; ignored for tree_growth=levelwise")
+        else:
+            forced = parse_forced_splits(config.forcedsplits_filename,
+                                         bin_mappers, config.num_leaves)
 
     if learner in ("serial", ""):
         if levelwise:
             grow = make_levelwise_grower(hist_frontier_fn=local_frontier, **common)
         else:
-            grow = make_leafwise_grower(hist_fn=local_hist, **common)
+            grow = make_leafwise_grower(hist_fn=local_hist,
+                                        forced_splits=forced, **common)
         return jax.jit(grow), jnp.asarray(binned_np), N
 
-    if learner == "voting":
-        log_warning(
-            "tree_learner=voting: PV-Tree voting is a communication "
-            "compression for slow links; over ICI the data-parallel psum is "
-            "already optimal — using tree_learner=data"
-        )
+    if learner == "voting" and levelwise:
+        log_warning("tree_learner=voting requires the leaf-wise grower; "
+                    "using tree_learner=data for tree_growth=levelwise")
         learner = "data"
 
-    if learner == "data":
+    if learner == "voting":
+        # PV-Tree voting (reference: VotingParallelTreeLearner,
+        # src/treelearner/voting_parallel_tree_learner.cpp:152-310): rows are
+        # sharded like the data-parallel learner, but instead of reducing the
+        # full (F, B) histogram block, each shard votes for its local top-k
+        # features, the global top-2k vote winners are selected
+        # (GlobalVoting :152-180), and only the selected features' histograms
+        # are summed across shards (CopyLocalHistogram) — comm volume drops
+        # from O(F·B) to O(2k·B).
+        from ..ops.split import per_feature_best_gain
+
         mesh = _make_mesh(config.num_shards, "data")
         ndev = mesh.devices.size
         N_pad = ((N + ndev - 1) // ndev) * ndev
@@ -176,8 +252,86 @@ def build_trainer(
         binned_dev = jax.device_put(
             jnp.asarray(binned_p), NamedSharding(mesh, P(None, "data"))
         )
+        top_k = max(1, min(config.top_k, F))
+        sel_k = min(2 * top_k, F)
+        log_info(f"Voting-parallel training over {ndev} devices "
+                 f"(top_k={top_k}, {sel_k} features reduced per split)")
+
+        def hist_fn(binned, g3, leaf_id, target):
+            # local histogram only — the reduce happens per-split in split_fn
+            return hist_one_leaf(binned, g3, leaf_id, target, B,
+                                 method=method, precision=precision)
+
+        def sums_fn(g3):
+            return lax.psum(g3.sum(axis=0), "data")
+
+        def split_fn(local_hist, parent, mask, key, uid, constraint, depth,
+                     parent_output):
+            # local parent stats: any feature's bin sums cover the shard rows
+            local_parent = local_hist[0].sum(axis=0)
+            gains = per_feature_best_gain(local_hist, local_parent, meta,
+                                          mask, params)
+            _, local_top = lax.top_k(gains, top_k)
+            votes = jnp.zeros(F, jnp.float32).at[local_top].add(
+                jnp.where(jnp.isfinite(gains[local_top]), 1.0, 0.0))
+            votes = lax.psum(votes, "data")               # GlobalVoting
+            # tie-break deterministically by feature index
+            order_score = votes * (F + 1) - jnp.arange(F, dtype=jnp.float32)
+            _, selected = lax.top_k(order_score, sel_k)   # (sel_k,)
+            # reduce ONLY the selected features' histograms
+            hist_sel = lax.psum(local_hist[selected], "data")  # (sel_k, B, 3)
+            full = jnp.zeros((F, B, 3), jnp.float32).at[selected].set(hist_sel)
+            sel_mask = jnp.zeros(F, bool).at[selected].set(True)
+            rk = jax.random.fold_in(key, uid + 1_000_003) \
+                if params.extra_trees else None
+            return find_best_split(full, parent, meta, mask & sel_mask,
+                                   params, constraint, depth,
+                                   config.monotone_penalty, parent_output, rk)
+
+        grow = make_leafwise_grower(
+            hist_fn=hist_fn, split_fn=split_fn, sums_fn=sums_fn, **common)
+        sharded = shard_map(
+            grow,
+            mesh=mesh,
+            in_specs=(P(None, "data"), P("data", None), P(), P()),
+            out_specs=(
+                jax.tree_util.tree_map(lambda _: P(), TreeArrays(
+                    *([0] * len(TreeArrays._fields)))),
+                P("data"),
+                P(),
+            ),
+            check_vma=False,
+        )
+
+        @jax.jit
+        def grow_fn(binned, g3, base_mask, key):
+            pad = N_pad - N
+            g3p = jnp.pad(g3, ((0, pad), (0, 0)))
+            tree, leaf_id, root = sharded(binned, g3p, base_mask, key)
+            return tree, leaf_id[:N], root
+
+        return grow_fn, binned_dev, N
+
+    if learner == "data":
+        mesh = _make_mesh(config.num_shards, "data")
+        ndev = mesh.devices.size
+        N_pad = ((N + ndev - 1) // ndev) * ndev
+        binned_p = np.zeros((F, N_pad), dtype=binned_np.dtype)
+        binned_p[:, :N] = binned_np
+        sharding = NamedSharding(mesh, P(None, "data"))
+        if jax.process_count() > 1:
+            # multi-host: every process carries the full host array and
+            # contributes its addressable row shards (the analog of the
+            # reference's loader-level rank pre-partition,
+            # dataset_loader.cpp:167 LoadFromFile(fname, rank, num_machines))
+            binned_dev = jax.make_array_from_callback(
+                binned_p.shape, sharding,
+                lambda idx: jnp.asarray(binned_p[idx]))
+        else:
+            binned_dev = jax.device_put(jnp.asarray(binned_p), sharding)
         log_info(f"Data-parallel training over {ndev} devices "
-                 f"({N_pad // ndev} rows/device)")
+                 f"({N_pad // ndev} rows/device, "
+                 f"{jax.process_count()} processes)")
 
         def hist_fn(binned, g3, leaf_id, target):
             # local histogram + Allreduce — the reference's
@@ -257,7 +411,8 @@ def build_trainer(
             full = jnp.zeros((F_pad, B, 3), jnp.float32)
             return lax.dynamic_update_slice(full, h, (lo, 0, 0))
 
-        def split_fn(hist, parent, mask, key, uid, constraint, depth):
+        def split_fn(hist, parent, mask, key, uid, constraint, depth,
+                     parent_output):
             # search only this device's features, then Allreduce-max over
             # packed SplitInfo (reference SyncUpGlobalBestSplit)
             lo = lax.axis_index("feature") * F_loc
@@ -266,9 +421,12 @@ def build_trainer(
             ) & (
                 lax.broadcasted_iota(jnp.int32, (F_pad, 1), 0)[:, 0] < lo + F_loc
             )
+            rk = jax.random.fold_in(key, uid + 1_000_003) \
+                if params.extra_trees else None
             local = find_best_split(hist, parent, meta_p, mask & in_shard,
                                     params, constraint, depth,
-                                    config.monotone_penalty)
+                                    config.monotone_penalty, parent_output,
+                                    rk)
             packed = _pack_split(local)
             allp = lax.all_gather(packed, "feature")        # (ndev, 10)
             best = jnp.argmax(allp[:, 0])
@@ -280,6 +438,8 @@ def build_trainer(
             params=params, max_depth=config.max_depth,
             feature_fraction_bynode=config.feature_fraction_bynode,
             monotone_penalty=config.monotone_penalty,
+            interaction_groups=parse_interaction_constraints(
+                config.interaction_constraints, F_pad),
         )
         sharded = shard_map(
             grow,

@@ -1,0 +1,95 @@
+"""Aux subsystem tests: binary dataset cache, auc_mu, phase timer.
+
+reference: Dataset::SaveBinaryFile / LoadFromBinFile
+(dataset.h:473, dataset_loader.cpp:273), AucMuMetric
+(multiclass_metric.hpp:183), USE_TIMETAG global_timer (common.h:1054-1138).
+"""
+
+import numpy as np
+import pytest
+
+import lightgbmv1_tpu as lgb
+from lightgbmv1_tpu.utils.timer import global_timer
+
+
+def test_binary_dataset_cache_round_trip(tmp_path):
+    rng = np.random.RandomState(0)
+    X = rng.randn(500, 6)
+    X[::7, 2] = np.nan                      # missing values survive the cache
+    y = (X[:, 0] > 0).astype(float)
+    w = rng.rand(500)
+    ds = lgb.Dataset(X, label=y, weight=w)
+    path = str(tmp_path / "train.bin")
+    ds.save_binary(path)
+
+    from lightgbmv1_tpu.io.dataset import BinnedDataset
+    assert BinnedDataset.is_binary_file(path)
+    assert not BinnedDataset.is_binary_file(__file__)
+
+    ds2 = lgb.Dataset(path)
+    params = {"objective": "binary", "num_leaves": 7, "verbosity": -1}
+    b1 = lgb.train(params, lgb.Dataset(X, label=y, weight=w), num_boost_round=5)
+    b2 = lgb.train(params, ds2, num_boost_round=5)
+    np.testing.assert_allclose(b1.predict(X), b2.predict(X),
+                               rtol=1e-6, atol=1e-7)
+
+
+def test_binary_cache_preserves_categorical(tmp_path):
+    rng = np.random.RandomState(1)
+    cat = rng.randint(0, 6, 800).astype(float)
+    y = np.isin(cat, [1, 4]).astype(float)
+    X = np.column_stack([cat, rng.randn(800)])
+    ds = lgb.Dataset(X, label=y, categorical_feature=[0])
+    path = str(tmp_path / "cat.bin")
+    ds.save_binary(path)
+    ds2 = lgb.Dataset(path)
+    ds2.construct()
+    assert ds2._binned.is_categorical[0]
+    assert not ds2._binned.is_categorical[1]
+    b = lgb.train({"objective": "binary", "num_leaves": 7, "verbosity": -1},
+                  ds2, num_boost_round=5)
+    acc = ((b.predict(X) > 0.5) == (y > 0.5)).mean()
+    assert acc > 0.95
+
+
+def test_auc_mu_metric():
+    rng = np.random.RandomState(2)
+    K, n = 3, 900
+    y = rng.randint(0, K, n).astype(float)
+    X = rng.randn(n, 4)
+    X[:, 0] += y                               # separable-ish signal
+    bst = lgb.train({"objective": "multiclass", "num_class": K,
+                     "metric": "auc_mu", "num_leaves": 7, "verbosity": -1},
+                    lgb.Dataset(X, label=y), num_boost_round=8)
+    out = bst._gbdt.eval_train()
+    vals = {m: v for (_, m, v, _) in out}
+    assert "auc_mu" in vals
+    assert 0.75 < vals["auc_mu"] <= 1.0
+
+    # permutation-invariance sanity: random labels ~ 0.5
+    y_rand = rng.randint(0, K, n).astype(float)
+    bst2 = lgb.train({"objective": "multiclass", "num_class": K,
+                      "metric": "auc_mu", "num_leaves": 4, "verbosity": -1},
+                     lgb.Dataset(rng.randn(n, 2), label=y_rand),
+                     num_boost_round=1)
+    out2 = {m: v for (_, m, v, _) in bst2._gbdt.eval_train()}
+    assert abs(out2["auc_mu"] - 0.5) < 0.15
+
+
+def test_global_timer_sections():
+    global_timer.reset()
+    global_timer.enabled = True
+    try:
+        rng = np.random.RandomState(3)
+        X = rng.randn(300, 4)
+        y = (X[:, 0] > 0).astype(float)
+        bst = lgb.train({"objective": "binary", "num_leaves": 4,
+                         "verbosity": -1}, lgb.Dataset(X, label=y),
+                        num_boost_round=3)
+        bst.predict(X)   # forces host-tree materialization
+        rep = global_timer.report()
+        assert "GBDT::" in rep
+        assert global_timer.totals   # phases actually recorded
+    finally:
+        global_timer.enabled = False
+        global_timer.reset()

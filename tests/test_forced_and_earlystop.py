@@ -1,0 +1,78 @@
+"""Forced splits + prediction early stop tests.
+
+reference: SerialTreeLearner::ForceSplits (serial_tree_learner.cpp:427-539,
+forcedsplits_filename JSON), PredictionEarlyStopInstance
+(src/boosting/prediction_early_stop.cpp:75).
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+import lightgbmv1_tpu as lgb
+from tests.conftest import make_binary_problem
+
+
+def test_forced_splits(tmp_path):
+    rng = np.random.RandomState(0)
+    X = rng.randn(2000, 4)
+    y = (X[:, 0] - X[:, 1] > 0).astype(float)
+    spec = {"feature": 3, "threshold": 0.5,
+            "left": {"feature": 2, "threshold": -0.25},
+            "right": {"feature": 2, "threshold": 0.75}}
+    path = tmp_path / "forced.json"
+    path.write_text(json.dumps(spec))
+    bst = lgb.train({"objective": "binary", "num_leaves": 15,
+                     "verbosity": -1, "forcedsplits_filename": str(path)},
+                    lgb.Dataset(X, label=y), num_boost_round=3)
+    for t in bst._all_trees():
+        assert int(t.split_feature[0]) == 3          # forced root
+        assert int(t.split_feature[1]) == 2          # forced left child
+        assert int(t.split_feature[2]) == 2          # forced right child
+        assert abs(float(t.threshold[0]) - 0.5) < 0.1
+    acc = ((bst.predict(X) > 0.5) == (y > 0.5)).mean()
+    assert acc > 0.8                                 # still learns after
+
+
+def test_forced_splits_skips_empty_children(tmp_path):
+    X, y = make_binary_problem(n=800)
+    # threshold far outside the data range => forced split would create an
+    # empty child and must be skipped, not crash
+    spec = {"feature": 0, "threshold": 1e9}
+    path = tmp_path / "forced.json"
+    path.write_text(json.dumps(spec))
+    bst = lgb.train({"objective": "binary", "num_leaves": 7, "verbosity": -1,
+                     "forcedsplits_filename": str(path)},
+                    lgb.Dataset(X, label=y), num_boost_round=2)
+    assert bst.num_trees() == 2
+
+
+def test_pred_early_stop_binary():
+    X, y = make_binary_problem(n=1500, f=6)
+    bst = lgb.train({"objective": "binary", "num_leaves": 15,
+                     "learning_rate": 0.3, "verbosity": -1},
+                    lgb.Dataset(X, label=y), num_boost_round=30)
+    full = bst.predict(X)
+    es = bst.predict(X, pred_early_stop=True, pred_early_stop_freq=5,
+                     pred_early_stop_margin=4.0)
+    # early-stopped rows keep the same decision even if probabilities differ
+    assert (((es > 0.5) == (full > 0.5)).mean()) > 0.97
+    # with a huge margin nothing stops early => identical output
+    es_off = bst.predict(X, pred_early_stop=True,
+                         pred_early_stop_margin=1e9)
+    np.testing.assert_allclose(es_off, full, rtol=1e-12)
+
+
+def test_pred_early_stop_multiclass():
+    rng = np.random.RandomState(1)
+    X = rng.randn(900, 4)
+    y = rng.randint(0, 3, 900).astype(float)
+    X[:, 0] += 2 * y
+    bst = lgb.train({"objective": "multiclass", "num_class": 3,
+                     "num_leaves": 7, "verbosity": -1},
+                    lgb.Dataset(X, label=y), num_boost_round=20)
+    full = bst.predict(X)
+    es = bst.predict(X, pred_early_stop=True, pred_early_stop_freq=5,
+                     pred_early_stop_margin=1.0)
+    assert (np.argmax(es, 1) == np.argmax(full, 1)).mean() > 0.97

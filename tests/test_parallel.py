@@ -127,7 +127,30 @@ def test_num_shards_subset():
     )
 
 
-def test_voting_falls_back_to_data():
+def test_voting_matches_data_parallel_with_full_top_k():
+    """PV-Tree voting with top_k >= F reduces every feature => must equal
+    the data-parallel learner exactly (reference: GlobalVoting selects all
+    features when 2*top_k >= F)."""
+    X, y = make_binary_problem(900, f=5)
+    vote = _train({"objective": "binary", "tree_learner": "voting",
+                   "top_k": 5}, X, y, 3)
+    data = _train({"objective": "binary", "tree_learner": "data"}, X, y, 3)
+    np.testing.assert_allclose(
+        vote.raw_train_scores(), data.raw_train_scores(), rtol=1e-4, atol=1e-6
+    )
+
+
+def test_voting_small_top_k_still_learns():
+    X, y = make_binary_problem(1200, f=8)
+    vote = _train({"objective": "binary", "tree_learner": "voting",
+                   "top_k": 2, "num_leaves": 15}, X, y, 5)
+    scores = vote.raw_train_scores()[:, 0]
+    acc = ((scores > 0) == (y > 0.5)).mean()
+    assert acc > 0.8
+
+
+def test_voting_levelwise_falls_back_to_data():
     X, y = make_binary_problem(600, f=5)
-    par = _train({"objective": "binary", "tree_learner": "voting"}, X, y, 2)
+    par = _train({"objective": "binary", "tree_learner": "voting",
+                  "tree_growth": "levelwise"}, X, y, 2)
     assert par.num_trees() == 2

@@ -1,0 +1,61 @@
+"""Plotting tests (reference: tests/python_package_test/test_plotting.py)."""
+
+import matplotlib
+
+matplotlib.use("Agg")   # headless
+
+import numpy as np
+import pytest
+
+import lightgbmv1_tpu as lgb
+
+
+@pytest.fixture
+def trained():
+    rng = np.random.RandomState(0)
+    X = rng.randn(400, 5)
+    y = (X[:, 0] - X[:, 1] > 0).astype(float)
+    res = {}
+    bst = lgb.train({"objective": "binary", "num_leaves": 7,
+                     "metric": ["auc", "binary_logloss"], "verbosity": -1},
+                    lgb.Dataset(X, label=y), num_boost_round=8,
+                    valid_sets=[lgb.Dataset(X[:100], label=y[:100])],
+                    valid_names=["v0"], evals_result=res, verbose_eval=False)
+    return bst, res
+
+
+def test_plot_importance(trained):
+    bst, _ = trained
+    ax = lgb.plot_importance(bst)
+    assert len(ax.patches) > 0
+    ax2 = lgb.plot_importance(bst, importance_type="gain", max_num_features=2)
+    assert len(ax2.patches) <= 2
+
+
+def test_plot_metric(trained):
+    _, res = trained
+    ax = lgb.plot_metric(res, metric="auc")
+    assert len(ax.lines) == 1
+    with pytest.raises(TypeError):
+        lgb.plot_metric(42)
+
+
+def test_plot_split_value_histogram(trained):
+    bst, _ = trained
+    ax = lgb.plot_split_value_histogram(bst, feature=0)
+    assert len(ax.patches) > 0
+    with pytest.raises(ValueError):
+        lgb.plot_split_value_histogram(bst, feature=4)  # likely unused
+
+
+def test_create_tree_digraph(trained):
+    bst, _ = trained
+    try:
+        g = lgb.create_tree_digraph(bst, tree_index=0,
+                                    show_info=["split_gain", "leaf_count"])
+    except ImportError:
+        pytest.skip("graphviz not installed")
+    src = g.source
+    assert "split0" in src and "leaf" in src
+    with pytest.raises(IndexError):
+        lgb.create_tree_digraph(bst, tree_index=99)

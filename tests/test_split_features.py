@@ -1,0 +1,79 @@
+"""path_smooth / extra_trees / interaction_constraints tests.
+
+reference: path smoothing (feature_histogram.hpp:756-760 + engine test
+test_path_smoothing :2264), extra_trees (USE_RAND templates + engine test
+:2246), interaction constraints (col_sampler.hpp:92-112 + engine test
+test_interaction_constraints).
+"""
+
+import numpy as np
+import pytest
+
+import lightgbmv1_tpu as lgb
+from tests.conftest import make_binary_problem, make_regression_problem
+
+BASE = {"objective": "binary", "num_leaves": 15, "min_data_in_leaf": 20,
+        "verbosity": -1}
+
+
+def _logloss(pred, y):
+    p = np.clip(pred, 1e-12, 1 - 1e-12)
+    return -np.mean(y * np.log(p) + (1 - y) * np.log(1 - p))
+
+
+@pytest.mark.parametrize("growth", ["leafwise", "levelwise"])
+def test_path_smoothing_regularizes(growth):
+    X, y = make_binary_problem(n=1500)
+    b0 = lgb.train({**BASE, "tree_growth": growth},
+                   lgb.Dataset(X, label=y), num_boost_round=10)
+    b1 = lgb.train({**BASE, "tree_growth": growth, "path_smooth": 1000.0},
+                   lgb.Dataset(X, label=y), num_boost_round=10)
+    p0, p1 = b0.predict(X, raw_score=True), b1.predict(X, raw_score=True)
+    assert not np.allclose(p0, p1)
+    # heavy smoothing shrinks outputs toward the parent chain (less extreme)
+    assert np.abs(p1).mean() < np.abs(p0).mean()
+    # model still learns
+    assert _logloss(b1.predict(X), y) < 0.65
+
+
+def test_extra_trees_randomizes_thresholds():
+    X, y = make_binary_problem(n=1500)
+    b0 = lgb.train(BASE, lgb.Dataset(X, label=y), num_boost_round=10)
+    b1 = lgb.train({**BASE, "extra_trees": True},
+                   lgb.Dataset(X, label=y), num_boost_round=10)
+    assert not np.allclose(b0.predict(X), b1.predict(X))
+    # randomized thresholds must still learn the signal
+    acc = ((b1.predict(X) > 0.5) == (y > 0.5)).mean()
+    assert acc > 0.75
+
+
+@pytest.mark.parametrize("growth", ["leafwise", "levelwise"])
+def test_interaction_constraints_respected(growth):
+    X, y = make_binary_problem(n=2000)
+    bst = lgb.train({**BASE, "tree_growth": growth, "num_leaves": 31,
+                     "interaction_constraints": "[0,1],[2,3,4]"},
+                    lgb.Dataset(X, label=y), num_boost_round=10)
+    groups = [{0, 1}, {2, 3, 4}]
+    for t in bst._all_trees():
+        # walk every root-to-leaf path; its feature set must fit in a group
+        def paths(node, used):
+            if node < 0:
+                if used:
+                    assert any(used <= g for g in groups), \
+                        f"path features {used} violate constraints"
+                return
+            u = used | {int(t.split_feature[node])}
+            paths(int(t.left_child[node]), u)
+            paths(int(t.right_child[node]), u)
+
+        if t.num_leaves > 1:
+            paths(0, set())
+
+
+def test_interaction_constraints_exclude_unlisted():
+    X, y = make_binary_problem(n=1500)
+    bst = lgb.train({**BASE, "interaction_constraints": "[0,1]"},
+                    lgb.Dataset(X, label=y), num_boost_round=5)
+    for t in bst._all_trees():
+        for i in range(t.num_leaves - 1):
+            assert int(t.split_feature[i]) in (0, 1)
