@@ -123,24 +123,47 @@ def load_data_file(
     metadata.cpp conventions)."""
     if not os.path.exists(path):
         log_fatal(f"Data file {path} does not exist")
+    # read only a head sample first: format detection + header names need a
+    # few lines, and the native fast path reads the file itself (avoiding a
+    # second full read + full Python line list on the fast path)
     with open(path) as fh:
-        lines = fh.read().splitlines()
+        head = [fh.readline().rstrip("\n") for _ in range(24)]
+    head = [h for h in head if h is not None]
     header_names = None
-    if has_header and lines:
-        first = lines[0]
+    head_data = list(head)
+    if has_header and head:
+        first = head[0]
         sep = "\t" if "\t" in first else ("," if "," in first else None)
         header_names = first.split(sep) if sep else first.split()
-        lines = lines[1:]
+        head_data = head[1:]
 
-    fmt = _detect_format(lines[:20])
+    fmt = _detect_format([ln for ln in head_data if ln.strip()][:20])
+    lines = None
+
+    def all_lines():
+        nonlocal lines
+        if lines is None:
+            with open(path) as fh:
+                lines = fh.read().splitlines()
+            if has_header and lines:
+                lines = lines[1:]
+        return lines
+
     label = weight = group = None
     if fmt == "libsvm":
-        X, label = _parse_libsvm(lines)
+        X, label = _parse_libsvm(all_lines())
         feature_names = None
     else:
-        sep = "\t" if fmt == "tsv" and "\t" in (lines[0] if lines else "") else (
+        first_data = next((ln for ln in head_data if ln.strip()), "")
+        sep = "\t" if fmt == "tsv" and "\t" in first_data else (
             "," if fmt == "csv" else None)
-        data = _parse_dense(lines, sep)
+        # native C++ fast path (native/text_parser.cpp, multithreaded);
+        # the Python parser is the semantics reference and the fallback
+        from ..native import parse_dense_file
+
+        data = parse_dense_file(path, has_header, sep)
+        if data is None:
+            data = _parse_dense(all_lines(), sep)
         label_idx = _resolve_column(label_column, header_names, "label")
         if label_idx is None:
             label_idx = 0 if not is_predict else None

@@ -1,0 +1,103 @@
+"""Native (C++) runtime components, loaded via ctypes.
+
+The reference implements its IO stack in C++ (Parser/TextReader/
+DatasetLoader); this package does the same for the dense-table fast path:
+``text_parser.cpp`` is compiled on first use with the system toolchain into
+a cached shared library and consumed through a C ABI.  Everything degrades
+gracefully to the pure-Python parser when no compiler is available.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+import numpy as np
+
+from ..utils.log import log_info, log_warning
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "text_parser.cpp")
+_LIB_PATH = os.path.join(_DIR, "_libtpugbdt_io.so")
+_lock = threading.Lock()
+_lib = None
+_lib_failed = False
+
+
+def _build() -> bool:
+    # build into a unique temp file + atomic rename so concurrent
+    # first-use builds from multiple processes can never expose a
+    # half-written shared library
+    tmp = f"{_LIB_PATH}.{os.getpid()}.tmp"
+    cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread",
+           "-o", tmp, _SRC]
+    try:
+        res = subprocess.run(cmd, capture_output=True, text=True, timeout=120)
+    except (OSError, subprocess.TimeoutExpired):
+        return False
+    if res.returncode != 0:
+        log_warning("native text parser build failed; using the Python "
+                    f"parser ({res.stderr.strip().splitlines()[-1:]})")
+        return False
+    try:
+        os.replace(tmp, _LIB_PATH)
+    except OSError:
+        return os.path.exists(_LIB_PATH)
+    return True
+
+
+def _load():
+    global _lib, _lib_failed
+    with _lock:
+        if _lib is not None or _lib_failed:
+            return _lib
+        fresh = (os.path.exists(_LIB_PATH)
+                 and os.path.getmtime(_LIB_PATH) >= os.path.getmtime(_SRC))
+        if not fresh and not _build():
+            _lib_failed = True
+            return None
+        try:
+            lib = ctypes.CDLL(_LIB_PATH)
+        except OSError:
+            _lib_failed = True
+            return None
+        lib.tp_open.restype = ctypes.c_void_p
+        lib.tp_open.argtypes = [ctypes.c_char_p, ctypes.c_int, ctypes.c_int]
+        lib.tp_rows.restype = ctypes.c_long
+        lib.tp_rows.argtypes = [ctypes.c_void_p]
+        lib.tp_cols.restype = ctypes.c_long
+        lib.tp_cols.argtypes = [ctypes.c_void_p]
+        lib.tp_fill.restype = ctypes.c_long
+        lib.tp_fill.argtypes = [ctypes.c_void_p,
+                                ctypes.POINTER(ctypes.c_double)]
+        lib.tp_close.restype = None
+        lib.tp_close.argtypes = [ctypes.c_void_p]
+        _lib = lib
+        return _lib
+
+
+def parse_dense_file(path: str, has_header: bool,
+                     sep: Optional[str]) -> Optional[np.ndarray]:
+    """Parse a dense numeric table natively; None -> caller falls back to
+    the Python parser (no compiler, malformed rows, etc.)."""
+    lib = _load()
+    if lib is None:
+        return None
+    sep_char = ord(sep) if sep else 0
+    h = lib.tp_open(path.encode(), 1 if has_header else 0, sep_char)
+    if not h:
+        return None
+    try:
+        rows, cols = lib.tp_rows(h), lib.tp_cols(h)
+        if rows <= 0 or cols <= 0:
+            return None
+        out = np.empty((rows, cols), dtype=np.float64)
+        bad = lib.tp_fill(h, out.ctypes.data_as(ctypes.POINTER(ctypes.c_double)))
+        if bad != 0:
+            return None   # ragged rows: let the Python parser report it
+        return out
+    finally:
+        lib.tp_close(h)

@@ -1,0 +1,74 @@
+"""Native C++ text parser tests — parity with the Python reference parser.
+
+reference: src/io/parser.cpp CSVParser/TSVParser, utils/text_reader.h; the
+Python `_parse_dense` in io/parser.py defines the exact semantics both must
+share.
+"""
+
+import numpy as np
+import pytest
+
+from lightgbmv1_tpu.io.parser import _parse_dense, load_data_file
+from lightgbmv1_tpu.native import parse_dense_file
+
+
+CONTENT_TSV = (
+    "1\t2.5\t-3e2\tnan\n"
+    "# a full comment line\n"
+    "\n"
+    "0\t-1.25\t4\tNA\n"
+    "1\t0\t0.125\t7.5   # trailing comment\n"
+)
+CONTENT_CSV = "1,2.5,-300,na\n0,-1.25,4,\n1,0,0.125,7.5\n"
+CONTENT_WS = "1 2.5 -300 nan\n0 -1.25 4 null\n1 0 0.125 7.5\n"
+
+
+@pytest.mark.parametrize("content,sep", [
+    (CONTENT_TSV, "\t"), (CONTENT_CSV, ","), (CONTENT_WS, None)])
+def test_native_matches_python(tmp_path, content, sep):
+    p = tmp_path / "data.txt"
+    p.write_text(content)
+    native = parse_dense_file(str(p), False, sep)
+    if native is None:
+        pytest.skip("no C++ toolchain available")
+    py = _parse_dense(content.splitlines(), sep)
+    assert native.shape == py.shape
+    np.testing.assert_array_equal(np.isnan(native), np.isnan(py))
+    np.testing.assert_allclose(np.nan_to_num(native), np.nan_to_num(py))
+
+
+def test_native_header_skip(tmp_path):
+    p = tmp_path / "data.csv"
+    p.write_text("a,b,c\n1,2,3\n4,5,6\n")
+    native = parse_dense_file(str(p), True, ",")
+    if native is None:
+        pytest.skip("no C++ toolchain available")
+    np.testing.assert_array_equal(native, [[1, 2, 3], [4, 5, 6]])
+
+
+def test_native_ragged_falls_back(tmp_path):
+    p = tmp_path / "bad.csv"
+    p.write_text("1,2,3\n4,5\n")
+    assert parse_dense_file(str(p), False, ",") is None
+
+
+def test_load_data_file_uses_same_values(tmp_path):
+    rng = np.random.RandomState(0)
+    X = rng.randn(500, 6)
+    y = (X[:, 0] > 0).astype(float)
+    p = tmp_path / "train.tsv"
+    np.savetxt(p, np.column_stack([y, X]), fmt="%.7g", delimiter="\t")
+    df = load_data_file(str(p))
+    np.testing.assert_allclose(df.X, X, rtol=1e-6)
+    np.testing.assert_array_equal(df.label, y)
+
+
+def test_native_large_file_multithreaded(tmp_path):
+    rng = np.random.RandomState(1)
+    data = rng.randn(30000, 8)
+    p = tmp_path / "big.tsv"
+    np.savetxt(p, data, fmt="%.9g", delimiter="\t")
+    native = parse_dense_file(str(p), False, "\t")
+    if native is None:
+        pytest.skip("no C++ toolchain available")
+    np.testing.assert_allclose(native, data, rtol=1e-8)
