@@ -82,7 +82,8 @@ def main():
     def sync():
         jax.device_get(gbdt._train_scores.score)
 
-    # warmup: compiles the scanned multi-iteration step
+    # warmup: compiles the scanned multi-iteration step (same scan length
+    # as the timed block — a different length would recompile)
     gbdt.train_iters(TREES)
     sync()
 
@@ -91,6 +92,20 @@ def main():
     sync()
     dt = time.time() - t0
     row_trees_per_s = N * TREES / dt / 1e6
+
+    # secondary: the reference's own leaf-wise (best-first) policy through
+    # the DataPartition fast path
+    cfg_lw = Config.from_dict({**{k: getattr(cfg, k) for k in (
+        "objective", "num_leaves", "max_bin", "learning_rate",
+        "min_data_in_leaf")}, "verbosity": -1, "tree_growth": "leafwise"})
+    gb_lw = create_boosting(cfg_lw, ds)
+    lw_trees = max(2, TREES // 2)
+    gb_lw.train_iters(lw_trees)
+    jax.device_get(gb_lw._train_scores.score)
+    t0 = time.time()
+    gb_lw.train_iters(lw_trees)
+    jax.device_get(gb_lw._train_scores.score)
+    leafwise_mrt = N * lw_trees / (time.time() - t0) / 1e6
 
     # quality: continue to AUC_ITERS total trees, eval held-out AUC
     remaining = max(AUC_ITERS - gbdt.iter, 0)
@@ -122,6 +137,7 @@ def main():
         # row-trees/s baseline machine is a 28-core dual-Xeon; see PERF.md)
         "ref_cpp_same_host_M_row_trees_per_s": ref_same_host_mrt,
         "vs_ref_same_host": round(row_trees_per_s / ref_same_host_mrt, 4),
+        "leafwise_M_row_trees_per_s": round(leafwise_mrt, 3),
     }))
 
 

@@ -128,7 +128,6 @@ def load_data_file(
     # second full read + full Python line list on the fast path)
     with open(path) as fh:
         head = [fh.readline().rstrip("\n") for _ in range(24)]
-    head = [h for h in head if h is not None]
     header_names = None
     head_data = list(head)
     if has_header and head:

@@ -107,6 +107,8 @@ class GBDT:
             min_data_per_group=float(config.min_data_per_group),
             path_smooth=float(config.path_smooth),
             extra_trees=bool(config.extra_trees),
+            cegb_tradeoff=float(config.cegb_tradeoff),
+            cegb_penalty_split=float(config.cegb_penalty_split),
         )
 
         self._build_trainer()
@@ -157,6 +159,11 @@ class GBDT:
         self._valid_scores: List[_ScoreUpdater] = []
         self._valid_metrics: List[List[Metric]] = []
         self._prev_state = None
+        # CEGB model-level used-feature mask (reference
+        # is_feature_used_in_split_, persists across trees)
+        self._cegb_enabled = (config.cegb_penalty_split > 0
+                              or bool(config.cegb_penalty_feature_coupled))
+        self._cegb_used = jnp.zeros(train_set.num_features, bool)
         self._rng_key = jax.random.PRNGKey(config.seed)
         self._bag_mask: Optional[jax.Array] = None
         self._feat_rng = np.random.RandomState(config.feature_fraction_seed)
@@ -218,7 +225,7 @@ class GBDT:
         rate = cfg.learning_rate if not isinstance(self, RF) else 1.0
 
         def step(binned, valid_binned, train_score, valid_scores, iteration,
-                 feat_masks):
+                 feat_masks, cegb_used):
             # binned/valid_binned ride as arguments (NOT closure constants):
             # closed-over process-spanning global arrays cannot be baked into
             # the jaxpr on multi-host meshes
@@ -233,8 +240,12 @@ class GBDT:
                 g3 = self._sample_g3(grad[:, k], hess[:, k], bag, iteration)
                 key = jax.random.fold_in(self._rng_key, iteration * K + k)
                 tree_dev, leaf_id, _ = self._grow(
-                    binned, g3, feat_masks[k], key
+                    binned, g3, feat_masks[k], key, cegb_used
                 )
+                if self._cegb_enabled:
+                    from .tree import tree_used_features
+                    cegb_used = cegb_used | tree_used_features(
+                        tree_dev, cegb_used.shape[0])
                 shrunk = tree_dev._replace(leaf_value=tree_dev.leaf_value * rate)
                 train_score = train_score.at[:, k].add(shrunk.leaf_value[leaf_id])
                 new_valid = []
@@ -247,7 +258,8 @@ class GBDT:
                 trees.append(shrunk)
                 leaf_ids.append(leaf_id)
             stacked = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *trees)
-            return train_score, valid_scores, stacked, jnp.stack(leaf_ids)
+            return (train_score, valid_scores, stacked, jnp.stack(leaf_ids),
+                    cegb_used)
 
         self._step_fn = step
         return jax.jit(step)
@@ -274,17 +286,18 @@ class GBDT:
             step_fn = self._step_fn
 
             def scan_fn(binned, valid_binned, train_score, valid_scores,
-                        start_iter, feat_masks_all):
+                        start_iter, feat_masks_all, cegb_used):
                 def body(carry, fm):
-                    ts, vs, it = carry
-                    ts, vs, stacked, _ = step_fn(binned, valid_binned,
-                                                 ts, vs, it, fm)
-                    return (ts, vs, it + 1), stacked
+                    ts, vs, it, cu = carry
+                    ts, vs, stacked, _, cu = step_fn(binned, valid_binned,
+                                                     ts, vs, it, fm, cu)
+                    return (ts, vs, it + 1, cu), stacked
 
-                (ts, vs, _), trees = jax.lax.scan(
-                    body, (train_score, valid_scores, start_iter), feat_masks_all
+                (ts, vs, _, cu), trees = jax.lax.scan(
+                    body, (train_score, valid_scores, start_iter, cegb_used),
+                    feat_masks_all
                 )
-                return ts, vs, trees
+                return ts, vs, trees, cu
 
             self._scan = jax.jit(scan_fn)
 
@@ -296,10 +309,11 @@ class GBDT:
         vscores = tuple(vs.score for vs in self._valid_scores)
         self._save_rollback_state()
         with global_timer.section("GBDT::TrainIters(dispatch)"):
-            new_train, new_valid, trees = self._scan(
+            new_train, new_valid, trees, self._cegb_used = self._scan(
                 self._grow_binned, tuple(self._valid_binned),
                 self._train_scores.score, vscores,
                 jnp.asarray(self.iter, jnp.int32), feat_masks,
+                self._cegb_used,
             )
         self._train_scores.score = new_train
         for vs, s in zip(self._valid_scores, new_valid):
@@ -324,10 +338,12 @@ class GBDT:
         )
         vscores = tuple(vs.score for vs in self._valid_scores)
         with global_timer.section("GBDT::TrainOneIter(dispatch)"):
-            new_train, new_valid, stacked, leaf_ids = self._step(
+            (new_train, new_valid, stacked, leaf_ids,
+             self._cegb_used) = self._step(
                 self._grow_binned, tuple(self._valid_binned),
                 self._train_scores.score, vscores,
                 jnp.asarray(self.iter, jnp.int32), feat_masks,
+                self._cegb_used,
             )
         self._train_scores.score = new_train
         for vs, s in zip(self._valid_scores, new_valid):
@@ -463,7 +479,12 @@ class GBDT:
             g3 = self._sample_g3(grad[:, k], hess[:, k], bag, self.iter)
             key = jax.random.fold_in(self._rng_key, self.iter * self.num_class + k)
             base_mask = jnp.asarray(self._tree_feature_mask())
-            tree_dev, leaf_id, root_sum = self._grow(self._grow_binned, g3, base_mask, key)
+            tree_dev, leaf_id, root_sum = self._grow(
+                self._grow_binned, g3, base_mask, key, self._cegb_used)
+            if self._cegb_enabled:
+                from .tree import tree_used_features
+                self._cegb_used = self._cegb_used | tree_used_features(
+                    tree_dev, self._cegb_used.shape[0])
             new_trees.append(self._finish_tree(tree_dev, leaf_id, k))
         self.iter += 1
         stopped = False
@@ -767,7 +788,12 @@ class DART(GBDT):
             g3 = self._sample_g3(grad[:, k], hess[:, k], bag, self.iter)
             key = jax.random.fold_in(self._rng_key, self.iter * self.num_class + k)
             base_mask = jnp.asarray(self._tree_feature_mask())
-            tree_dev, leaf_id, _ = self._grow(self._grow_binned, g3, base_mask, key)
+            tree_dev, leaf_id, _ = self._grow(
+                self._grow_binned, g3, base_mask, key, self._cegb_used)
+            if self._cegb_enabled:
+                from .tree import tree_used_features
+                self._cegb_used = self._cegb_used | tree_used_features(
+                    tree_dev, self._cegb_used.shape[0])
             new_trees.append(
                 self._finish_tree(tree_dev, leaf_id, k, shrinkage=lr * new_factor)
             )
@@ -912,7 +938,12 @@ class RF(GBDT):
             g3 = self._sample_g3(grad[:, k], hess[:, k], bag, self.iter)
             key = jax.random.fold_in(self._rng_key, self.iter * self.num_class + k)
             base_mask = jnp.asarray(self._tree_feature_mask())
-            tree_dev, leaf_id, _ = self._grow(self._grow_binned, g3, base_mask, key)
+            tree_dev, leaf_id, _ = self._grow(
+                self._grow_binned, g3, base_mask, key, self._cegb_used)
+            if self._cegb_enabled:
+                from .tree import tree_used_features
+                self._cegb_used = self._cegb_used | tree_used_features(
+                    tree_dev, self._cegb_used.shape[0])
             new_trees.append(self._finish_tree(tree_dev, leaf_id, k, shrinkage=1.0))
         self.iter += 1
         if custom_grad is None and check_stop:

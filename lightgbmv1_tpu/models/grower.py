@@ -67,6 +67,12 @@ class GrowerState(NamedTuple):
     leaf_out: jax.Array       # (L,) — current leaf output values (smoothing)
     leaf_used: jax.Array      # (L, F) bool — branch features per leaf
                               # (reference Tree::branch_features)
+    cegb_used: jax.Array      # (F,) bool — model-level used features (CEGB)
+    order: jax.Array          # (N+CAPMAX,) int32 — rows grouped by leaf
+                              # (reference DataPartition indices_; ghost
+                              # entries hold N). dummy (1,) when masked mode
+    leaf_begin: jax.Array     # (L,) int32 — segment begin per leaf
+    leaf_phys: jax.Array      # (L,) int32 — physical rows per leaf
     tree: TreeArrays
     leaf_is_left: jax.Array   # (L,) bool
     num_leaves: jax.Array     # () int32
@@ -98,11 +104,20 @@ def make_leafwise_grower(
     monotone_penalty: float = 0.0,
     interaction_groups=None,
     forced_splits=None,
+    cegb_coupled=None,
+    partition: bool = False,
     hist_fn: Callable = None,
     split_fn: Callable = None,
     sums_fn: Callable = None,
 ):
     """Build the jittable ``grow(binned, g3, base_mask, key)`` function.
+
+    ``partition=True`` selects the DataPartition-based fast path (reference:
+    src/treelearner/data_partition.hpp — rows kept grouped by leaf in an
+    index array): each split only touches its parent's segment and the
+    smaller child's histogram is built over COMPACTED rows, so per-split
+    cost is O(segment) instead of O(num_data).  Dynamic segment sizes are
+    bucketed into a few static capacities dispatched with ``lax.switch``.
 
     ``forced_splits``: optional (S, 4) int array [leaf, feature, bin,
     default_left] applied as the first S steps in BFS order (reference:
@@ -134,14 +149,31 @@ def make_leafwise_grower(
         f_bin = jnp.asarray(forced_splits[:S_forced, 2], jnp.int32)
         f_dl = jnp.asarray(forced_splits[:S_forced, 3] != 0)
 
+    use_cegb = (params.cegb_penalty_split > 0) or (cegb_coupled is not None)
+    coupled = (jnp.asarray(cegb_coupled, jnp.float32)
+               if cegb_coupled is not None else None)
+
+    def cegb_penalty_vec(parent_cnt, used_model):
+        """reference: CostEfficientGradientBoosting::DetlaGain —
+        tradeoff*(penalty_split*n_leaf + coupled_penalty[unused features])."""
+        if not use_cegb:
+            return None
+        pen = jnp.full(meta.num_bins.shape[0],
+                       params.cegb_tradeoff * params.cegb_penalty_split
+                       * parent_cnt, jnp.float32)
+        if coupled is not None:
+            pen = pen + params.cegb_tradeoff * coupled * (
+                ~used_model).astype(jnp.float32)
+        return pen
+
     if split_fn is None:
         def split_fn(hist, parent, mask, key, uid, constraint, depth,
-                     parent_output):
+                     parent_output, cegb_pen=None):
             rk = jax.random.fold_in(key, uid + 1_000_003) \
                 if params.extra_trees else None
             return find_best_split(hist, parent, meta, mask, params,
                                    constraint, depth, monotone_penalty,
-                                   parent_output, rk)
+                                   parent_output, rk, cegb_pen)
 
     def allowed_features(used):
         """reference GetByNode: branch features + union of constraint
@@ -176,10 +208,103 @@ def make_leafwise_grower(
         go_left = jnp.where(is_cat, in_set, go_left)
         return jnp.where((leaf_id == leaf) & (~go_left), new_leaf, leaf_id)
 
-    def grow(binned, g3, base_mask, key):
+    def grow(binned, g3, base_mask, key, cegb_used=None):
         N = binned.shape[1]
         F = binned.shape[0]
         B = num_bins
+        if cegb_used is None:
+            cegb_used = jnp.zeros(F, bool)
+
+        # ---- bucketed static capacities for the partition fast path -----
+        if partition:
+            caps = []
+            c = 2048
+            while c < N:
+                caps.append(c)
+                c *= 2
+            caps.append(N)
+            capmax = caps[-1]
+
+            def bucket_of(n):
+                b = jnp.zeros((), jnp.int32)
+                for cc in caps[:-1]:
+                    b = b + (n > cc).astype(jnp.int32)
+                return b
+
+            def partition_segment(order, s_begin, n_p, feat, thr, dl,
+                                  iscat, bitset):
+                """Stable two-way partition of one leaf's segment
+                (reference DataPartition::Split, data_partition.hpp:101)."""
+                bins_row = binned[feat]                    # (N,)
+
+                def make_branch(CAP):
+                    def br(op):
+                        order, s_begin, n_p, thr, dl, iscat, bitset = op
+                        seg = lax.dynamic_slice(order, (s_begin,), (CAP,))
+                        bseg = jnp.take(bins_row, seg, mode="fill",
+                                        fill_value=0)
+                        valid = jnp.arange(CAP) < n_p
+                        is_na = (meta.missing_type[feat] == MISSING_NAN) & (
+                            bseg == meta.nan_bin[feat])
+                        gl = jnp.where(is_na, dl, bseg <= thr)
+                        bi = bseg.astype(jnp.int32)
+                        word = bitset[bi >> 5]
+                        in_set = ((word >> (bi.astype(jnp.uint32) & 31))
+                                  & 1) == 1
+                        gl = jnp.where(iscat, in_set, gl) & valid
+                        n_l = gl.sum().astype(jnp.int32)
+                        posl = jnp.where(gl, size=CAP, fill_value=CAP)[0]
+                        posr = jnp.where((~gl) & valid, size=CAP,
+                                         fill_value=CAP)[0]
+                        lrows = jnp.take(seg, posl, mode="fill", fill_value=N)
+                        rrows = jnp.take(seg, posr, mode="fill", fill_value=N)
+                        pos = jnp.arange(CAP)
+                        rpick = jnp.take(rrows,
+                                         jnp.clip(pos - n_l, 0, CAP - 1))
+                        comb = jnp.where(pos < n_l, lrows, rpick)
+                        comb = jnp.where(valid, comb, seg)  # ghosts untouched
+                        order2 = lax.dynamic_update_slice(order, comb,
+                                                          (s_begin,))
+                        return order2, n_l
+                    return br
+
+                return lax.switch(
+                    bucket_of(n_p), [make_branch(cc) for cc in caps],
+                    (order, s_begin, n_p, thr, dl, iscat, bitset))
+
+            def hist_compact(order, s_begin, n_s):
+                """Histogram of one COMPACTED segment (the smaller child)
+                — the reference's ordered-gradient smaller-leaf pass.  The
+                slice capacity can exceed the segment, so rows beyond n_s
+                (they belong to OTHER leaves) are zero-masked."""
+                def make_branch(CAP):
+                    def br(op):
+                        order, s_begin, n_s = op
+                        rows = lax.dynamic_slice(order, (s_begin,), (CAP,))
+                        in_seg = jnp.arange(CAP) < n_s
+                        bins_sub = jnp.take(binned, rows, axis=1,
+                                            mode="fill", fill_value=0)
+                        g3_sub = jnp.take(g3, rows, axis=0, mode="fill",
+                                          fill_value=0.0)
+                        g3_sub = jnp.where(in_seg[:, None], g3_sub, 0.0)
+                        return hist_fn(bins_sub, g3_sub,
+                                       jnp.zeros(CAP, jnp.int32),
+                                       jnp.asarray(0, jnp.int32))
+                    return br
+
+                return lax.switch(
+                    bucket_of(n_s), [make_branch(cc) for cc in caps],
+                    (order, s_begin, n_s))
+
+            order0 = jnp.concatenate([
+                jnp.arange(N, dtype=jnp.int32),
+                jnp.full(capmax, N, jnp.int32)])
+            leaf_begin0 = jnp.zeros(L, jnp.int32)
+            leaf_phys0 = jnp.zeros(L, jnp.int32).at[0].set(N)
+        else:
+            order0 = jnp.zeros(1, jnp.int32)
+            leaf_begin0 = jnp.zeros(L, jnp.int32)
+            leaf_phys0 = jnp.zeros(L, jnp.int32)
 
         leaf_id = jnp.zeros(N, jnp.int32)
         hist0 = hist_fn(binned, g3, leaf_id, jnp.asarray(0, jnp.int32))
@@ -191,7 +316,8 @@ def make_leafwise_grower(
         out0 = leaf_output(root_sum[0], root_sum[1], params)
         if params.path_smooth > 0:
             out0 = smooth_output(out0, root_sum[2], 0.0, params)
-        res0 = split_fn(hist0, root_sum, mask0, key, 0, no_constr, 0, out0)
+        res0 = split_fn(hist0, root_sum, mask0, key, 0, no_constr, 0, out0,
+                        cegb_penalty_vec(root_sum[2], cegb_used))
 
         from ..models.tree import empty_tree
 
@@ -212,6 +338,10 @@ def make_leafwise_grower(
             leaf_constr=jnp.tile(jnp.asarray(NO_CONSTRAINT, jnp.float32), (L, 1)),
             leaf_out=jnp.zeros(L, jnp.float32).at[0].set(out0),
             leaf_used=jnp.zeros((L, F), bool),
+            cegb_used=cegb_used,
+            order=order0,
+            leaf_begin=leaf_begin0,
+            leaf_phys=leaf_phys0,
             tree=empty_tree(L, W),
             leaf_is_left=jnp.zeros(L, bool),
             num_leaves=jnp.asarray(1, jnp.int32),
@@ -267,8 +397,16 @@ def make_leafwise_grower(
                                        jnp.zeros_like(bitset), bitset)
                 parent_sum = st.leaf_sums[leaf]
 
-                leaf_id = apply_decision(binned, st.leaf_id, leaf, nl, feat,
-                                         thr, dl, iscat, bitset)
+                if partition:
+                    s_begin = st.leaf_begin[leaf]
+                    n_p = st.leaf_phys[leaf]
+                    order2, n_l_phys = partition_segment(
+                        st.order, s_begin, n_p, feat, thr, dl, iscat, bitset)
+                    leaf_id = st.leaf_id      # reconstructed once at the end
+                else:
+                    order2, n_l_phys = st.order, jnp.asarray(0, jnp.int32)
+                    leaf_id = apply_decision(binned, st.leaf_id, leaf, nl,
+                                             feat, thr, dl, iscat, bitset)
 
                 # monotone constraint propagation (reference:
                 # BasicLeafConstraints::Update, monotone_constraints.hpp:99-117)
@@ -294,9 +432,17 @@ def make_leafwise_grower(
                     constr_l = constr_r = pconstr
 
                 # histogram-subtraction trick: one pass over the smaller child
-                smaller_is_left = lsum[2] <= rsum[2]
-                smaller = jnp.where(smaller_is_left, leaf, nl)
-                h_small = hist_fn(binned, g3, leaf_id, smaller)
+                if partition:
+                    n_r_phys = n_p - n_l_phys
+                    smaller_is_left = n_l_phys <= n_r_phys
+                    sm_begin = jnp.where(smaller_is_left, s_begin,
+                                         s_begin + n_l_phys)
+                    sm_n = jnp.minimum(n_l_phys, n_r_phys)
+                    h_small = hist_compact(order2, sm_begin, sm_n)
+                else:
+                    smaller_is_left = lsum[2] <= rsum[2]
+                    smaller = jnp.where(smaller_is_left, leaf, nl)
+                    h_small = hist_fn(binned, g3, leaf_id, smaller)
                 h_parent = st.hist_pool[leaf]
                 h_left = jnp.where(smaller_is_left, h_small, h_parent - h_small)
                 h_right = h_parent - h_left
@@ -313,10 +459,14 @@ def make_leafwise_grower(
                 mask_r = _node_feature_mask(
                     key, 2 * s + 2, base_mask, feature_fraction_bynode
                 ) & allow_child
+                cegb_next = st.cegb_used.at[feat].set(True) \
+                    if use_cegb else st.cegb_used
                 res_l = split_fn(h_left, lsum, mask_l, key, 2 * s + 1,
-                                 constr_l, d, out_l)
+                                 constr_l, d, out_l,
+                                 cegb_penalty_vec(lsum[2], cegb_next))
                 res_r = split_fn(h_right, rsum, mask_r, key, 2 * s + 2,
-                                 constr_r, d, out_r)
+                                 constr_r, d, out_r,
+                                 cegb_penalty_vec(rsum[2], cegb_next))
                 gain_l = jnp.where(depth_ok, res_l.gain, -jnp.inf)
                 gain_r = jnp.where(depth_ok, res_r.gain, -jnp.inf)
 
@@ -374,6 +524,14 @@ def make_leafwise_grower(
                     leaf_out=st.leaf_out.at[leaf].set(out_l).at[nl].set(out_r),
                     leaf_used=st.leaf_used.at[leaf].set(used_child)
                     .at[nl].set(used_child),
+                    cegb_used=cegb_next,
+                    order=order2,
+                    leaf_begin=st.leaf_begin.at[nl].set(
+                        st.leaf_begin[leaf] + n_l_phys) if partition
+                    else st.leaf_begin,
+                    leaf_phys=st.leaf_phys.at[leaf].set(n_l_phys)
+                    .at[nl].set(st.leaf_phys[leaf] - n_l_phys) if partition
+                    else st.leaf_phys,
                     tree=tree,
                     leaf_is_left=st.leaf_is_left.at[leaf].set(True).at[nl].set(False),
                     num_leaves=nl + 1,
@@ -386,6 +544,23 @@ def make_leafwise_grower(
             return lax.cond(active, do_split, no_split, st)
 
         st = lax.fori_loop(0, L - 1, body, st) if L > 1 else st
+        if partition and L > 1:
+            # reconstruct the per-row leaf assignment from the partition
+            # (one pass; the loop never touched the O(N) leaf_id array):
+            # sort active segments by begin, find each position's segment by
+            # searchsorted, then scatter through the row order.
+            beg_eff = jnp.where(st.leaf_phys > 0, st.leaf_begin,
+                                N + 1 + jnp.arange(L))
+            leaf_order = jnp.argsort(beg_eff)
+            sorted_begin = beg_eff[leaf_order]
+            pos = jnp.arange(N)
+            ordinal = jnp.clip(
+                jnp.searchsorted(sorted_begin, pos, side="right") - 1, 0, L - 1)
+            pos_leaf = leaf_order[ordinal].astype(jnp.int32)
+            rows = st.order[:N]
+            leaf_id_final = jnp.zeros(N, jnp.int32).at[rows].set(
+                pos_leaf, mode="drop", unique_indices=True)
+            return st.tree, leaf_id_final, root_sum
         return st.tree, st.leaf_id, root_sum
 
     return grow
@@ -406,6 +581,7 @@ def make_levelwise_grower(
     feature_fraction_bynode: float = 1.0,
     monotone_penalty: float = 0.0,
     interaction_groups=None,
+    cegb_coupled=None,
     hist_frontier_fn: Callable = None,
     split_fn: Callable = None,
     sums_fn: Callable = None,
@@ -437,14 +613,29 @@ def make_levelwise_grower(
     groups_lw = (jnp.asarray(interaction_groups)
                  if interaction_groups is not None else None)
 
+    use_cegb_lw = (params.cegb_penalty_split > 0) or (cegb_coupled is not None)
+    coupled_lw = (jnp.asarray(cegb_coupled, jnp.float32)
+                  if cegb_coupled is not None else None)
+
+    def cegb_penalty_batch(parent_cnt, used_model):
+        if not use_cegb_lw:
+            return None
+        F = meta.num_bins.shape[0]
+        pen = (params.cegb_tradeoff * params.cegb_penalty_split
+               * parent_cnt[:, None]) * jnp.ones((1, F), jnp.float32)
+        if coupled_lw is not None:
+            pen = pen + params.cegb_tradeoff * coupled_lw[None, :] * (
+                ~used_model)[None, :].astype(jnp.float32)
+        return pen
+
     if split_fn is None:
         def split_fn(hist, parent, mask, key, uid, constraint, depth,
-                     parent_output):
+                     parent_output, cegb_pen=None):
             rk = jax.random.fold_in(key, uid + 1_000_003) \
                 if params.extra_trees else None
             return find_best_split(hist, parent, meta, mask, params,
                                    constraint, depth, monotone_penalty,
-                                   parent_output, rk)
+                                   parent_output, rk, cegb_pen)
 
     if sums_fn is None:
         def sums_fn(g3):
@@ -464,9 +655,11 @@ def make_levelwise_grower(
             return out
         return jnp.clip(out, constr[:, 0], constr[:, 1])
 
-    def grow(binned, g3, base_mask, key):
+    def grow(binned, g3, base_mask, key, cegb_used=None):
         N = binned.shape[1]
         F = binned.shape[0]
+        if cegb_used is None:
+            cegb_used = jnp.zeros(F, bool)
         from .tree import empty_tree
 
         leaf_id = jnp.zeros(N, jnp.int32)
@@ -497,9 +690,17 @@ def make_levelwise_grower(
             else:
                 masks = jnp.broadcast_to(base_mask, (Ld, F))
             masks = masks & allowed_features_batch(leaf_used[:Ld])
-            res = jax.vmap(
-                lambda h, p, m, c, po: split_fn(h, p, m, key, d, c, d, po)
-            )(hist, leaf_sums[:Ld], masks, leaf_constr[:Ld], leaf_out[:Ld])
+            cegb_pen = cegb_penalty_batch(leaf_sums[:Ld, 2], cegb_used)
+            if cegb_pen is None:
+                res = jax.vmap(
+                    lambda h, p, m, c, po: split_fn(h, p, m, key, d, c, d, po)
+                )(hist, leaf_sums[:Ld], masks, leaf_constr[:Ld], leaf_out[:Ld])
+            else:
+                res = jax.vmap(
+                    lambda h, p, m, c, po, cp: split_fn(
+                        h, p, m, key, d, c, d, po, cp)
+                )(hist, leaf_sums[:Ld], masks, leaf_constr[:Ld],
+                  leaf_out[:Ld], cegb_pen)
 
             gains = jnp.where(leaf_active[:Ld], res.gain, -jnp.inf)
             want = gains > 0
@@ -602,6 +803,10 @@ def make_levelwise_grower(
                 .at[nl].set(constr_r, mode="drop")
             leaf_out = leaf_out.at[ld_idx].set(left_out, mode="drop") \
                 .at[nl].set(right_out, mode="drop")
+            if use_cegb_lw:
+                cegb_used = cegb_used | jnp.any(
+                    jax.nn.one_hot(res.feature, F, dtype=bool)
+                    & split_mask[:, None], axis=0)
             used_child = leaf_used[:Ld] | jax.nn.one_hot(
                 res.feature, F, dtype=bool)
             leaf_used = leaf_used.at[ld_idx].set(used_child, mode="drop") \

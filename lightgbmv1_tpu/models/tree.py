@@ -168,6 +168,16 @@ def tree_predict_raw(tree: TreeArrays, X: jax.Array) -> jax.Array:
     return tree.leaf_value[-node - 1]
 
 
+def tree_used_features(tree: TreeArrays, num_features: int) -> jax.Array:
+    """(F,) bool — features used by this tree's valid internal nodes
+    (CEGB model-level used-feature tracking, the analog of
+    is_feature_used_in_split_ in cost_effective_gradient_boosting.hpp)."""
+    n_nodes = tree.split_feature.shape[0]
+    valid = jnp.arange(n_nodes) < (tree.num_leaves - 1)
+    oh = jax.nn.one_hot(tree.split_feature, num_features, dtype=bool)
+    return jnp.any(oh & valid[:, None], axis=0)
+
+
 def stack_trees(trees: List[TreeArrays]) -> TreeArrays:
     return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *trees)
 

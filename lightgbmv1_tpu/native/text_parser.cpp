@@ -33,6 +33,15 @@ struct ParsedFile {
   char sep = 0;  // 0 = any whitespace
 };
 
+bool is_hex_like(const char* b, size_t n) {
+  // strtod accepts C99 hex floats ('0x1A'); the Python reference parser
+  // does not — reject so such files fall back loudly
+  for (size_t i = 0; i + 1 < n; ++i) {
+    if ((b[i] == 'x' || b[i] == 'X')) return true;
+  }
+  return false;
+}
+
 bool is_nan_token(const char* b, size_t n) {
   if (n == 0) return true;
   static const char* toks[] = {"na", "nan", "NA", "NaN", "null"};
@@ -65,10 +74,9 @@ long parse_line(const ParsedFile& pf, size_t li, double* out, long max_cols) {
         } else {
           char* endp = nullptr;
           double v = std::strtod(tok, &endp);
-          // the token must be FULLY consumed: partial parses ('12.5.3',
-          // '0x10' under odd locales) must fail loudly via the Python
-          // fallback instead of silently truncating
-          if (endp != p) return -2;
+          // the token must be FULLY consumed and not a hex float: partial
+          // or hex parses must fail loudly via the Python fallback
+          if (endp != p || is_hex_like(tok, p - tok)) return -2;
           out[col] = v;
         }
       }
@@ -90,7 +98,7 @@ long parse_line(const ParsedFile& pf, size_t li, double* out, long max_cols) {
         } else {
           char* endp = nullptr;
           double v = std::strtod(tb, &endp);
-          if (endp != te) return -2;
+          if (endp != te || is_hex_like(tb, te - tb)) return -2;
           out[col] = v;
         }
       }

@@ -56,6 +56,10 @@ class SplitParams(NamedTuple):
     # feature_histogram.hpp:756-760) and extremely-randomized trees
     path_smooth: float = 0.0
     extra_trees: bool = False
+    # cost-effective gradient boosting (reference
+    # cost_effective_gradient_boosting.hpp:22 DetlaGain)
+    cegb_tradeoff: float = 1.0
+    cegb_penalty_split: float = 0.0
 
 
 class SplitResult(NamedTuple):
@@ -172,7 +176,8 @@ def bitset_contains(bitset: jax.Array, bins: jax.Array) -> jax.Array:
     return ((word >> (b.astype(jnp.uint32) & 31)) & 1) == 1
 
 
-def _best_categorical(hist, parent_sum, meta, feature_mask, params):
+def _best_categorical(hist, parent_sum, meta, feature_mask, params,
+                      cegb_penalty=None):
     """Best categorical split across all features of one leaf.
 
     reference: FindBestThresholdCategoricalInner,
@@ -208,6 +213,8 @@ def _best_categorical(hist, parent_sum, meta, feature_mask, params):
         & (oth_h - eps >= params.min_sum_hessian_in_leaf)
     )
     gain1 = leaf_gain(g, h + eps, params) + leaf_gain(oth_g, oth_h - eps, params)
+    if cegb_penalty is not None:
+        gain1 = gain1 - cegb_penalty[:, None]
     gain1 = jnp.where(ok1, gain1, NEG_INF)
 
     # ---- sorted two-direction scan (reference :371-470) ------------------
@@ -255,6 +262,8 @@ def _best_categorical(hist, parent_sum, meta, feature_mask, params):
     can_eval = jnp.pad(can_eval, ((0, 0), (0, 0), (0, B - n_steps)))
 
     gain2 = leaf_gain(clg, clh, l2cat) + leaf_gain(crg, crh, l2cat)
+    if cegb_penalty is not None:
+        gain2 = gain2 - cegb_penalty[None, :, None]
     gain2 = jnp.where(can_eval, gain2, NEG_INF)        # (2, F, B)
 
     # ---- pick the best categorical candidate -----------------------------
@@ -302,6 +311,7 @@ def find_best_split(
     monotone_penalty: float = 0.0,
     parent_output=0.0,        # this leaf's current output (path smoothing)
     rand_key: Optional[jax.Array] = None,    # extra_trees threshold sampling
+    cegb_penalty: Optional[jax.Array] = None,  # (F,) CEGB gain penalty
 ) -> SplitResult:
     F, B, _ = hist.shape
     total_g, total_h, total_c = parent_sum[0], parent_sum[1], parent_sum[2]
@@ -385,6 +395,13 @@ def find_best_split(
         mono_f = (meta.monotone_type != 0)[None, :, None]
         gains = jnp.where(
             jnp.isfinite(gains) & mono_f, (gains - pg) * factor + pg, gains)
+    if cegb_penalty is not None:
+        # reference: new_split.gain -= cegb_->DetlaGain(...) AFTER the
+        # monotone depth-penalty scaling
+        # (serial_tree_learner.cpp FindBestSplitsFromHistograms); the delta
+        # is feature-wise constant for a given leaf
+        gains = jnp.where(jnp.isfinite(gains),
+                          gains - cegb_penalty[None, :, None], gains)
     flat = gains.reshape(-1)
     best = jnp.argmax(flat)
     best_gain = flat[best]
@@ -402,7 +419,8 @@ def find_best_split(
     W = -(-B // 32)
     if has_cat:
         cgain, cfeat, cleft, cbitset = _best_categorical(
-            hist, parent_sum, meta, feature_mask, params)
+            hist, parent_sum, meta, feature_mask, params,
+            cegb_penalty=cegb_penalty)
         use_cat = cgain > best_gain
         best_gain = jnp.maximum(best_gain, cgain)
         feature = jnp.where(use_cat, cfeat, feature)

@@ -113,18 +113,22 @@ def parse_interaction_constraints(spec, num_features: int):
 def _warn_unimplemented(config: Config) -> None:
     """Loudly reject accepted-but-unimplemented parameters instead of
     silently ignoring them (the reference either enforces or rejects)."""
-    checks = [
-        ("cegb_tradeoff", config.cegb_tradeoff != 1.0),
-        ("cegb_penalty_split", config.cegb_penalty_split != 0.0),
-        ("cegb_penalty_feature_lazy", bool(config.cegb_penalty_feature_lazy)),
-        ("cegb_penalty_feature_coupled",
-         bool(config.cegb_penalty_feature_coupled)),
-    ]
-    for name, is_set in checks:
-        if is_set:
-            log_warning(
-                f"{name} is set but cost-effective gradient boosting is not "
-                "implemented in this build — the parameter has NO effect")
+    if config.cegb_penalty_feature_lazy:
+        log_warning(
+            "cegb_penalty_feature_lazy (per-row on-demand feature costs) is "
+            "not implemented — the parameter has NO effect; split and "
+            "coupled-feature CEGB penalties ARE enforced")
+
+
+def _cegb_coupled(config: Config, num_features: int):
+    """cegb_penalty_feature_coupled padded/validated -> (F,) or None."""
+    pen = config.cegb_penalty_feature_coupled
+    if not pen:
+        return None
+    if len(pen) != num_features:
+        log_fatal("cegb_penalty_feature_coupled should be the same size as "
+                  f"feature number ({len(pen)} vs {num_features})")
+    return np.asarray(pen, np.float64)
 
 
 def parse_forced_splits(filename: str, bin_mappers, num_leaves: int):
@@ -208,6 +212,7 @@ def build_trainer(
         monotone_penalty=config.monotone_penalty,
         interaction_groups=parse_interaction_constraints(
             config.interaction_constraints, F),
+        cegb_coupled=_cegb_coupled(config, F),
     )
     forced = None
     if config.forcedsplits_filename:
@@ -224,8 +229,12 @@ def build_trainer(
         if levelwise:
             grow = make_levelwise_grower(hist_frontier_fn=local_frontier, **common)
         else:
-            grow = make_leafwise_grower(hist_fn=local_hist,
-                                        forced_splits=forced, **common)
+            # the DataPartition-based fast path is the serial default;
+            # tree_growth=leafwise_masked keeps the O(N)-per-split variant
+            grow = make_leafwise_grower(
+                hist_fn=local_hist, forced_splits=forced,
+                partition=(config.tree_growth != "leafwise_masked"),
+                **common)
         return jax.jit(grow), jnp.asarray(binned_np), N
 
     if learner == "voting" and levelwise:
@@ -266,7 +275,7 @@ def build_trainer(
             return lax.psum(g3.sum(axis=0), "data")
 
         def split_fn(local_hist, parent, mask, key, uid, constraint, depth,
-                     parent_output):
+                     parent_output, cegb_pen=None):
             # local parent stats: any feature's bin sums cover the shard rows
             local_parent = local_hist[0].sum(axis=0)
             gains = per_feature_best_gain(local_hist, local_parent, meta,
@@ -286,14 +295,15 @@ def build_trainer(
                 if params.extra_trees else None
             return find_best_split(full, parent, meta, mask & sel_mask,
                                    params, constraint, depth,
-                                   config.monotone_penalty, parent_output, rk)
+                                   config.monotone_penalty, parent_output,
+                                   rk, cegb_pen)
 
         grow = make_leafwise_grower(
             hist_fn=hist_fn, split_fn=split_fn, sums_fn=sums_fn, **common)
         sharded = shard_map(
             grow,
             mesh=mesh,
-            in_specs=(P(None, "data"), P("data", None), P(), P()),
+            in_specs=(P(None, "data"), P("data", None), P(), P(), P()),
             out_specs=(
                 jax.tree_util.tree_map(lambda _: P(), TreeArrays(
                     *([0] * len(TreeArrays._fields)))),
@@ -304,10 +314,11 @@ def build_trainer(
         )
 
         @jax.jit
-        def grow_fn(binned, g3, base_mask, key):
+        def grow_fn(binned, g3, base_mask, key, cegb_used):
             pad = N_pad - N
             g3p = jnp.pad(g3, ((0, pad), (0, 0)))
-            tree, leaf_id, root = sharded(binned, g3p, base_mask, key)
+            tree, leaf_id, root = sharded(binned, g3p, base_mask, key,
+                                          cegb_used)
             return tree, leaf_id[:N], root
 
         return grow_fn, binned_dev, N
@@ -353,7 +364,7 @@ def build_trainer(
         sharded = shard_map(
             grow,
             mesh=mesh,
-            in_specs=(P(None, "data"), P("data", None), P(), P()),
+            in_specs=(P(None, "data"), P("data", None), P(), P(), P()),
             out_specs=(
                 jax.tree_util.tree_map(lambda _: P(), TreeArrays(
                     *([0] * len(TreeArrays._fields)))),
@@ -364,10 +375,11 @@ def build_trainer(
         )
 
         @jax.jit
-        def grow_fn(binned, g3, base_mask, key):
+        def grow_fn(binned, g3, base_mask, key, cegb_used):
             pad = N_pad - N
             g3p = jnp.pad(g3, ((0, pad), (0, 0)))
-            tree, leaf_id, root = sharded(binned, g3p, base_mask, key)
+            tree, leaf_id, root = sharded(binned, g3p, base_mask, key,
+                                          cegb_used)
             return tree, leaf_id[:N], root
 
         return grow_fn, binned_dev, N
@@ -412,7 +424,7 @@ def build_trainer(
             return lax.dynamic_update_slice(full, h, (lo, 0, 0))
 
         def split_fn(hist, parent, mask, key, uid, constraint, depth,
-                     parent_output):
+                     parent_output, cegb_pen=None):
             # search only this device's features, then Allreduce-max over
             # packed SplitInfo (reference SyncUpGlobalBestSplit)
             lo = lax.axis_index("feature") * F_loc
@@ -426,7 +438,7 @@ def build_trainer(
             local = find_best_split(hist, parent, meta_p, mask & in_shard,
                                     params, constraint, depth,
                                     config.monotone_penalty, parent_output,
-                                    rk)
+                                    rk, cegb_pen)
             packed = _pack_split(local)
             allp = lax.all_gather(packed, "feature")        # (ndev, 10)
             best = jnp.argmax(allp[:, 0])
@@ -444,7 +456,7 @@ def build_trainer(
         sharded = shard_map(
             grow,
             mesh=mesh,
-            in_specs=(P(None, None), P(None, None), P(), P()),
+            in_specs=(P(None, None), P(None, None), P(), P(), P()),
             out_specs=(
                 jax.tree_util.tree_map(lambda _: P(), TreeArrays(
                     *([0] * len(TreeArrays._fields)))),
@@ -455,9 +467,10 @@ def build_trainer(
         )
 
         @jax.jit
-        def grow_fn(binned, g3, base_mask, key):
+        def grow_fn(binned, g3, base_mask, key, cegb_used):
             maskp = jnp.pad(base_mask, (0, pad_f))
-            return sharded(binned, g3, maskp, key)
+            return sharded(binned, g3, maskp, key,
+                           jnp.pad(cegb_used, (0, pad_f)))
 
         return grow_fn, binned_dev, N
 

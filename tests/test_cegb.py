@@ -1,0 +1,80 @@
+"""Cost-effective gradient boosting tests.
+
+reference: CostEfficientGradientBoosting
+(src/treelearner/cost_effective_gradient_boosting.hpp:22 — DetlaGain =
+tradeoff*(penalty_split*n_leaf + coupled_penalty[first use of feature]));
+engine coverage via test_basic CEGB scaling equalities (test_basic.py:221).
+"""
+
+import numpy as np
+import pytest
+
+import lightgbmv1_tpu as lgb
+
+
+def make_problem(n=2000, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, 6)
+    y = (X[:, 0] + 0.95 * X[:, 1] + 0.1 * X[:, 2]
+         + rng.randn(n) * 0.3 > 0).astype(float)
+    return X, y
+
+
+BASE = {"objective": "binary", "num_leaves": 15, "verbosity": -1}
+
+
+def test_coupled_penalty_avoids_expensive_features():
+    X, y = make_problem()
+    pen = [0.0, 50.0, 50.0, 50.0, 50.0, 50.0]
+    bst = lgb.train({**BASE, "cegb_penalty_feature_coupled": pen},
+                    lgb.Dataset(X, label=y), num_boost_round=10)
+    imp = bst.feature_importance()
+    # the free feature dominates; weak expensive features are never bought
+    assert imp[0] > 0
+    assert imp[2:].sum() == 0
+    base = lgb.train(BASE, lgb.Dataset(X, label=y), num_boost_round=10)
+    assert (base.feature_importance() > 0).sum() > 2
+
+
+def test_split_penalty_prunes():
+    X, y = make_problem()
+    plain = lgb.train({**BASE, "num_leaves": 31},
+                      lgb.Dataset(X, label=y), num_boost_round=3)
+    pruned = lgb.train({**BASE, "num_leaves": 31, "cegb_penalty_split": 0.05},
+                       lgb.Dataset(X, label=y), num_boost_round=3)
+    n_plain = sum(t.num_leaves for t in plain._all_trees())
+    n_pruned = sum(t.num_leaves for t in pruned._all_trees())
+    assert n_pruned < n_plain
+    # still learns something
+    acc = ((pruned.predict(X) > 0.5) == (y > 0.5)).mean()
+    assert acc > 0.7
+
+
+def test_coupled_penalty_is_paid_once():
+    """Once a feature is bought it stays free for the rest of the MODEL
+    (reference is_feature_used_in_split_ persists across trees)."""
+    X, y = make_problem()
+    pen = [5.0] * 6
+    bst = lgb.train({**BASE, "cegb_penalty_feature_coupled": pen,
+                     "cegb_tradeoff": 0.5},
+                    lgb.Dataset(X, label=y), num_boost_round=8)
+    imp = bst.feature_importance()
+    # informative features are bought and then reused repeatedly
+    assert imp[0] > 3 and imp[1] > 3
+
+
+def test_coupled_penalty_wrong_size_fatal():
+    X, y = make_problem()
+    with pytest.raises(lgb.LightGBMError):
+        lgb.train({**BASE, "cegb_penalty_feature_coupled": [1.0, 2.0]},
+                  lgb.Dataset(X, label=y), num_boost_round=2)
+
+
+def test_levelwise_cegb():
+    X, y = make_problem()
+    pen = [0.0, 50.0, 50.0, 50.0, 50.0, 50.0]
+    bst = lgb.train({**BASE, "tree_growth": "levelwise",
+                     "cegb_penalty_feature_coupled": pen},
+                    lgb.Dataset(X, label=y), num_boost_round=5)
+    imp = bst.feature_importance()
+    assert imp[0] > 0 and imp[3:].sum() == 0
