@@ -64,6 +64,19 @@ def _load_dataset(config: Config, path: str,
     )
 
 
+def _find_latest_snapshot(output_model: str):
+    """Latest ``<output_model>.snapshot_iter_N`` on disk, or None."""
+    import glob
+    import re
+
+    best, best_iter = None, -1
+    for p in glob.glob(glob.escape(output_model) + ".snapshot_iter_*"):
+        m = re.search(r"\.snapshot_iter_(\d+)$", p)
+        if m and int(m.group(1)) > best_iter:
+            best, best_iter = p, int(m.group(1))
+    return best, best_iter
+
+
 def run_train(config: Config) -> Booster:
     """reference: Application::InitTrain + Train, application.cpp:164-211."""
     if not config.data:
@@ -73,8 +86,24 @@ def run_train(config: Config) -> Booster:
     if config.save_binary:
         # reference: is_save_binary_file → SaveBinaryFile(data + ".bin")
         train_set.save_binary(config.data + ".bin")
+    init_model = config.input_model or None
+    done_iters = 0
+    if init_model is None and config.snapshot_freq > 0 \
+            and not os.path.exists(config.output_model):
+        # crash recovery: resume from the newest snapshot automatically —
+        # but ONLY when the final model is absent (i.e. the previous run
+        # crashed); a completed run's leftover snapshots never hijack a
+        # fresh training run (the reference's recovery story is snapshots +
+        # manual restart via input_model; this closes the loop)
+        snap, done_iters = _find_latest_snapshot(config.output_model)
+        if snap is not None:
+            log_info(f"Resuming from snapshot {snap} ({done_iters} "
+                     "iterations already trained)")
+            init_model = snap
+        else:
+            done_iters = 0
     booster = Booster(params=_config_to_params(config), train_set=train_set,
-                      init_model=config.input_model or None)
+                      init_model=init_model)
     valid_names: List[str] = []
     for i, vpath in enumerate(config.valid):
         name = os.path.basename(vpath)
@@ -83,7 +112,7 @@ def run_train(config: Config) -> Booster:
         valid_names.append(name)
     log_info(f"Finished loading data in {time.time() - t0:.6f} seconds")
 
-    n_iter = config.num_iterations
+    n_iter = max(config.num_iterations - done_iters, 0)
     t0 = time.time()
     for i in range(n_iter):
         finished = booster.update()
@@ -95,8 +124,9 @@ def run_train(config: Config) -> Booster:
         log_info(f"{time.time() - t0:.6f} seconds elapsed, "
                  f"finished iteration {i + 1}")
         # snapshots (reference: GBDT::Train, gbdt.cpp:258-262)
-        if config.snapshot_freq > 0 and (i + 1) % config.snapshot_freq == 0:
-            snap = f"{config.output_model}.snapshot_iter_{i + 1}"
+        total_i = done_iters + i + 1
+        if config.snapshot_freq > 0 and total_i % config.snapshot_freq == 0:
+            snap = f"{config.output_model}.snapshot_iter_{total_i}"
             booster.save_model(snap)
             log_info(f"Saved snapshot to {snap}")
         if finished:

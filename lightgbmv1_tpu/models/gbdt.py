@@ -36,7 +36,8 @@ from ..ops.split import SplitParams, make_feature_meta
 from ..utils.log import log_fatal, log_info, log_warning
 from ..utils.timer import global_timer
 from .grower import make_leafwise_grower
-from .tree import HostTree, TreeArrays, tree_predict_binned
+from .tree import (HostTree, TreeArrays, tree_predict_binned,
+                   tree_used_features)
 
 
 def _np_weighted_quantile_sorted(v, w, q):
@@ -243,7 +244,6 @@ class GBDT:
                     binned, g3, feat_masks[k], key, cegb_used
                 )
                 if self._cegb_enabled:
-                    from .tree import tree_used_features
                     cegb_used = cegb_used | tree_used_features(
                         tree_dev, cegb_used.shape[0])
                 shrunk = tree_dev._replace(leaf_value=tree_dev.leaf_value * rate)
@@ -482,7 +482,6 @@ class GBDT:
             tree_dev, leaf_id, root_sum = self._grow(
                 self._grow_binned, g3, base_mask, key, self._cegb_used)
             if self._cegb_enabled:
-                from .tree import tree_used_features
                 self._cegb_used = self._cegb_used | tree_used_features(
                     tree_dev, self._cegb_used.shape[0])
             new_trees.append(self._finish_tree(tree_dev, leaf_id, k))
@@ -791,7 +790,6 @@ class DART(GBDT):
             tree_dev, leaf_id, _ = self._grow(
                 self._grow_binned, g3, base_mask, key, self._cegb_used)
             if self._cegb_enabled:
-                from .tree import tree_used_features
                 self._cegb_used = self._cegb_used | tree_used_features(
                     tree_dev, self._cegb_used.shape[0])
             new_trees.append(
@@ -941,7 +939,6 @@ class RF(GBDT):
             tree_dev, leaf_id, _ = self._grow(
                 self._grow_binned, g3, base_mask, key, self._cegb_used)
             if self._cegb_enabled:
-                from .tree import tree_used_features
                 self._cegb_used = self._cegb_used | tree_used_features(
                     tree_dev, self._cegb_used.shape[0])
             new_trees.append(self._finish_tree(tree_dev, leaf_id, k, shrinkage=1.0))

@@ -280,6 +280,11 @@ def build_trainer(
             local_parent = local_hist[0].sum(axis=0)
             gains = per_feature_best_gain(local_hist, local_parent, meta,
                                           mask, params)
+            if cegb_pen is not None:
+                # CEGB must influence WHICH features win the vote, not just
+                # the final reduced search (serial-semantics parity)
+                gains = jnp.where(jnp.isfinite(gains), gains - cegb_pen,
+                                  gains)
             _, local_top = lax.top_k(gains, top_k)
             votes = jnp.zeros(F, jnp.float32).at[local_top].add(
                 jnp.where(jnp.isfinite(gains[local_top]), 1.0, 0.0))
@@ -444,6 +449,9 @@ def build_trainer(
             best = jnp.argmax(allp[:, 0])
             return _unpack_split(allp[best])
 
+        coupled_fp = _cegb_coupled(config, F)
+        if coupled_fp is not None:
+            coupled_fp = np.pad(coupled_fp, (0, pad_f))
         grow = make_leafwise_grower(
             hist_fn=hist_fn, split_fn=split_fn,
             num_leaves=config.num_leaves, num_bins=B, meta=meta_p,
@@ -452,6 +460,7 @@ def build_trainer(
             monotone_penalty=config.monotone_penalty,
             interaction_groups=parse_interaction_constraints(
                 config.interaction_constraints, F_pad),
+            cegb_coupled=coupled_fp,
         )
         sharded = shard_map(
             grow,

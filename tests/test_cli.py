@@ -138,3 +138,35 @@ def test_convert_model_cpp_compiles_and_matches(tmp_path):
     cc = np.array([float(x) for x in out.stdout.split()])
     py = np.loadtxt(result)
     np.testing.assert_allclose(cc, py, rtol=1e-12, atol=1e-14)
+
+
+def test_cli_snapshot_auto_resume(tmp_path):
+    """Crash recovery: rerunning the same train command picks up the newest
+    snapshot and trains only the remaining iterations."""
+    data = _write_data(tmp_path)
+    model = str(tmp_path / "m.txt")
+    args = [f"data={data}", "objective=binary", "num_trees=6",
+            "num_leaves=7", "min_data_in_leaf=20", "snapshot_freq=2",
+            f"output_model={model}", "verbosity=-1"]
+    cli_main(args)
+    import lightgbmv1_tpu as lgb
+    full = lgb.Booster(model_file=model)
+    assert full.num_trees() == 6
+    # simulate a crash after iteration 4: delete the final model + last snap
+    os.remove(model)
+    os.remove(model + ".snapshot_iter_6")
+    import io
+    from contextlib import redirect_stderr
+    buf = io.StringIO()
+    with redirect_stderr(buf):
+        cli_main([a for a in args if not a.startswith("verbosity")]
+                 + ["verbosity=1"])   # resumes from snapshot_iter_4
+    assert "Resuming from snapshot" in buf.getvalue()
+    resumed = lgb.Booster(model_file=model)
+    assert resumed.num_trees() == 6
+    # a COMPLETED run must not be hijacked by leftover snapshots
+    buf2 = io.StringIO()
+    with redirect_stderr(buf2):
+        cli_main([a for a in args if not a.startswith("verbosity")]
+                 + ["verbosity=1"])
+    assert "Resuming from snapshot" not in buf2.getvalue()
