@@ -116,11 +116,17 @@ def load_data_file(
     group_column: str = "",
     ignore_column: str = "",
     is_predict: bool = False,
+    rank: Optional[int] = None,
+    num_machines: int = 1,
 ) -> DataFile:
     """Load a training/prediction data file with the reference's loader
     conventions (reference: DatasetLoader::LoadFromFile,
     src/io/dataset_loader.cpp:167; sibling weight/query files
-    metadata.cpp conventions)."""
+    metadata.cpp conventions).
+
+    ``rank``/``num_machines``: parse only this rank's contiguous row shard
+    (the reference's loader-level pre-partition). Only the owned lines are
+    tokenized/parsed; the raw text is still read once to index lines."""
     if not os.path.exists(path):
         log_fatal(f"Data file {path} does not exist")
     # read only a head sample first: format detection + header names need a
@@ -138,6 +144,8 @@ def load_data_file(
 
     fmt = _detect_format([ln for ln in head_data if ln.strip()][:20])
     lines = None
+    sharded = rank is not None and num_machines > 1
+    shard_range = [0, None]
 
     def all_lines():
         nonlocal lines
@@ -146,6 +154,16 @@ def load_data_file(
                 lines = fh.read().splitlines()
             if has_header and lines:
                 lines = lines[1:]
+            if sharded:
+                # keep only this rank's contiguous data-line shard; only
+                # those lines get tokenized below
+                data_idx = [i for i, ln in enumerate(lines)
+                            if ln.split("#", 1)[0].strip()]
+                per = -(-len(data_idx) // num_machines)
+                lo = min(rank * per, len(data_idx))
+                hi = min(lo + per, len(data_idx))
+                shard_range[0], shard_range[1] = lo, hi
+                lines = [lines[i] for i in data_idx[lo:hi]]
         return lines
 
     label = weight = group = None
@@ -158,9 +176,10 @@ def load_data_file(
             "," if fmt == "csv" else None)
         # native C++ fast path (native/text_parser.cpp, multithreaded);
         # the Python parser is the semantics reference and the fallback
+        # (sharded loads parse only the owned lines, Python path)
         from ..native import parse_dense_file
 
-        data = parse_dense_file(path, has_header, sep)
+        data = None if sharded else parse_dense_file(path, has_header, sep)
         if data is None:
             data = _parse_dense(all_lines(), sep)
         label_idx = _resolve_column(label_column, header_names, "label")
@@ -196,11 +215,18 @@ def load_data_file(
     wfile = path + ".weight"
     if weight is None and os.path.exists(wfile):
         weight = np.loadtxt(wfile, dtype=np.float64, ndmin=1)
+        if sharded:
+            weight = weight[shard_range[0]:shard_range[1]]
         log_info(f"Loading weights from {wfile}")
     qfile = path + ".query"
     if group is None and os.path.exists(qfile):
-        group = np.loadtxt(qfile, dtype=np.int64, ndmin=1)
-        log_info(f"Loading query boundaries from {qfile}")
+        if sharded:
+            log_warning("query boundaries + rank-sharded loading need "
+                        "query-aligned shards, which is not implemented; "
+                        "ignoring the .query sibling")
+        else:
+            group = np.loadtxt(qfile, dtype=np.int64, ndmin=1)
+            log_info(f"Loading query boundaries from {qfile}")
     ifile = path + ".init"
     init_score = None
     if os.path.exists(ifile):
