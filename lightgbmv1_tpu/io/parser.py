@@ -107,6 +107,15 @@ def _resolve_column(spec: str, header_names: Optional[List[str]], what: str) -> 
     return int(spec)
 
 
+def shard_rows(num_rows: int, rank: int, world: int):
+    """Contiguous row range for one rank (reference loader pre-partition,
+    dataset_loader.cpp:167). Single definition shared with parallel/."""
+    per = -(-num_rows // world)
+    lo = min(rank * per, num_rows)
+    hi = min(lo + per, num_rows)
+    return lo, hi
+
+
 def load_data_file(
     path: str,
     *,
@@ -159,15 +168,20 @@ def load_data_file(
                 # those lines get tokenized below
                 data_idx = [i for i, ln in enumerate(lines)
                             if ln.split("#", 1)[0].strip()]
-                per = -(-len(data_idx) // num_machines)
-                lo = min(rank * per, len(data_idx))
-                hi = min(lo + per, len(data_idx))
+                lo, hi = shard_rows(len(data_idx), rank, num_machines)
                 shard_range[0], shard_range[1] = lo, hi
                 lines = [lines[i] for i in data_idx[lo:hi]]
         return lines
 
     label = weight = group = None
     if fmt == "libsvm":
+        if sharded:
+            # a shard's max feature index need not match other ranks';
+            # consistent distributed libsvm loading needs a global
+            # max-index pass, which is not implemented
+            log_fatal("rank-sharded loading of libsvm files is not "
+                      "supported; use a dense format or pre-partitioned "
+                      "files")
         X, label = _parse_libsvm(all_lines())
         feature_names = None
     else:
@@ -205,6 +219,11 @@ def load_data_file(
         if weight_idx is not None:
             weight = data[:, weight_idx]
         if group_idx is not None:
+            if sharded:
+                log_warning(
+                    "group_column with rank-sharded loading: queries that "
+                    "straddle a shard boundary are split across ranks "
+                    "(query-aligned sharding is not implemented)")
             # group column holds a query id per row -> convert to sizes
             qid = data[:, group_idx]
             change = np.flatnonzero(np.diff(qid) != 0)
@@ -231,6 +250,8 @@ def load_data_file(
     init_score = None
     if os.path.exists(ifile):
         init_score = np.loadtxt(ifile, dtype=np.float64)
+        if sharded:
+            init_score = init_score[shard_range[0]:shard_range[1]]
         log_info(f"Loading initial scores from {ifile}")
 
     df = DataFile(X, label, weight, group, feature_names)

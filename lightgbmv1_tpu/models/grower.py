@@ -221,7 +221,7 @@ def make_leafwise_grower(
             c = 2048
             while c < N:
                 caps.append(c)
-                c *= 2
+                c = (c * 3) // 2
             caps.append(N)
             capmax = caps[-1]
 

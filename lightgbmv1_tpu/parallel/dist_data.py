@@ -37,16 +37,8 @@ import numpy as np
 from ..config import Config
 from ..io.binning import BIN_CATEGORICAL, BIN_NUMERICAL, BinMapper
 from ..io.dataset import BinnedDataset
-from ..io.parser import load_data_file
+from ..io.parser import load_data_file, shard_rows  # noqa: F401 (re-export)
 from ..utils.log import log_info
-
-
-def shard_rows(num_rows: int, rank: int, world: int):
-    """Contiguous row range for this rank (reference pre-partition)."""
-    per = -(-num_rows // world)
-    lo = min(rank * per, num_rows)
-    hi = min(lo + per, num_rows)
-    return lo, hi
 
 
 def find_bins_distributed(local_samples: List[np.ndarray], sample_cnt: int,
@@ -131,8 +123,17 @@ def load_distributed(path: str, config: Config,
     )
     log_info(f"Process {rank}/{world}: {df.X.shape[0]} local rows "
              "(reference rank pre-partition)")
+    if world > 1:
+        # keep the GLOBAL gathered sample within the configured budget:
+        # each rank contributes its share (the gather concatenates them)
+        import dataclasses
+
+        config = dataclasses.replace(
+            config, bin_construct_sample_cnt=max(
+                1, config.bin_construct_sample_cnt // world))
     return BinnedDataset.from_numpy(
         df.X, label=df.label, weight=df.weight, group=df.group,
+        init_score=getattr(df, "init_score", None),
         config=config, categorical_features=categorical_features,
         feature_names=df.feature_names,
         bin_finder=find_bins_distributed,
