@@ -1,0 +1,94 @@
+"""Missing-value handling tests.
+
+reference: tests/python_package_test/test_engine.py
+test_missing_value_handle / _na / _zero (:121-266): NaN routing with
+use_missing, zero_as_missing semantics, default-direction learning.
+"""
+
+import numpy as np
+import pytest
+
+import lightgbmv1_tpu as lgb
+
+BASE = {"objective": "binary", "num_leaves": 7, "min_data_in_leaf": 10,
+        "verbosity": -1}
+
+
+def test_nan_rows_learn_their_own_direction():
+    """NaN carries signal: rows with NaN in f0 are positive — the learned
+    default direction must route them to the positive side."""
+    rng = np.random.RandomState(0)
+    n = 2000
+    X = rng.rand(n, 2) * 2 - 1
+    is_na = rng.rand(n) < 0.3
+    y = np.where(is_na, 1.0, (X[:, 0] > 0).astype(float))
+    X[is_na, 0] = np.nan
+    bst = lgb.train(BASE, lgb.Dataset(X, label=y), num_boost_round=20)
+    pred = bst.predict(X)
+    acc_na = ((pred[is_na] > 0.5) == (y[is_na] > 0.5)).mean()
+    assert acc_na > 0.95
+
+
+def test_use_missing_false_treats_nan_as_zero():
+    rng = np.random.RandomState(1)
+    n = 1500
+    X = rng.rand(n, 2)
+    y = (X[:, 0] > 0.5).astype(float)
+    X[::7, 0] = np.nan
+    bst = lgb.train({**BASE, "use_missing": False},
+                    lgb.Dataset(X, label=y), num_boost_round=10)
+    # NaN rows and exact-zero rows must predict identically (NaN -> 0)
+    Xa = X.copy()
+    Xa[:, 0] = np.nan
+    Xb = X.copy()
+    Xb[:, 0] = 0.0
+    np.testing.assert_allclose(bst.predict(Xa), bst.predict(Xb),
+                               rtol=1e-6, atol=1e-7)
+
+
+def test_zero_as_missing():
+    """zero_as_missing=True: exact zeros follow the missing direction."""
+    rng = np.random.RandomState(2)
+    n = 2000
+    X = rng.rand(n, 2) + 0.5          # strictly positive
+    is_zero = rng.rand(n) < 0.3
+    y = np.where(is_zero, 1.0, (X[:, 0] > 1.0).astype(float))
+    X[is_zero, 0] = 0.0
+    bst = lgb.train({**BASE, "zero_as_missing": True},
+                    lgb.Dataset(X, label=y), num_boost_round=20)
+    pred = bst.predict(X)
+    acc_zero = ((pred[is_zero] > 0.5) == 1.0).mean()
+    assert acc_zero > 0.95
+    # NaN and zero take the same route under MISSING_ZERO
+    Xa = X.copy()
+    Xa[:, 0] = 0.0
+    Xb = X.copy()
+    Xb[:, 0] = np.nan
+    np.testing.assert_allclose(bst.predict(Xa), bst.predict(Xb),
+                               rtol=1e-6, atol=1e-7)
+
+
+def test_all_nan_feature_is_trivial():
+    rng = np.random.RandomState(3)
+    X = rng.randn(800, 3)
+    X[:, 2] = np.nan
+    y = (X[:, 0] > 0).astype(float)
+    bst = lgb.train(BASE, lgb.Dataset(X, label=y), num_boost_round=5)
+    assert bst.feature_importance()[2] == 0   # never split on the NaN column
+    acc = ((bst.predict(X) > 0.5) == (y > 0.5)).mean()
+    assert acc > 0.9
+
+
+def test_predict_unseen_nan_goes_default_side():
+    """A model trained WITHOUT NaNs must still route NaN inputs (missing
+    type None -> treated as zero, reference NumericalDecision)."""
+    rng = np.random.RandomState(4)
+    X = rng.randn(1000, 2)
+    y = (X[:, 0] > 0).astype(float)
+    bst = lgb.train(BASE, lgb.Dataset(X, label=y), num_boost_round=10)
+    Xn = X.copy()
+    Xn[:, 0] = np.nan
+    Xz = X.copy()
+    Xz[:, 0] = 0.0
+    np.testing.assert_allclose(bst.predict(Xn), bst.predict(Xz),
+                               rtol=1e-6, atol=1e-7)
