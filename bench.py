@@ -142,4 +142,18 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    try:
+        main()
+    except Exception as e:   # noqa: BLE001 — the driver records stdout; a
+        # crash must still leave a parseable record of what happened
+        import traceback
+
+        traceback.print_exc()
+        print(json.dumps({
+            "metric": "higgs-shaped binary training throughput (FAILED)",
+            "value": 0.0,
+            "unit": "M row-trees/s",
+            "vs_baseline": 0.0,
+            "error": f"{type(e).__name__}: {e}"[:300],
+        }))
+        sys.exit(1)   # truthful exit code alongside the parseable record
