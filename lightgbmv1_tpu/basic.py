@@ -99,21 +99,47 @@ class Dataset:
             self.feature_name = list(self._binned.feature_names)
         elif isinstance(data, (str, os.PathLike)):
             cfg = Config.from_dict(self.params)
-            df = load_data_file(
-                str(data),
-                has_header=cfg.header,
-                label_column=cfg.label_column,
-                weight_column=cfg.weight_column,
-                group_column=cfg.group_column,
-                ignore_column=cfg.ignore_column,
-            )
-            self.data = df.X
-            label = df.label if label is None else label
-            weight = df.weight if weight is None else weight
-            group = df.group if group is None else group
-            init_score = getattr(df, "init_score", None) if init_score is None else init_score
-            if df.feature_names and feature_name == "auto":
-                self.feature_name = df.feature_names
+            if cfg.two_round and reference is None:
+                # streaming two-pass load straight into bins (reference:
+                # two_round=true, dataset_loader.cpp:208-235); valid sets
+                # with a reference still use the in-memory path since they
+                # must reuse the training bin mappers
+                from .io.parser import load_two_round
+
+                cat2 = []
+                if categorical_feature not in ("auto", None):
+                    cat2 = [int(c) for c in categorical_feature
+                            if not isinstance(c, str)]
+                binned = load_two_round(str(data), cfg, cat2)
+                if binned is not None:
+                    self._binned = binned
+                    self.data = None
+                    meta = binned.metadata
+                    label = meta.label if label is None else label
+                    weight = meta.weight if weight is None else weight
+                    group = meta.group if group is None else group
+                    init_score = (meta.init_score if init_score is None
+                                  else init_score)
+                    self.feature_name = list(binned.feature_names)
+            if self._binned is None:
+                df = load_data_file(
+                    str(data),
+                    has_header=cfg.header,
+                    label_column=cfg.label_column,
+                    weight_column=cfg.weight_column,
+                    group_column=cfg.group_column,
+                    ignore_column=cfg.ignore_column,
+                    num_threads=cfg.num_threads,
+                    init_score_file=cfg.initscore_filename,
+                )
+                self.data = df.X
+                label = df.label if label is None else label
+                weight = df.weight if weight is None else weight
+                group = df.group if group is None else group
+                init_score = getattr(df, "init_score", None) \
+                    if init_score is None else init_score
+                if df.feature_names and feature_name == "auto":
+                    self.feature_name = df.feature_names
         else:
             self.data = _to_2d_numpy(data) if data is not None else None
 

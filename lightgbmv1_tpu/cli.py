@@ -38,12 +38,22 @@ def _config_to_params(config: Config) -> dict:
 
 
 def _load_dataset(config: Config, path: str,
-                  reference: Optional[Dataset] = None) -> Dataset:
+                  reference: Optional[Dataset] = None,
+                  init_score_file: str = "") -> Dataset:
     from .io.dataset import BinnedDataset
 
     if BinnedDataset.is_binary_file(path):
         return Dataset(path, params=_config_to_params(config),
                        reference=reference)
+    if config.two_round and reference is None:
+        # streaming two-pass path (reference two_round=true); Dataset's
+        # file-path constructor routes to io.parser.load_two_round
+        cat2 = "auto"
+        if config.categorical_feature:
+            cat2 = [int(x) for x in
+                    str(config.categorical_feature).replace(",", " ").split()]
+        return Dataset(path, params=_config_to_params(config),
+                       reference=reference, categorical_feature=cat2)
     df = load_data_file(
         path,
         has_header=config.header,
@@ -51,6 +61,8 @@ def _load_dataset(config: Config, path: str,
         weight_column=config.weight_column,
         group_column=config.group_column,
         ignore_column=config.ignore_column,
+        num_threads=config.num_threads,
+        init_score_file=init_score_file,
     )
     cat = "auto"
     if config.categorical_feature:
@@ -82,7 +94,8 @@ def run_train(config: Config) -> Booster:
     if not config.data:
         log_fatal("No training data: set data=<file>")
     t0 = time.time()
-    train_set = _load_dataset(config, config.data)
+    train_set = _load_dataset(config, config.data,
+                              init_score_file=config.initscore_filename)
     if config.save_binary:
         # reference: is_save_binary_file → SaveBinaryFile(data + ".bin")
         train_set.save_binary(config.data + ".bin")
@@ -107,7 +120,11 @@ def run_train(config: Config) -> Booster:
     valid_names: List[str] = []
     for i, vpath in enumerate(config.valid):
         name = os.path.basename(vpath)
-        booster.add_valid(_load_dataset(config, vpath, reference=train_set),
+        # per-valid-set init score files (reference: valid_data_initscores)
+        vinit = (config.valid_data_initscores[i]
+                 if i < len(config.valid_data_initscores) else "")
+        booster.add_valid(_load_dataset(config, vpath, reference=train_set,
+                                        init_score_file=vinit),
                           name)
         valid_names.append(name)
     log_info(f"Finished loading data in {time.time() - t0:.6f} seconds")
@@ -117,8 +134,11 @@ def run_train(config: Config) -> Booster:
     for i in range(n_iter):
         finished = booster.update()
         if config.metric_freq > 0 and (i + 1) % config.metric_freq == 0:
-            for data_name, metric, value, _ in booster.eval_train():
-                log_info(f"Iteration:{i + 1}, {data_name} {metric} : {value:g}")
+            # reference: OutputMetric prints the training metric only under
+            # is_provide_training_metric (gbdt.cpp:413-434)
+            if config.is_provide_training_metric:
+                for data_name, metric, value, _ in booster.eval_train():
+                    log_info(f"Iteration:{i + 1}, {data_name} {metric} : {value:g}")
             for data_name, metric, value, _ in booster.eval_valid():
                 log_info(f"Iteration:{i + 1}, {data_name} {metric} : {value:g}")
         log_info(f"{time.time() - t0:.6f} seconds elapsed, "
@@ -199,6 +219,10 @@ def run_convert_model(config: Config) -> None:
 
     if not config.input_model:
         log_fatal("No model file: set input_model=<file>")
+    if config.convert_model_language not in ("", "cpp"):
+        log_fatal(f"convert_model_language="
+                  f"{config.convert_model_language} is not supported; "
+                  "only 'cpp' code generation is available")
     booster = Booster(model_file=config.input_model)
     code = model_to_cpp(booster._loaded)
     out = config.convert_model or "gbdt_prediction.cpp"

@@ -136,6 +136,114 @@ def _find_bin_with_zero_as_one_bin(
     return bounds
 
 
+def _find_bin_with_predefined(
+    distinct_values: np.ndarray,
+    counts: np.ndarray,
+    max_bin: int,
+    total_sample_cnt: int,
+    min_data_in_bin: int,
+    forced_upper_bounds: Sequence[float],
+) -> List[float]:
+    """Bin boundaries honoring user-forced upper bounds (behavioral port of
+    FindBinWithPredefinedBin, reference src/io/bin.cpp:157-255): seed the
+    boundary list with the zero-straddle bounds plus the forced bounds, then
+    subdivide each seeded range greedily with a bin budget proportional to
+    its sample count."""
+    bounds: List[float] = []
+    # negative / zero / positive partition (reference :163-195)
+    left_cnt = int(np.searchsorted(distinct_values, -K_ZERO_THRESHOLD,
+                                   side="right"))
+    has_left = left_cnt > 0
+    right_start = int(np.searchsorted(distinct_values, K_ZERO_THRESHOLD,
+                                      side="right"))
+    has_right = right_start < len(distinct_values)
+    if max_bin == 2:
+        bounds.append(K_ZERO_THRESHOLD if left_cnt == 0 else -K_ZERO_THRESHOLD)
+    elif max_bin >= 3:
+        if has_left:
+            bounds.append(-K_ZERO_THRESHOLD)
+        if has_right:
+            bounds.append(K_ZERO_THRESHOLD)
+    bounds.append(math.inf)
+
+    # insert forced bounds (nonzero only — zero bounds already seeded)
+    max_to_insert = max_bin - len(bounds)
+    inserted = 0
+    for b in forced_upper_bounds:
+        if inserted >= max_to_insert:
+            break
+        if abs(b) > K_ZERO_THRESHOLD:
+            bounds.append(float(b))
+            inserted += 1
+    bounds.sort()
+
+    # subdivide each seeded range with a count-proportional budget
+    free_bins = max_bin - len(bounds)
+    to_add: List[float] = []
+    value_ind = 0
+    for i, ub in enumerate(bounds):
+        bin_start = value_ind
+        cnt_in_bin = 0
+        while (value_ind < len(distinct_values)
+               and distinct_values[value_ind] < ub):
+            cnt_in_bin += int(counts[value_ind])
+            value_ind += 1
+        remaining = max_bin - len(bounds) - len(to_add)
+        # std::lround = half away from zero (Python round() would banker-round)
+        num_sub = int(math.floor(
+            cnt_in_bin * free_bins / max(total_sample_cnt, 1) + 0.5))
+        num_sub = min(num_sub, remaining) + 1
+        if i == len(bounds) - 1:
+            num_sub = remaining + 1
+        if num_sub > 1 and value_ind > bin_start:
+            sub = _greedy_find_bin(
+                distinct_values[bin_start:value_ind],
+                counts[bin_start:value_ind],
+                num_sub, cnt_in_bin, min_data_in_bin)
+            to_add.extend(sub[:-1])          # last bound is +inf
+    bounds.extend(to_add)
+    return sorted(set(bounds))
+
+
+def get_forced_bins(path: str, num_total_features: int,
+                    categorical_features=None) -> List[List[float]]:
+    """forcedbins_filename JSON -> per-feature forced upper bounds
+    (behavioral port of DatasetLoader::GetForcedBins,
+    reference src/io/dataset_loader.cpp:1200-1235; format:
+    ``[{"feature": i, "bin_upper_bound": [..]}, ...]``)."""
+    import json
+
+    from ..utils.log import log_warning
+
+    forced: List[List[float]] = [[] for _ in range(num_total_features)]
+    if not path:
+        return forced
+    categorical = set(categorical_features or [])
+    try:
+        with open(path) as fh:
+            spec = json.load(fh)
+    except OSError:
+        log_warning(f"Could not open {path}. Will ignore.")
+        return forced
+    for entry in spec:
+        f = int(entry["feature"])
+        if f >= num_total_features:
+            continue
+        if f in categorical:
+            log_warning(f"Feature {f} is categorical. Will ignore forced "
+                        "bins for this feature.")
+            continue
+        forced[f] = [float(b) for b in entry["bin_upper_bound"]]
+    # remove consecutive duplicates (reference std::unique)
+    for f in range(num_total_features):
+        out: List[float] = []
+        for b in forced[f]:
+            if not out or b != out[-1]:
+                out.append(b)
+        forced[f] = out
+    return forced
+
+
 @dataclass
 class BinMapper:
     """Maps raw feature values to small integer bins (one per feature)."""
@@ -184,6 +292,7 @@ class BinMapper:
         bin_type: int = BIN_NUMERICAL,
         use_missing: bool = True,
         zero_as_missing: bool = False,
+        forced_bounds: Optional[Sequence[float]] = None,
     ) -> "BinMapper":
         """Behavioral port of BinMapper::FindBin (reference src/io/bin.cpp:325-...).
 
@@ -229,9 +338,16 @@ class BinMapper:
         m.max_value = float(vals.max()) if len(vals) else 0.0
 
         distinct, counts = np.unique(vals, return_counts=True)
-        bounds = _find_bin_with_zero_as_one_bin(
-            distinct, counts, budget, len(vals), min_data_in_bin
-        )
+        if forced_bounds:
+            # reference bin.cpp:316-322: forced bounds switch the boundary
+            # search to FindBinWithPredefinedBin
+            bounds = _find_bin_with_predefined(
+                distinct, counts, budget, len(vals), min_data_in_bin,
+                forced_bounds)
+        else:
+            bounds = _find_bin_with_zero_as_one_bin(
+                distinct, counts, budget, len(vals), min_data_in_bin
+            )
         m.bin_upper_bound = np.asarray(bounds, dtype=np.float64)
         m.num_bin = len(bounds)
         if m.missing_type == MISSING_NAN:

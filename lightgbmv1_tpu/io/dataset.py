@@ -160,6 +160,10 @@ class BinnedDataset:
             if bin_finder is not None:
                 mappers = bin_finder(samples, sample_cnt, max_bins, categorical, config)
             else:
+                from .binning import get_forced_bins
+
+                forced = get_forced_bins(config.forcedbins_filename,
+                                         num_features, categorical)
                 mappers = [
                     BinMapper.find_bin(
                         samples[j],
@@ -169,6 +173,7 @@ class BinnedDataset:
                         bin_type=BIN_CATEGORICAL if j in categorical else BIN_NUMERICAL,
                         use_missing=config.use_missing,
                         zero_as_missing=config.zero_as_missing,
+                        forced_bounds=forced[j],
                     )
                     for j in range(num_features)
                 ]

@@ -116,6 +116,194 @@ def shard_rows(num_rows: int, rank: int, world: int):
     return lo, hi
 
 
+def load_two_round(path: str, config, categorical_features=None):
+    """Two-pass streaming loader (``two_round=true``; reference:
+    DatasetLoader::LoadFromFile's two-round branch, src/io/dataset_loader.cpp
+    :208-235, and ``ExtractFeaturesFromFile`` :1101-1160).
+
+    Pass 1 streams the file once, reservoir-sampling
+    ``bin_construct_sample_cnt`` rows for bin-mapper construction while
+    collecting the (small) label/weight/group columns; pass 2 re-reads the
+    file in chunks and bins rows straight into the ``(F, N)`` bin matrix.
+    Peak memory is the binned matrix (1 byte/value) plus one chunk — the
+    raw float64 matrix (8 bytes/value) is never materialized, which is the
+    reference's exact speed-for-memory trade.
+
+    Returns a ``BinnedDataset`` or ``None`` when the format has no
+    streaming path (libsvm), in which case the caller should fall back to
+    the in-memory loader.
+    """
+    from .binning import BIN_CATEGORICAL, BIN_NUMERICAL, BinMapper, \
+        get_forced_bins
+    from .dataset import BinnedDataset, Metadata
+
+    if not os.path.exists(path):
+        log_fatal(f"Data file {path} does not exist")
+    with open(path) as fh:
+        head = [fh.readline().rstrip("\n") for _ in range(24)]
+    header_names = None
+    head_data = list(head)
+    if config.header and head:
+        first = head[0]
+        hsep = "\t" if "\t" in first else ("," if "," in first else None)
+        header_names = first.split(hsep) if hsep else first.split()
+        head_data = head[1:]
+    fmt = _detect_format([ln for ln in head_data if ln.strip()][:20])
+    if fmt == "libsvm":
+        log_warning("two_round loading has no libsvm streaming path; "
+                    "falling back to the in-memory loader")
+        return None
+    first_data = next((ln for ln in head_data if ln.strip()), "")
+    sep = "\t" if fmt == "tsv" and "\t" in first_data else (
+        "," if fmt == "csv" else None)
+
+    label_idx = _resolve_column(config.label_column, header_names, "label")
+    if label_idx is None:
+        label_idx = 0
+    weight_idx = _resolve_column(config.weight_column, header_names, "weight")
+    group_idx = _resolve_column(config.group_column, header_names, "group")
+    ignore = set()
+    if config.ignore_column:
+        for tok in config.ignore_column.split(","):
+            idx = _resolve_column(tok, header_names, "ignore")
+            if idx is not None:
+                ignore.add(idx)
+
+    def parse_row(line):
+        parts = line.split(sep) if sep else line.split()
+        return [float(p) if p not in ("", "na", "nan", "NA", "NaN", "null")
+                else np.nan for p in parts]
+
+    # ---- pass 1: metadata columns + reservoir sample for binning ---------
+    rng = np.random.RandomState(config.data_random_seed)
+    cap = max(1, config.bin_construct_sample_cnt)
+    sample_rows: List[list] = []
+    label_l, weight_l, group_l = [], [], []
+    n_rows = 0
+    _miss = ("", "na", "nan", "NA", "NaN", "null")
+
+    def fval(tok):
+        return float(tok) if tok not in _miss else np.nan
+
+    with open(path) as fh:
+        if config.header:
+            fh.readline()
+        for line in fh:
+            line = line.split("#", 1)[0].strip()
+            if not line:
+                continue
+            # only the metadata columns are float-parsed per row; the full
+            # row is converted only when it enters the reservoir
+            parts = line.split(sep) if sep else line.split()
+            if label_idx is not None:
+                label_l.append(fval(parts[label_idx]))
+            if weight_idx is not None:
+                weight_l.append(fval(parts[weight_idx]))
+            if group_idx is not None:
+                group_l.append(fval(parts[group_idx]))
+            # reservoir sampling (uniform over all rows, one pass)
+            if n_rows < cap:
+                sample_rows.append([fval(p) for p in parts])
+            else:
+                j = rng.randint(0, n_rows + 1)
+                if j < cap:
+                    sample_rows[j] = [fval(p) for p in parts]
+            n_rows += 1
+    if n_rows == 0:
+        log_fatal(f"Data file {path} is empty")
+
+    meta_cols = {c for c in (label_idx, weight_idx, group_idx)
+                 if c is not None}
+    ncol = len(sample_rows[0])
+    keep = [c for c in range(ncol) if c not in meta_cols and c not in ignore]
+    num_features = len(keep)
+    feature_names = ([header_names[c] for c in keep] if header_names
+                     else None)
+    categorical = set(categorical_features or [])
+
+    sample_mat = np.asarray(sample_rows, np.float64)[:, keep]
+    sample_cnt = sample_mat.shape[0]
+    max_bins = list(config.max_bin_by_feature) or \
+        [config.max_bin] * num_features
+    if len(max_bins) != num_features:
+        log_fatal("max_bin_by_feature length must equal number of features")
+    forced = get_forced_bins(config.forcedbins_filename, num_features,
+                             categorical)
+    mappers = [
+        BinMapper.find_bin(
+            sample_mat[:, j],
+            total_sample_cnt=sample_cnt,
+            max_bin=max_bins[j],
+            min_data_in_bin=config.min_data_in_bin,
+            bin_type=BIN_CATEGORICAL if j in categorical else BIN_NUMERICAL,
+            use_missing=config.use_missing,
+            zero_as_missing=config.zero_as_missing,
+            forced_bounds=forced[j],
+        )
+        for j in range(num_features)
+    ]
+
+    # ---- pass 2: chunked re-read, binned in place ------------------------
+    max_nb = max(m.num_bin for m in mappers) if mappers else 2
+    dtype = np.uint8 if max_nb <= 256 else np.int16
+    binned = np.empty((num_features, n_rows), dtype=dtype)
+    CHUNK = 65536
+    lo = 0
+    buf: List[list] = []
+
+    def flush():
+        nonlocal lo
+        if not buf:
+            return
+        chunk = np.asarray(buf, np.float64)[:, keep]     # (rows, F)
+        for j, m in enumerate(mappers):
+            binned[j, lo:lo + len(buf)] = m.value_to_bin(
+                chunk[:, j]).astype(dtype)
+        lo += len(buf)
+        buf.clear()
+
+    with open(path) as fh:
+        if config.header:
+            fh.readline()
+        for line in fh:
+            line = line.split("#", 1)[0].strip()
+            if not line:
+                continue
+            buf.append(parse_row(line))
+            if len(buf) >= CHUNK:
+                flush()
+        flush()
+
+    meta = Metadata()
+    meta.label = np.asarray(label_l, np.float32)
+    if weight_idx is not None:
+        meta.weight = np.asarray(weight_l, np.float32)
+    wfile = path + ".weight"
+    if meta.weight is None and os.path.exists(wfile):
+        meta.weight = np.loadtxt(wfile, dtype=np.float64,
+                                 ndmin=1).astype(np.float32)
+    group = None
+    if group_idx is not None:
+        qid = np.asarray(group_l)
+        change = np.flatnonzero(np.diff(qid) != 0)
+        bounds = np.concatenate([[0], change + 1, [len(qid)]])
+        group = np.diff(bounds)
+    qfile = path + ".query"
+    if group is None and os.path.exists(qfile):
+        group = np.loadtxt(qfile, dtype=np.int64, ndmin=1)
+    meta.set_group(group)
+    # explicit initscore_filename overrides the .init sibling convention
+    ifile = config.initscore_filename or (path + ".init")
+    if os.path.exists(ifile):
+        meta.init_score = np.loadtxt(ifile, dtype=np.float64)
+
+    ds = BinnedDataset(binned, mappers, meta, feature_names,
+                       max_bin=config.max_bin)
+    log_info(f"two_round: streamed {n_rows} rows x {num_features} features "
+             f"in two passes ({binned.nbytes >> 20} MB binned)")
+    return ds
+
+
 def load_data_file(
     path: str,
     *,
@@ -127,6 +315,8 @@ def load_data_file(
     is_predict: bool = False,
     rank: Optional[int] = None,
     num_machines: int = 1,
+    num_threads: int = 0,
+    init_score_file: str = "",
 ) -> DataFile:
     """Load a training/prediction data file with the reference's loader
     conventions (reference: DatasetLoader::LoadFromFile,
@@ -193,7 +383,8 @@ def load_data_file(
         # (sharded loads parse only the owned lines, Python path)
         from ..native import parse_dense_file
 
-        data = None if sharded else parse_dense_file(path, has_header, sep)
+        data = None if sharded else parse_dense_file(path, has_header, sep,
+                                                     num_threads)
         if data is None:
             data = _parse_dense(all_lines(), sep)
         label_idx = _resolve_column(label_column, header_names, "label")
@@ -246,7 +437,9 @@ def load_data_file(
         else:
             group = np.loadtxt(qfile, dtype=np.int64, ndmin=1)
             log_info(f"Loading query boundaries from {qfile}")
-    ifile = path + ".init"
+    # explicit initscore_filename overrides the .init sibling convention
+    # (reference: config.h initscore_filename, metadata.cpp LoadInitialScore)
+    ifile = init_score_file or (path + ".init")
     init_score = None
     if os.path.exists(ifile):
         init_score = np.loadtxt(ifile, dtype=np.float64)

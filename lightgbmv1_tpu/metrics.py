@@ -28,6 +28,9 @@ from .utils.log import log_fatal, log_warning
 class Metric:
     name = "metric"
     higher_better = False
+    # metrics that evaluate on RAW margins instead of converted predictions
+    # (reference: metrics whose GetEvalAt consumes score_ directly)
+    wants_raw = False
 
     def __init__(self, config: Config):
         self.config = config
@@ -202,21 +205,21 @@ class AucMuMetric(Metric):
     reference: AucMuMetric, src/metric/multiclass_metric.hpp:183-314 —
     pairwise class separation measured along the hyperplane normal
     ``v = w_i - w_j`` with the partition-loss weight matrix (default:
-    uniform off-diagonal).  The reference evaluates on raw scores; this
-    implementation uses log-probabilities, which is identical whenever the
-    pair's weight vector sums to zero (always true for the default uniform
-    matrix, since per-row softmax offsets cancel).
+    uniform off-diagonal).  Evaluates on RAW scores like the reference
+    (``wants_raw``): with custom ``auc_mu_weights`` whose pair vector does
+    not sum to zero, the per-row softmax offset would NOT cancel, so
+    log-probability projection would diverge from the reference.
     """
 
     name = "auc_mu"
     higher_better = True
+    wants_raw = True
     _EPS = 1e-15
 
     def eval(self, pred):
         K = self.config.num_class
         y = self.label.astype(np.int64)
-        scores = np.log(np.clip(np.asarray(pred, np.float64).reshape(-1, K),
-                                1e-300, None))
+        scores = np.asarray(pred, np.float64).reshape(-1, K)
         W = self.config.auc_mu_weights
         if W:
             cw = np.asarray(W, np.float64).reshape(K, K)

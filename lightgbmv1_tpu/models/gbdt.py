@@ -92,7 +92,8 @@ class GBDT:
 
         # device-resident training data
         self.binned = jnp.asarray(train_set.binned)
-        self.meta = make_feature_meta(train_set, config.monotone_constraints)
+        self.meta = make_feature_meta(train_set, config.monotone_constraints,
+                                      config.feature_contri)
         self.num_bins = train_set.padded_bin
         self.split_params = SplitParams(
             lambda_l1=config.lambda_l1,
@@ -108,6 +109,7 @@ class GBDT:
             min_data_per_group=float(config.min_data_per_group),
             path_smooth=float(config.path_smooth),
             extra_trees=bool(config.extra_trees),
+            extra_seed=int(config.extra_seed),
             cegb_tradeoff=float(config.cegb_tradeoff),
             cegb_penalty_split=float(config.cegb_penalty_split),
         )
@@ -635,16 +637,35 @@ class GBDT:
             s = objective.convert_output(s)
         return np.asarray(s, dtype=np.float64)
 
+    def _raw_pred(self, scores: _ScoreUpdater) -> np.ndarray:
+        """Raw margins for ``wants_raw`` metrics (reference: metrics reading
+        score_ directly, e.g. AucMuMetric multiclass_metric.hpp:254)."""
+        raw = scores.score
+        s = raw[:, 0] if self.num_class == 1 else raw
+        return np.asarray(s, dtype=np.float64)
+
+    def _eval_metrics(self, dataset_name, scores, metrics, out):
+        pred = raw = None
+        for m in metrics:
+            if getattr(m, "wants_raw", False):
+                if raw is None:
+                    raw = self._raw_pred(scores)
+                p = raw
+            else:
+                if pred is None:
+                    pred = self._converted_pred(scores, self.objective)
+                p = pred
+            for name, value, hb in m.eval(p):
+                out.append((dataset_name, name, value, hb))
+
     def eval_train(self):
         with global_timer.section("GBDT::EvalTrain"):
             return self._eval_train_inner()
 
     def _eval_train_inner(self):
-        pred = self._converted_pred(self._train_scores, self.objective)
         out = []
-        for m in self.train_metrics:
-            for name, value, hb in m.eval(pred):
-                out.append(("training", name, value, hb))
+        self._eval_metrics("training", self._train_scores,
+                           self.train_metrics, out)
         return out
 
     def eval_valid(self):
@@ -656,10 +677,7 @@ class GBDT:
         for vname, vs, metrics in zip(
             self._valid_names, self._valid_scores, self._valid_metrics
         ):
-            pred = self._converted_pred(vs, self.objective)
-            for m in metrics:
-                for name, value, hb in m.eval(pred):
-                    out.append((vname, name, value, hb))
+            self._eval_metrics(vname, vs, metrics, out)
         return out
 
     # ------------------------------------------------------------------
@@ -723,22 +741,40 @@ class DART(GBDT):
         super().__init__(*args, **kwargs)
         self._drop_rng = np.random.RandomState(self.config.drop_seed)
         self._needs_host_tree = True  # drop normalization rescales host trees
+        # per-tree weights driving the weighted (non-uniform) drop
+        # (reference: dart.hpp tree_weight_/sum_weight_, :67-68,103-115)
+        self._tree_weight: List[float] = []
+        self._sum_weight = 0.0
 
     def train_one_iter(self, custom_grad=None, custom_hess=None,
                        check_stop: bool = True) -> bool:
         cfg = self.config
         self._save_rollback_state()
-        # select trees to drop
+        self._prev_weights = (list(self._tree_weight), self._sum_weight)
+        # select trees to drop (reference: dart.hpp DroppingTrees :96-137 —
+        # uniform_drop=false weights each tree's drop probability by its
+        # current normalized weight; true drops uniformly at drop_rate)
         n_trees = len(self.models) // self.num_class
         drop_iters: List[int] = []
         if n_trees > 0 and self._drop_rng.rand() >= cfg.skip_drop:
-            for i in range(n_trees):
-                if self._drop_rng.rand() < cfg.drop_rate:
-                    drop_iters.append(i)
-            if len(drop_iters) > cfg.max_drop > 0:
-                drop_iters = list(
-                    self._drop_rng.choice(drop_iters, cfg.max_drop, replace=False)
-                )
+            dr = cfg.drop_rate
+            if not cfg.uniform_drop and self._sum_weight > 0:
+                inv_avg = len(self._tree_weight) / self._sum_weight
+                if cfg.max_drop > 0:
+                    dr = min(dr, cfg.max_drop * inv_avg / self._sum_weight)
+                for i in range(n_trees):
+                    if self._drop_rng.rand() < dr * self._tree_weight[i] * inv_avg:
+                        drop_iters.append(i)
+                        if cfg.max_drop > 0 and len(drop_iters) >= cfg.max_drop:
+                            break
+            else:
+                if cfg.max_drop > 0:
+                    dr = min(dr, cfg.max_drop / float(n_trees))
+                for i in range(n_trees):
+                    if self._drop_rng.rand() < dr:
+                        drop_iters.append(i)
+                        if cfg.max_drop > 0 and len(drop_iters) >= cfg.max_drop:
+                            break
         k_drop = len(drop_iters)
 
         # remove dropped trees' contribution from scores, caching each
@@ -773,14 +809,17 @@ class DART(GBDT):
             grad, hess = self._gradients()
         bag = self._bagging_mask(self.iter)
 
-        # normalization factors (reference: dart.hpp Normalize)
+        # normalization factors (reference: dart.hpp Normalize :158-196 and
+        # shrinkage_rate_ :138-146)
         lr = cfg.learning_rate
         if cfg.xgboost_dart_mode:
-            new_factor = lr / (k_drop + lr)
+            shrink_new = lr if k_drop == 0 else lr / (lr + k_drop)
             old_factor = k_drop / (k_drop + lr)
+            w_dec = 1.0 / (k_drop + lr)       # reference dart.hpp:192-193
         else:
-            new_factor = 1.0 / (k_drop + 1.0)
+            shrink_new = lr / (k_drop + 1.0)
             old_factor = k_drop / (k_drop + 1.0)
+            w_dec = 1.0 / (k_drop + 1.0)      # reference dart.hpp:173-174
 
         new_trees = []
         for k in range(self.num_class):
@@ -793,7 +832,7 @@ class DART(GBDT):
                 self._cegb_used = self._cegb_used | tree_used_features(
                     tree_dev, self._cegb_used.shape[0])
             new_trees.append(
-                self._finish_tree(tree_dev, leaf_id, k, shrinkage=lr * new_factor)
+                self._finish_tree(tree_dev, leaf_id, k, shrinkage=shrink_new)
             )
         stopped = all(int(t.num_leaves) <= 1 for t in new_trees)
 
@@ -814,7 +853,14 @@ class DART(GBDT):
                     self._train_scores.add_pred(old_factor * pred, k)
                     for vs, vp in zip(self._valid_scores, vpreds):
                         vs.add_pred(old_factor * vp, k)
+                if not cfg.uniform_drop:
+                    # reference Normalize weight rescale (:173-175,:191-194)
+                    self._sum_weight -= self._tree_weight[it] * w_dec
+                    self._tree_weight[it] *= old_factor
 
+        if not cfg.uniform_drop:
+            self._tree_weight.append(shrink_new)
+            self._sum_weight += shrink_new
         self.iter += 1
         return stopped
 
@@ -864,6 +910,9 @@ class DART(GBDT):
                 self._model_shrink[idx] = shrink
                 self._model_bias[idx] = bias
             self._prev_state = self._prev_state[:3]
+        if getattr(self, "_prev_weights", None) is not None:
+            self._tree_weight, self._sum_weight = self._prev_weights
+            self._prev_weights = None
         super().rollback_one_iter()
 
 
@@ -954,6 +1003,13 @@ class RF(GBDT):
         s = raw[:, 0] if self.num_class == 1 else raw
         if objective is not None:
             s = objective.convert_output(s)
+        return np.asarray(s, dtype=np.float64)
+
+    def _raw_pred(self, scores):
+        n_iter = max(self.iter, 1)
+        init = jnp.asarray(self._init_scores[None, :], jnp.float32)
+        raw = init + (scores.score - init) / n_iter
+        s = raw[:, 0] if self.num_class == 1 else raw
         return np.asarray(s, dtype=np.float64)
 
 

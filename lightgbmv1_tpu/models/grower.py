@@ -73,6 +73,9 @@ class GrowerState(NamedTuple):
                               # entries hold N). dummy (1,) when masked mode
     leaf_begin: jax.Array     # (L,) int32 — segment begin per leaf
     leaf_phys: jax.Array      # (L,) int32 — physical rows per leaf
+    forced_leaf: jax.Array    # (S, 2) int32 — realized [left, right] leaf ids
+                              # per applied forced step (-1 = not applied);
+                              # dummy (1, 2) when no forced splits
     tree: TreeArrays
     leaf_is_left: jax.Array   # (L,) bool
     num_leaves: jax.Array     # () int32
@@ -119,8 +122,10 @@ def make_leafwise_grower(
     cost is O(segment) instead of O(num_data).  Dynamic segment sizes are
     bucketed into a few static capacities dispatched with ``lax.switch``.
 
-    ``forced_splits``: optional (S, 4) int array [leaf, feature, bin,
-    default_left] applied as the first S steps in BFS order (reference:
+    ``forced_splits``: optional (S, 5) int array [parent_step, side, feature,
+    bin, default_left] applied as the first S steps in BFS order
+    (parse_forced_splits format; parent_step = -1 is the root, side selects
+    the parent step's realized left/right child leaf — reference:
     SerialTreeLearner::ForceSplits, serial_tree_learner.cpp:427-539).
 
     ``hist_fn(binned, g3, leaf_id, target_leaf) -> (F, B, 3)`` — histogram of
@@ -144,10 +149,13 @@ def make_leafwise_grower(
               if interaction_groups is not None else None)
     S_forced = 0 if forced_splits is None else min(len(forced_splits), L - 1)
     if S_forced:
-        f_leaf = jnp.asarray(forced_splits[:S_forced, 0], jnp.int32)
-        f_feat = jnp.asarray(forced_splits[:S_forced, 1], jnp.int32)
-        f_bin = jnp.asarray(forced_splits[:S_forced, 2], jnp.int32)
-        f_dl = jnp.asarray(forced_splits[:S_forced, 3] != 0)
+        # (S, 5) [parent_step, side, feature, bin, dl] — leaf ids resolved at
+        # runtime from the realized forced_leaf table (see GrowerState)
+        f_parent = jnp.asarray(forced_splits[:S_forced, 0], jnp.int32)
+        f_side = jnp.asarray(forced_splits[:S_forced, 1], jnp.int32)
+        f_feat = jnp.asarray(forced_splits[:S_forced, 2], jnp.int32)
+        f_bin = jnp.asarray(forced_splits[:S_forced, 3], jnp.int32)
+        f_dl = jnp.asarray(forced_splits[:S_forced, 4] != 0)
 
     use_cegb = (params.cegb_penalty_split > 0) or (cegb_coupled is not None)
     coupled = (jnp.asarray(cegb_coupled, jnp.float32)
@@ -169,7 +177,7 @@ def make_leafwise_grower(
     if split_fn is None:
         def split_fn(hist, parent, mask, key, uid, constraint, depth,
                      parent_output, cegb_pen=None):
-            rk = jax.random.fold_in(key, uid + 1_000_003) \
+            rk = jax.random.fold_in(key, uid + 1_000_003 + params.extra_seed) \
                 if params.extra_trees else None
             return find_best_split(hist, parent, meta, mask, params,
                                    constraint, depth, monotone_penalty,
@@ -342,6 +350,7 @@ def make_leafwise_grower(
             order=order0,
             leaf_begin=leaf_begin0,
             leaf_phys=leaf_phys0,
+            forced_leaf=jnp.full((max(S_forced, 1), 2), -1, jnp.int32),
             tree=empty_tree(L, W),
             leaf_is_left=jnp.zeros(L, bool),
             num_leaves=jnp.asarray(1, jnp.int32),
@@ -355,10 +364,18 @@ def make_leafwise_grower(
             if S_forced:
                 # forced splits occupy the first S steps (reference
                 # ForceSplits BFS, serial_tree_learner.cpp:427-539); a forced
-                # split that would create an empty child is skipped
+                # split that would create an empty child is skipped, and any
+                # step whose parent step was skipped is skipped too (the
+                # realized forced_leaf entry stays -1)
                 sidx = jnp.minimum(s, S_forced - 1)
                 maybe = s < S_forced
-                fleaf, ffeat = f_leaf[sidx], f_feat[sidx]
+                pstep = f_parent[sidx]
+                fleaf_raw = jnp.where(
+                    pstep < 0, 0,
+                    st.forced_leaf[jnp.maximum(pstep, 0), f_side[sidx]])
+                parent_ok = (pstep < 0) | (fleaf_raw >= 0)
+                fleaf = jnp.maximum(fleaf_raw, 0)
+                ffeat = f_feat[sidx]
                 fthr, fdl = f_bin[sidx], f_dl[sidx]
                 hf = st.hist_pool[fleaf, ffeat]               # (B, 3)
                 cumf = jnp.cumsum(hf, axis=0)
@@ -369,7 +386,7 @@ def make_leafwise_grower(
                 flsum = cumf[fthr] + nan_c * (
                     fdl.astype(jnp.float32) - in_cum.astype(jnp.float32))
                 frsum = st.leaf_sums[fleaf] - flsum
-                ok_f = maybe & (flsum[2] > 0) & (frsum[2] > 0)
+                ok_f = maybe & parent_ok & (flsum[2] > 0) & (frsum[2] > 0)
                 is_forced = ok_f
                 leaf = jnp.where(ok_f, fleaf, leaf)
                 gain = jnp.where(ok_f, jnp.float32(0.0), gain)
@@ -395,6 +412,15 @@ def make_leafwise_grower(
                     iscat = iscat & (~is_forced)
                     bitset = jnp.where(is_forced,
                                        jnp.zeros_like(bitset), bitset)
+                    # record the REALIZED child leaf ids of this forced step
+                    # (left child keeps the parent's leaf id, right child is
+                    # the new leaf) so descendant forced steps resolve
+                    # against actual leaf numbering
+                    forced_next = st.forced_leaf.at[sidx2].set(
+                        jnp.where(is_forced, jnp.stack([leaf, nl]),
+                                  st.forced_leaf[sidx2]))
+                else:
+                    forced_next = st.forced_leaf
                 parent_sum = st.leaf_sums[leaf]
 
                 if partition:
@@ -532,6 +558,7 @@ def make_leafwise_grower(
                     leaf_phys=st.leaf_phys.at[leaf].set(n_l_phys)
                     .at[nl].set(st.leaf_phys[leaf] - n_l_phys) if partition
                     else st.leaf_phys,
+                    forced_leaf=forced_next,
                     tree=tree,
                     leaf_is_left=st.leaf_is_left.at[leaf].set(True).at[nl].set(False),
                     num_leaves=nl + 1,
@@ -631,7 +658,7 @@ def make_levelwise_grower(
     if split_fn is None:
         def split_fn(hist, parent, mask, key, uid, constraint, depth,
                      parent_output, cegb_pen=None):
-            rk = jax.random.fold_in(key, uid + 1_000_003) \
+            rk = jax.random.fold_in(key, uid + 1_000_003 + params.extra_seed) \
                 if params.extra_trees else None
             return find_best_split(hist, parent, meta, mask, params,
                                    constraint, depth, monotone_penalty,
@@ -691,16 +718,21 @@ def make_levelwise_grower(
                 masks = jnp.broadcast_to(base_mask, (Ld, F))
             masks = masks & allowed_features_batch(leaf_used[:Ld])
             cegb_pen = cegb_penalty_batch(leaf_sums[:Ld, 2], cegb_used)
+            # one uid per LEAF (not per level) so extra_trees draws distinct
+            # random thresholds for each node, like the leaf-wise 2s+1/2s+2
+            # numbering; shares the level-d feature-mask uid base
+            uids = d * (2 * L) + jnp.arange(Ld, dtype=jnp.int32)
             if cegb_pen is None:
                 res = jax.vmap(
-                    lambda h, p, m, c, po: split_fn(h, p, m, key, d, c, d, po)
-                )(hist, leaf_sums[:Ld], masks, leaf_constr[:Ld], leaf_out[:Ld])
+                    lambda h, p, m, c, po, u: split_fn(h, p, m, key, u, c, d, po)
+                )(hist, leaf_sums[:Ld], masks, leaf_constr[:Ld], leaf_out[:Ld],
+                  uids)
             else:
                 res = jax.vmap(
-                    lambda h, p, m, c, po, cp: split_fn(
-                        h, p, m, key, d, c, d, po, cp)
+                    lambda h, p, m, c, po, u, cp: split_fn(
+                        h, p, m, key, u, c, d, po, cp)
                 )(hist, leaf_sums[:Ld], masks, leaf_constr[:Ld],
-                  leaf_out[:Ld], cegb_pen)
+                  leaf_out[:Ld], uids, cegb_pen)
 
             gains = jnp.where(leaf_active[:Ld], res.gain, -jnp.inf)
             want = gains > 0

@@ -72,17 +72,20 @@ def _load():
         lib.tp_cols.argtypes = [ctypes.c_void_p]
         lib.tp_fill.restype = ctypes.c_long
         lib.tp_fill.argtypes = [ctypes.c_void_p,
-                                ctypes.POINTER(ctypes.c_double)]
+                                ctypes.POINTER(ctypes.c_double),
+                                ctypes.c_long]
         lib.tp_close.restype = None
         lib.tp_close.argtypes = [ctypes.c_void_p]
         _lib = lib
         return _lib
 
 
-def parse_dense_file(path: str, has_header: bool,
-                     sep: Optional[str]) -> Optional[np.ndarray]:
+def parse_dense_file(path: str, has_header: bool, sep: Optional[str],
+                     num_threads: int = 0) -> Optional[np.ndarray]:
     """Parse a dense numeric table natively; None -> caller falls back to
-    the Python parser (no compiler, malformed rows, etc.)."""
+    the Python parser (no compiler, malformed rows, etc.).
+    ``num_threads`` <= 0 uses hardware concurrency (reference: num_threads
+    caps the OMP pool; here it caps the parser's thread count)."""
     lib = _load()
     if lib is None:
         return None
@@ -95,7 +98,8 @@ def parse_dense_file(path: str, has_header: bool,
         if rows <= 0 or cols <= 0:
             return None
         out = np.empty((rows, cols), dtype=np.float64)
-        bad = lib.tp_fill(h, out.ctypes.data_as(ctypes.POINTER(ctypes.c_double)))
+        bad = lib.tp_fill(h, out.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+                          int(num_threads))
         if bad != 0:
             return None   # ragged rows: let the Python parser report it
         return out

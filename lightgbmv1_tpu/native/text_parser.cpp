@@ -158,11 +158,13 @@ long tp_cols(void* h) { return static_cast<ParsedFile*>(h)->cols; }
 
 // Fill a row-major rows*cols buffer. Returns 0 on success, the failing
 // 1-based row number when a line has the wrong field count.
-long tp_fill(void* h, double* out) {
+// max_threads <= 0 means auto (hardware concurrency).
+long tp_fill(void* h, double* out, long max_threads) {
   auto* pf = static_cast<ParsedFile*>(h);
   const long rows = pf->rows, cols = pf->cols;
   unsigned hw = std::thread::hardware_concurrency();
-  long nthreads = std::max(1L, std::min<long>(hw ? hw : 1, rows / 4096 + 1));
+  long cap = max_threads > 0 ? max_threads : static_cast<long>(hw ? hw : 1);
+  long nthreads = std::max(1L, std::min<long>(cap, rows / 4096 + 1));
   std::vector<std::thread> threads;
   std::vector<long> bad(static_cast<size_t>(nthreads), 0);
   auto work = [&](long t) {

@@ -117,8 +117,22 @@ class ObjectiveFunction:
 class RegressionL2(ObjectiveFunction):
     name = "regression"
 
+    def init(self, metadata, num_data):
+        super().init(metadata, num_data)
+        if self.config.reg_sqrt:
+            # reference regression_objective.hpp:114-120: train on
+            # sign(y)*sqrt(|y|); ConvertOutput squares back
+            t = np.sign(self._np_label) * np.sqrt(np.abs(self._np_label))
+            self._np_label = t
+            self.label = jnp.asarray(t, jnp.float32)
+
     def _grad_hess(self, s):
         return s - self.label, jnp.ones_like(s)
+
+    def convert_output(self, raw):
+        if self.config.reg_sqrt:
+            return jnp.sign(raw) * raw * raw
+        return raw
 
     def boost_from_score(self, class_id=0):
         return self.average_label if self.config.boost_from_average else 0.0

@@ -56,6 +56,8 @@ class SplitParams(NamedTuple):
     # feature_histogram.hpp:756-760) and extremely-randomized trees
     path_smooth: float = 0.0
     extra_trees: bool = False
+    extra_seed: int = 0       # offsets the extra_trees threshold stream
+                              # (reference config.h extra_seed)
     # cost-effective gradient boosting (reference
     # cost_effective_gradient_boosting.hpp:22 DetlaGain)
     cegb_tradeoff: float = 1.0
@@ -110,14 +112,24 @@ class FeatureMeta(NamedTuple):
     is_categorical: jax.Array  # (F,) bool
     usable: jax.Array         # (F,) bool — not trivial
     monotone_type: jax.Array  # (F,) int32 — -1 / 0 / +1 constraint direction
+    contri: Optional[jax.Array] = None  # (F,) f32 feature_contri gain
+                              # multipliers (reference FeatureMetainfo::penalty,
+                              # feature_histogram.hpp:32,94,1139) or None
 
 
-def make_feature_meta(dataset, monotone_constraints=None) -> FeatureMeta:
+def make_feature_meta(dataset, monotone_constraints=None,
+                      feature_contri=None) -> FeatureMeta:
     F = len(dataset.num_bins)
     mono = np.zeros(F, np.int32)
     if monotone_constraints:
         mc = np.asarray(list(monotone_constraints), np.int32)
         mono[: min(F, len(mc))] = mc[:F]
+    contri = None
+    if feature_contri:
+        contri = np.ones(F, np.float32)
+        fc = np.asarray(list(feature_contri), np.float32)
+        contri[: min(F, len(fc))] = fc[:F]
+        contri = jnp.asarray(contri)
     return FeatureMeta(
         num_bins=jnp.asarray(dataset.num_bins, jnp.int32),
         missing_type=jnp.asarray(dataset.missing_types, jnp.int32),
@@ -126,6 +138,7 @@ def make_feature_meta(dataset, monotone_constraints=None) -> FeatureMeta:
         is_categorical=jnp.asarray(dataset.is_categorical),
         usable=jnp.asarray(~dataset.is_trivial),
         monotone_type=jnp.asarray(mono),
+        contri=contri,
     )
 
 
@@ -176,15 +189,40 @@ def bitset_contains(bitset: jax.Array, bins: jax.Array) -> jax.Array:
     return ((word >> (b.astype(jnp.uint32) & 31)) & 1) == 1
 
 
+def _cat_split_gain(lg, lh, rg, rh, lc, rc, p, constraint, parent_output,
+                    use_mc, use_smooth):
+    """GetSplitGains<USE_MC, USE_SMOOTHING> for categorical candidates
+    (reference feature_histogram.hpp:350-355,450-456): leaf outputs smoothed
+    toward the parent and clamped to the leaf's [min, max] bound; no monotone
+    direction check — categorical features cannot carry monotone constraints
+    (dataset_loader.cpp:569 fatals on that combination)."""
+    if not use_mc and not use_smooth:
+        return leaf_gain(lg, lh, p) + leaf_gain(rg, rh, p)
+    out_l = leaf_output(lg, lh, p)
+    out_r = leaf_output(rg, rh, p)
+    if use_smooth:
+        out_l = smooth_output(out_l, lc, parent_output, p)
+        out_r = smooth_output(out_r, rc, parent_output, p)
+    if use_mc:
+        out_l = jnp.clip(out_l, constraint[0], constraint[1])
+        out_r = jnp.clip(out_r, constraint[0], constraint[1])
+    return (leaf_gain_given_output(lg, lh, out_l, p)
+            + leaf_gain_given_output(rg, rh, out_r, p))
+
+
 def _best_categorical(hist, parent_sum, meta, feature_mask, params,
-                      cegb_penalty=None):
+                      shift=0.0, constraint=None, parent_output=0.0,
+                      rand_key=None, cegb_penalty=None):
     """Best categorical split across all features of one leaf.
 
     reference: FindBestThresholdCategoricalInner,
     src/treelearner/feature_histogram.hpp:278-460 — one-vs-rest for features
     with few categories (max_cat_to_onehot), otherwise a two-direction scan
     over bins sorted by grad/(hess+cat_smooth) with cat_l2 regularization and
-    min_data_per_group batching.
+    min_data_per_group batching.  Returned gains are RELATIVE (minus
+    ``shift`` = parent gain + min_gain_to_split) with the per-feature
+    ``meta.contri`` penalty applied, matching ``output->gain`` after
+    FindBestThreshold (feature_histogram.hpp:94).
 
     Deviation from the reference: the trailing "other/unseen/NaN" bin of a
     categorical feature is never placed in the left (in-set) side, so the
@@ -194,6 +232,10 @@ def _best_categorical(hist, parent_sum, meta, feature_mask, params,
     """
     F, B, _ = hist.shape
     eps = 1e-15
+    use_mc = constraint is not None
+    use_smooth = params.path_smooth > 0
+    if constraint is None:
+        constraint = jnp.asarray(NO_CONSTRAINT, jnp.float32)
     g, h, c = hist[..., 0], hist[..., 1], hist[..., 2]
     total_g, total_h, total_c = parent_sum[0], parent_sum[1], parent_sum[2]
     t_idx = lax.broadcasted_iota(jnp.int32, (F, B), 1)
@@ -202,6 +244,9 @@ def _best_categorical(hist, parent_sum, meta, feature_mask, params,
     # exclude the trailing other/unseen bin from left-set membership
     bin_ok = (t_idx < nb - 1) & fmask
     use_onehot = (nb <= params.max_cat_to_onehot)
+    use_rand = params.extra_trees and rand_key is not None
+    if use_rand:
+        ku = jax.random.uniform(jax.random.fold_in(rand_key, 7), (2, F))
 
     # ---- one-vs-rest (reference :316-369) --------------------------------
     oth_g, oth_h, oth_c = total_g - g, total_h - h, total_c - c
@@ -212,7 +257,17 @@ def _best_categorical(hist, parent_sum, meta, feature_mask, params,
         & (oth_c >= params.min_data_in_leaf)
         & (oth_h - eps >= params.min_sum_hessian_in_leaf)
     )
-    gain1 = leaf_gain(g, h + eps, params) + leaf_gain(oth_g, oth_h - eps, params)
+    if use_rand:
+        # USE_RAND (reference :316-318,344-348): only one random bin per
+        # feature is evaluated
+        rb1 = (ku[0] * jnp.maximum(meta.num_bins - 1, 1)
+               ).astype(jnp.int32)[:, None]
+        ok1 = ok1 & (t_idx == rb1)
+    gain1 = _cat_split_gain(g, h + eps, oth_g, oth_h - eps, c, oth_c,
+                            params, constraint, parent_output,
+                            use_mc, use_smooth) - shift
+    if meta.contri is not None:
+        gain1 = gain1 * meta.contri[:, None]
     if cegb_penalty is not None:
         gain1 = gain1 - cegb_penalty[:, None]
     gain1 = jnp.where(ok1, gain1, NEG_INF)
@@ -246,6 +301,13 @@ def _best_categorical(hist, parent_sum, meta, feature_mask, params,
         & (crc >= params.min_data_per_group)
         & (crh >= params.min_sum_hessian_in_leaf)
     )
+    if use_rand:
+        # USE_RAND (reference :400-404,448-451): one random prefix position,
+        # shared by both scan directions; NextInt(0, max_threshold) is
+        # half-open, so positions are drawn from [0, max_threshold)
+        max_thr = jnp.maximum(jnp.minimum(max_num_cat, used_bin) - 1, 0)
+        rp = (ku[1] * jnp.maximum(max_thr, 1)).astype(jnp.int32)
+        pos_ok = pos_ok & (t_idx[None] == rp[None, :, None])
 
     # min_data_per_group batching: evaluate a prefix only when >= mdpg rows
     # accumulated since the previous evaluated prefix (reference
@@ -261,7 +323,11 @@ def _best_categorical(hist, parent_sum, meta, feature_mask, params,
     can_eval = jnp.moveaxis(can_eval, 0, 2)            # (2, F, n_steps)
     can_eval = jnp.pad(can_eval, ((0, 0), (0, 0), (0, B - n_steps)))
 
-    gain2 = leaf_gain(clg, clh, l2cat) + leaf_gain(crg, crh, l2cat)
+    gain2 = _cat_split_gain(clg, clh, crg, crh, clc, crc, l2cat,
+                            constraint, parent_output,
+                            use_mc, use_smooth) - shift
+    if meta.contri is not None:
+        gain2 = gain2 * meta.contri[None, :, None]
     if cegb_penalty is not None:
         gain2 = gain2 - cegb_penalty[None, :, None]
     gain2 = jnp.where(can_eval, gain2, NEG_INF)        # (2, F, B)
@@ -385,23 +451,33 @@ def find_best_split(
         base_valid & has_nan_dir, eval_direction(left_b), NEG_INF
     )
 
-    gains = jnp.stack([gain_a, gain_b])               # (2, F, B)
+    if use_smooth:
+        # reference: with smoothing the gain shift is the leaf's gain AT its
+        # current (already-smoothed) output value
+        parent_gain = leaf_gain_given_output(total_g, total_h,
+                                             parent_output, params)
+    else:
+        parent_gain = leaf_gain(total_g, total_h, params)
+    shift = parent_gain + params.min_gain_to_split
+
+    # Work in RELATIVE gains from here on — the reference's output->gain is
+    # best_gain - min_gain_shift, and every penalty below operates on that
+    # relative value (ComputeBestSplitForFeature,
+    # serial_tree_learner.cpp:701-736):
+    #   1. feature_contri multiply (inside FindBestThreshold,
+    #      feature_histogram.hpp:94)
+    #   2. CEGB DetlaGain subtract (serial_tree_learner.cpp:723-727)
+    #   3. monotone depth-penalty multiply (:728-732)
+    gains = jnp.stack([gain_a, gain_b]) - shift       # (2, F, B)
+    finite = jnp.isfinite(gains)
+    if meta.contri is not None:
+        gains = jnp.where(finite, gains * meta.contri[None, :, None], gains)
+    if cegb_penalty is not None:
+        gains = jnp.where(finite, gains - cegb_penalty[None, :, None], gains)
     if use_mc and monotone_penalty > 0:
-        # reference: ComputeBestSplitForFeature multiplies the relative gain
-        # by the depth penalty for monotone features
-        # (serial_tree_learner.cpp:701-736)
-        pg = leaf_gain(total_g, total_h, params)
         factor = monotone_penalty_factor(jnp.asarray(depth), monotone_penalty)
         mono_f = (meta.monotone_type != 0)[None, :, None]
-        gains = jnp.where(
-            jnp.isfinite(gains) & mono_f, (gains - pg) * factor + pg, gains)
-    if cegb_penalty is not None:
-        # reference: new_split.gain -= cegb_->DetlaGain(...) AFTER the
-        # monotone depth-penalty scaling
-        # (serial_tree_learner.cpp FindBestSplitsFromHistograms); the delta
-        # is feature-wise constant for a given leaf
-        gains = jnp.where(jnp.isfinite(gains),
-                          gains - cegb_penalty[None, :, None], gains)
+        gains = jnp.where(finite & mono_f, gains * factor, gains)
     flat = gains.reshape(-1)
     best = jnp.argmax(flat)
     best_gain = flat[best]
@@ -420,6 +496,8 @@ def find_best_split(
     if has_cat:
         cgain, cfeat, cleft, cbitset = _best_categorical(
             hist, parent_sum, meta, feature_mask, params,
+            shift=shift, constraint=constraint if use_mc else None,
+            parent_output=parent_output, rand_key=rand_key,
             cegb_penalty=cegb_penalty)
         use_cat = cgain > best_gain
         best_gain = jnp.maximum(best_gain, cgain)
@@ -443,15 +521,8 @@ def find_best_split(
     )
     default_left = default_left & (~is_cat)
 
-    if use_smooth:
-        # reference: with smoothing the gain shift is the leaf's gain AT its
-        # current (already-smoothed) output value
-        parent_gain = leaf_gain_given_output(total_g, total_h,
-                                             parent_output, params)
-    else:
-        parent_gain = leaf_gain(total_g, total_h, params)
-    rel_gain = best_gain - parent_gain - params.min_gain_to_split
-    rel_gain = jnp.where(jnp.isfinite(best_gain), rel_gain, NEG_INF)
+    # best_gain is already relative (shift subtracted before the argmax)
+    rel_gain = jnp.where(jnp.isfinite(best_gain), best_gain, NEG_INF)
 
     return SplitResult(
         gain=rel_gain.astype(jnp.float32),
@@ -500,7 +571,15 @@ def per_feature_best_gain(
     ga = jnp.where(valid, gains_for(cum), NEG_INF)
     gb = jnp.where(valid & has_nan_dir,
                    gains_for(cum + nan_contrib[:, None, :]), NEG_INF)
-    return jnp.maximum(ga.max(axis=1), gb.max(axis=1))
+    best = jnp.maximum(ga.max(axis=1), gb.max(axis=1))
+    # votes rank RELATIVE gains with the feature_contri penalty applied,
+    # like the full search (the constant shift is rank-neutral without
+    # contri, but with per-feature multipliers it changes the ordering)
+    shift = leaf_gain(total_g, total_h, params) + params.min_gain_to_split
+    best = jnp.where(jnp.isfinite(best), best - shift, best)
+    if meta.contri is not None:
+        best = jnp.where(jnp.isfinite(best), best * meta.contri, best)
+    return best
 
 
 # vmapped over a batch of leaves: hist (K, F, B, 3), parent (K, 3), mask (K, F),

@@ -109,10 +109,16 @@ def init_cluster(
             log_fatal("Could not find the local machine in the machines "
                       "list (reference rank discovery failed)")
 
+    kw = {}
+    if config is not None and config.time_out > 0:
+        # reference: network time_out is in MINUTES (config.h:692); it bounds
+        # the socket-linker connect phase, here the coordinator barrier
+        kw["initialization_timeout"] = config.time_out * 60
     jax.distributed.initialize(
         coordinator_address=coordinator_address,
         num_processes=num_processes,
         process_id=process_id,
+        **kw,
     )
     _initialized = True
     log_info(

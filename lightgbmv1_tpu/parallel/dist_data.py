@@ -85,6 +85,10 @@ def find_bins_distributed(local_samples: List[np.ndarray], sample_cnt: int,
         samples = local_samples
         total_cnt = sample_cnt
 
+    from ..io.binning import get_forced_bins
+
+    forced = get_forced_bins(config.forcedbins_filename, len(samples),
+                             categorical)
     return [
         BinMapper.find_bin(
             np.asarray(samples[j], np.float64),
@@ -94,6 +98,7 @@ def find_bins_distributed(local_samples: List[np.ndarray], sample_cnt: int,
             bin_type=BIN_CATEGORICAL if j in categorical else BIN_NUMERICAL,
             use_missing=config.use_missing,
             zero_as_missing=config.zero_as_missing,
+            forced_bounds=forced[j],
         )
         for j in range(len(samples))
     ]
@@ -111,6 +116,11 @@ def load_distributed(path: str, config: Config,
     import jax
 
     rank, world = jax.process_index(), jax.process_count()
+    # pre_partition=true: each process's data file already holds ONLY its
+    # rows, so the loader-level rank row-shard is skipped (reference:
+    # config.h is_pre_partition / dataset_loader.cpp:167 LoadFromFile with
+    # used_data_indices bypass when pre-partitioned)
+    shard_here = world > 1 and not config.pre_partition
     df = load_data_file(
         path,
         has_header=config.header,
@@ -118,11 +128,12 @@ def load_distributed(path: str, config: Config,
         weight_column=config.weight_column,
         group_column=config.group_column,
         ignore_column=config.ignore_column,
-        rank=rank if world > 1 else None,
+        rank=rank if shard_here else None,
         num_machines=world,
     )
     log_info(f"Process {rank}/{world}: {df.X.shape[0]} local rows "
-             "(reference rank pre-partition)")
+             + ("(pre-partitioned input)" if config.pre_partition and world > 1
+                else "(reference rank pre-partition)"))
     if world > 1:
         # keep the GLOBAL gathered sample within the configured budget:
         # each rank contributes its share (the gather concatenates them)

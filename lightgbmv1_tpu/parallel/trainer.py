@@ -132,10 +132,17 @@ def _cegb_coupled(config: Config, num_features: int):
 
 
 def parse_forced_splits(filename: str, bin_mappers, num_leaves: int):
-    """forcedsplits_filename JSON -> (S, 4) [leaf, feature, bin, dl] in BFS
-    order with the grower's leaf numbering (reference:
-    SerialTreeLearner::ForceSplits, serial_tree_learner.cpp:427-539; JSON
-    format {'feature': f, 'threshold': t, 'left': {...}, 'right': {...}})."""
+    """forcedsplits_filename JSON -> (S, 5) [parent_step, side, feature, bin,
+    dl] in BFS order (reference: SerialTreeLearner::ForceSplits,
+    serial_tree_learner.cpp:427-539; JSON format {'feature': f,
+    'threshold': t, 'left': {...}, 'right': {...}}).
+
+    Leaf ids are NOT precomputed: a forced step can be skipped at runtime
+    (empty child), which shifts every later leaf index, so each entry names
+    its PARENT forced step (-1 = root) and which child leaf (0 = left,
+    1 = right) it splits; the grower resolves the realized leaf id from the
+    tracked per-step [left, right] leaves (the analog of the reference's
+    ``left_``/``right_`` queues carrying actual leaf indices)."""
     import json
 
     if not filename:
@@ -145,20 +152,19 @@ def parse_forced_splits(filename: str, bin_mappers, num_leaves: int):
     if not spec:
         return None
     out = []
-    queue = [(spec, 0)]
+    queue = [(spec, -1, 0)]
     step = 0
     while queue and step < num_leaves - 1:
-        node, leaf = queue.pop(0)
+        node, pstep, side = queue.pop(0)
         f = int(node["feature"])
         thr = float(node["threshold"])
         b = int(bin_mappers[f].value_to_bin(np.asarray([thr]))[0])
         dl = bool(node.get("default_left", False))
-        out.append([leaf, f, b, int(dl)])
-        new_leaf = step + 1
+        out.append([pstep, side, f, b, int(dl)])
         if node.get("left"):
-            queue.append((node["left"], leaf))
+            queue.append((node["left"], step, 0))
         if node.get("right"):
-            queue.append((node["right"], new_leaf))
+            queue.append((node["right"], step, 1))
         step += 1
     return np.asarray(out, np.int64) if out else None
 
@@ -180,6 +186,14 @@ def build_trainer(
     precision = config.hist_dtype
     F, N = binned_np.shape
     B = num_bins
+
+    if config.device_type in ("gpu", "cuda"):
+        # reference configs select the OpenCL/CUDA learners here; this
+        # framework's accelerated path is the TPU/XLA backend
+        log_warning(f"device_type={config.device_type}: this framework's "
+                    f"device path is XLA ({jax.default_backend()} backend); "
+                    "the GPU-learner role is filled by the Pallas histogram "
+                    "kernel")
 
     from ..models.grower import make_levelwise_grower
     from ..ops.histogram import hist_frontier
@@ -296,7 +310,7 @@ def build_trainer(
             hist_sel = lax.psum(local_hist[selected], "data")  # (sel_k, B, 3)
             full = jnp.zeros((F, B, 3), jnp.float32).at[selected].set(hist_sel)
             sel_mask = jnp.zeros(F, bool).at[selected].set(True)
-            rk = jax.random.fold_in(key, uid + 1_000_003) \
+            rk = jax.random.fold_in(key, uid + 1_000_003 + params.extra_seed) \
                 if params.extra_trees else None
             return find_best_split(full, parent, meta, mask & sel_mask,
                                    params, constraint, depth,
@@ -414,6 +428,8 @@ def build_trainer(
             is_categorical=jnp.pad(meta.is_categorical, (0, pad_f)),
             usable=jnp.pad(meta.usable, (0, pad_f)),
             monotone_type=jnp.pad(meta.monotone_type, (0, pad_f)),
+            contri=(jnp.pad(meta.contri, (0, pad_f), constant_values=1.0)
+                    if meta.contri is not None else None),
         )
         log_info(f"Feature-parallel training over {ndev} devices "
                  f"({F_loc} features/device)")
@@ -438,7 +454,7 @@ def build_trainer(
             ) & (
                 lax.broadcasted_iota(jnp.int32, (F_pad, 1), 0)[:, 0] < lo + F_loc
             )
-            rk = jax.random.fold_in(key, uid + 1_000_003) \
+            rk = jax.random.fold_in(key, uid + 1_000_003 + params.extra_seed) \
                 if params.extra_trees else None
             local = find_best_split(hist, parent, meta_p, mask & in_shard,
                                     params, constraint, depth,
