@@ -288,7 +288,17 @@ class Config:
     verbosity: int = 1
 
     # -- TPU-specific (new; no reference equivalent) ------------------------
-    tree_growth: str = "leafwise"  # leafwise (reference semantics) | levelwise (batched)
+    tree_growth: str = "leafwise"  # leafwise (best-first policy, wave-batched
+                                   # schedule) | leafwise_serial (one split
+                                   # per round — the reference's exact
+                                   # sequential order) | leafwise_masked
+                                   # (sequential, O(N)-per-split variant) |
+                                   # levelwise (depth-wise batched)
+    leafwise_wave_size: int = 0    # frontier leaves split per round in the
+                                   # wave-batched leaf-wise schedule; 0 =
+                                   # auto (num_leaves/16 — sequential for
+                                   # small trees); 1 == exact sequential
+                                   # best-first order
     hist_method: str = "auto"      # auto | scatter | onehot | pallas
     hist_dtype: str = "bf16x2"     # bf16 | bf16x2 | f32 | int8 (quantized) precision
     num_shards: int = 0            # devices for data-parallel (0 = all available)

@@ -193,6 +193,23 @@ def hist_frontier(
     return hist_leaves_scatter(binned, g3, leaf_id, num_leaves, num_bins)
 
 
+def hist_wave(
+    binned: jax.Array,
+    g3: jax.Array,
+    label: jax.Array,       # (N,) int32 — child slot per row; nslots = dead
+    nslots: int,
+    num_bins: int,
+    method: str = "scatter",
+    precision: str = "bf16x2",
+) -> jax.Array:             # (nslots, F, B, 3)
+    """Histograms of the rows labeled ``0..nslots-1`` in one pass; rows
+    labeled ``nslots`` (not part of the current wave) contribute nothing.
+    Used by the wave-batched leaf-wise grower (models/grower_wave.py): one
+    sacrificial slot absorbs the dead rows, then is sliced away."""
+    return hist_frontier(binned, g3, label, nslots + 1, num_bins,
+                         method=method, precision=precision)[:nslots]
+
+
 def default_hist_method(config_method: str = "auto",
                         bin_dtype=None) -> str:
     """Pick the histogram implementation.

@@ -45,8 +45,9 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..config import Config
 from ..models.grower import make_leafwise_grower
+from ..models.grower_wave import make_wave_grower
 from ..models.tree import TreeArrays
-from ..ops.histogram import default_hist_method, hist_one_leaf
+from ..ops.histogram import default_hist_method, hist_one_leaf, hist_wave
 from ..ops.split import FeatureMeta, SplitParams, SplitResult, find_best_split
 from ..utils.log import log_fatal, log_info, log_warning
 
@@ -208,6 +209,23 @@ def build_trainer(
         return hist_frontier(binned, g3, leaf_id, L_level, B,
                              method=method, precision=precision)
 
+    def local_wave(binned, g3, label, nslots):
+        return hist_wave(binned, g3, label, nslots, B,
+                         method=method, precision=precision)
+
+    # the wave-batched best-first schedule is the leaf-wise default; CEGB
+    # needs the sequential grower's exact split ORDER (its penalties depend
+    # on the features used by earlier splits of the same tree), and forced
+    # splits occupy the first steps of the sequential order
+    use_cegb = (config.cegb_tradeoff * config.cegb_penalty_split > 0
+                or bool(config.cegb_penalty_feature_coupled))
+    wave_size = config.leafwise_wave_size
+    if wave_size == 0:   # auto: batched for big trees, sequential for small
+        wave_size = max(1, config.num_leaves // 16)
+    use_wave = (config.tree_growth == "leafwise"
+                and wave_size > 1
+                and not use_cegb)
+
     if config.monotone_constraints and \
             config.monotone_constraints_method not in ("basic", ""):
         log_warning(
@@ -228,6 +246,8 @@ def build_trainer(
             config.interaction_constraints, F),
         cegb_coupled=_cegb_coupled(config, F),
     )
+    wave_common = {k: v for k, v in common.items() if k != "cegb_coupled"}
+    wave_common["wave_size"] = wave_size
     forced = None
     if config.forcedsplits_filename:
         if bin_mappers is None:
@@ -242,9 +262,14 @@ def build_trainer(
     if learner in ("serial", ""):
         if levelwise:
             grow = make_levelwise_grower(hist_frontier_fn=local_frontier, **common)
+        elif use_wave and forced is None:
+            # wave-batched best-first: the leaf-wise default schedule
+            # (models/grower_wave.py)
+            grow = make_wave_grower(hist_wave_fn=local_wave, **wave_common)
         else:
-            # the DataPartition-based fast path is the serial default;
-            # tree_growth=leafwise_masked keeps the O(N)-per-split variant
+            # sequential best-first (the reference's exact split order):
+            # DataPartition fast path by default; tree_growth=leafwise_masked
+            # keeps the O(N)-per-split variant
             grow = make_leafwise_grower(
                 hist_fn=local_hist, forced_splits=forced,
                 partition=(config.tree_growth != "leafwise_masked"),
@@ -317,8 +342,16 @@ def build_trainer(
                                    config.monotone_penalty, parent_output,
                                    rk, cegb_pen)
 
-        grow = make_leafwise_grower(
-            hist_fn=hist_fn, split_fn=split_fn, sums_fn=sums_fn, **common)
+        if use_wave:
+            # the wave grower's vmapped split_fn batches the vote psum and
+            # the selective histogram reduce across all 2K children of a
+            # round — same PV-Tree semantics, one collective round-trip
+            grow = make_wave_grower(hist_wave_fn=local_wave,
+                                    split_fn=split_fn, sums_fn=sums_fn,
+                                    **wave_common)
+        else:
+            grow = make_leafwise_grower(
+                hist_fn=hist_fn, split_fn=split_fn, sums_fn=sums_fn, **common)
         sharded = shard_map(
             grow,
             mesh=mesh,
@@ -378,6 +411,15 @@ def build_trainer(
 
             grow = make_levelwise_grower(
                 hist_frontier_fn=frontier_fn, sums_fn=sums_fn, **common)
+        elif use_wave:
+            # one histogram Allreduce per ROUND (up to 2K child histograms
+            # batched in a single psum) instead of one per split — the wave
+            # schedule's distributed dividend
+            def wave_fn(binned, g3, label, nslots):
+                return lax.psum(local_wave(binned, g3, label, nslots), "data")
+
+            grow = make_wave_grower(hist_wave_fn=wave_fn, sums_fn=sums_fn,
+                                    **wave_common)
         else:
             grow = make_leafwise_grower(hist_fn=hist_fn, sums_fn=sums_fn, **common)
         sharded = shard_map(
@@ -444,6 +486,14 @@ def build_trainer(
             full = jnp.zeros((F_pad, B, 3), jnp.float32)
             return lax.dynamic_update_slice(full, h, (lo, 0, 0))
 
+        def hist_wave_fp(binned, g3, label, nslots):
+            lo = lax.axis_index("feature") * F_loc
+            block = lax.dynamic_slice(binned, (lo, 0), (F_loc, N))
+            h = hist_wave(block, g3, label, nslots, B,
+                          method=method, precision=precision)
+            full = jnp.zeros((nslots, F_pad, B, 3), jnp.float32)
+            return lax.dynamic_update_slice(full, h, (0, lo, 0, 0))
+
         def split_fn(hist, parent, mask, key, uid, constraint, depth,
                      parent_output, cegb_pen=None):
             # search only this device's features, then Allreduce-max over
@@ -468,16 +518,22 @@ def build_trainer(
         coupled_fp = _cegb_coupled(config, F)
         if coupled_fp is not None:
             coupled_fp = np.pad(coupled_fp, (0, pad_f))
-        grow = make_leafwise_grower(
-            hist_fn=hist_fn, split_fn=split_fn,
+        fp_kwargs = dict(
             num_leaves=config.num_leaves, num_bins=B, meta=meta_p,
             params=params, max_depth=config.max_depth,
             feature_fraction_bynode=config.feature_fraction_bynode,
             monotone_penalty=config.monotone_penalty,
             interaction_groups=parse_interaction_constraints(
                 config.interaction_constraints, F_pad),
-            cegb_coupled=coupled_fp,
         )
+        if use_wave:
+            grow = make_wave_grower(
+                hist_wave_fn=hist_wave_fp, split_fn=split_fn,
+                wave_size=wave_size, **fp_kwargs)
+        else:
+            grow = make_leafwise_grower(
+                hist_fn=hist_fn, split_fn=split_fn, cegb_coupled=coupled_fp,
+                **fp_kwargs)
         sharded = shard_map(
             grow,
             mesh=mesh,

@@ -33,7 +33,8 @@ def make_problem(n=4000, seed=0):
 def test_partition_matches_masked(params):
     X, y = make_problem()
     params = {**params, "verbosity": -1}
-    a = lgb.train(params, lgb.Dataset(X, label=y, categorical_feature=[7]),
+    a = lgb.train({**params, "tree_growth": "leafwise_serial"},
+                  lgb.Dataset(X, label=y, categorical_feature=[7]),
                   num_boost_round=5)
     b = lgb.train({**params, "tree_growth": "leafwise_masked"},
                   lgb.Dataset(X, label=y, categorical_feature=[7]),
