@@ -157,6 +157,10 @@ def main():
         "leafwise_M_row_trees_per_s": round(leafwise_mrt, 3),
         "leafwise_auc": (round(leafwise_auc, 5)
                          if leafwise_auc is not None else None),
+        # auc_iters fields record the ACTUAL tree counts behind each auc —
+        # with BENCH_TREES overridden high the timed blocks can overshoot
+        # AUC_ITERS, making the ref comparison no longer like-for-like
+        "leafwise_auc_iters": int(gb_lw.iter),
         "leafwise_vs_ref_same_host": round(leafwise_mrt / ref_same_host_mrt,
                                            4),
     }))

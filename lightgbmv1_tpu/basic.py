@@ -107,10 +107,21 @@ class Dataset:
                 from .io.parser import load_two_round
 
                 cat2 = []
+                cat_named = []
                 if categorical_feature not in ("auto", None):
+                    cat_named = [c for c in categorical_feature
+                                 if isinstance(c, str)]
                     cat2 = [int(c) for c in categorical_feature
                             if not isinstance(c, str)]
-                binned = load_two_round(str(data), cfg, cat2)
+                if cat_named:
+                    # name resolution needs the constructed header map; the
+                    # in-memory path below handles it
+                    log_warning(
+                        "two_round with named categorical_feature columns "
+                        "falls back to the in-memory loader")
+                    binned = None
+                else:
+                    binned = load_two_round(str(data), cfg, cat2)
                 if binned is not None:
                     self._binned = binned
                     self.data = None
@@ -130,7 +141,11 @@ class Dataset:
                     group_column=cfg.group_column,
                     ignore_column=cfg.ignore_column,
                     num_threads=cfg.num_threads,
-                    init_score_file=cfg.initscore_filename,
+                    # initscore_filename describes the TRAINING data only;
+                    # valid sets use valid_data_initscores (reference:
+                    # config.h initscore_filename doc, application.cpp:90)
+                    init_score_file=(cfg.initscore_filename
+                                     if reference is None else ""),
                 )
                 self.data = df.X
                 label = df.label if label is None else label

@@ -225,10 +225,17 @@ def get_forced_bins(path: str, num_total_features: int,
     except OSError:
         log_warning(f"Could not open {path}. Will ignore.")
         return forced
+    except json.JSONDecodeError as e:
+        from ..utils.log import log_fatal
+        log_fatal(f"Forced bins file {path} is not valid JSON: {e}")
     for entry in spec:
         f = int(entry["feature"])
-        if f >= num_total_features:
-            continue
+        if f >= num_total_features or f < 0:
+            # reference: CHECK_LT(forced_bins_arr[i]["feature"].int_value(),
+            # num_total_features) aborts (dataset_loader.cpp:1217)
+            from ..utils.log import log_fatal
+            log_fatal(f"Forced bins feature index {f} is out of range "
+                      f"(num features = {num_total_features})")
         if f in categorical:
             log_warning(f"Feature {f} is categorical. Will ignore forced "
                         "bins for this feature.")

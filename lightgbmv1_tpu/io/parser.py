@@ -44,6 +44,15 @@ def _detect_format(sample_lines: List[str]) -> str:
     return "tsv"  # whitespace-separated
 
 
+# missing-value spellings accepted by the reference's Atof path
+# (reference: include/LightGBM/utils/common.h Atof "na"/"nan"/"null" handling)
+_MISS_TOKENS = frozenset(("", "na", "nan", "NA", "NaN", "null"))
+
+
+def _fval(tok: str) -> float:
+    return float(tok) if tok not in _MISS_TOKENS else np.nan
+
+
 def _parse_dense(lines: List[str], sep: Optional[str]) -> np.ndarray:
     rows = []
     for line in lines:
@@ -51,8 +60,7 @@ def _parse_dense(lines: List[str], sep: Optional[str]) -> np.ndarray:
         if not line:
             continue
         parts = line.split(sep) if sep else line.split()
-        rows.append([float(p) if p not in ("", "na", "nan", "NA", "NaN", "null")
-                     else np.nan for p in parts])
+        rows.append([_fval(p) for p in parts])
     return np.asarray(rows, dtype=np.float64)
 
 
@@ -171,8 +179,7 @@ def load_two_round(path: str, config, categorical_features=None):
 
     def parse_row(line):
         parts = line.split(sep) if sep else line.split()
-        return [float(p) if p not in ("", "na", "nan", "NA", "NaN", "null")
-                else np.nan for p in parts]
+        return [_fval(p) for p in parts]
 
     # ---- pass 1: metadata columns + reservoir sample for binning ---------
     rng = np.random.RandomState(config.data_random_seed)
@@ -180,10 +187,7 @@ def load_two_round(path: str, config, categorical_features=None):
     sample_rows: List[list] = []
     label_l, weight_l, group_l = [], [], []
     n_rows = 0
-    _miss = ("", "na", "nan", "NA", "NaN", "null")
-
-    def fval(tok):
-        return float(tok) if tok not in _miss else np.nan
+    fval = _fval
 
     with open(path) as fh:
         if config.header:

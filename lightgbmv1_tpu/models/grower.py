@@ -82,6 +82,18 @@ class GrowerState(NamedTuple):
     done: jax.Array           # () bool
 
 
+def allowed_features_for(groups, used):
+    """reference ColSampler::GetByNode: branch features + union of
+    interaction-constraint groups containing ALL branch features
+    (src/treelearner/col_sampler.hpp:92-112).  ``groups`` is the (G, F)
+    bool constraint matrix or None; ``used`` the leaf's (F,) branch-feature
+    mask.  Shared by the sequential, level-wise and wave growers."""
+    if groups is None:
+        return jnp.ones_like(used)
+    fits = jnp.all(groups | ~used[None, :], axis=1)       # (G,)
+    return used | jnp.any(groups & fits[:, None], axis=0)
+
+
 def _node_feature_mask(key, uid, base_mask, fraction: float):
     """Per-node column sampling (reference: ColSampler bynode,
     src/treelearner/col_sampler.hpp:20)."""
@@ -184,12 +196,7 @@ def make_leafwise_grower(
                                    parent_output, rk, cegb_pen)
 
     def allowed_features(used):
-        """reference GetByNode: branch features + union of constraint
-        groups containing ALL branch features."""
-        if groups is None:
-            return jnp.ones_like(used)
-        fits = jnp.all(groups | ~used[None, :], axis=1)       # (G,)
-        return used | jnp.any(groups & fits[:, None], axis=0)
+        return allowed_features_for(groups, used)
 
     if sums_fn is None:
         def sums_fn(g3):
@@ -671,8 +678,7 @@ def make_levelwise_grower(
     def allowed_features_batch(used):
         if groups_lw is None:
             return jnp.ones_like(used)
-        fits = jnp.all(groups_lw[None] | ~used[:, None, :], axis=2)  # (K, G)
-        return used | jnp.any(groups_lw[None] & fits[:, :, None], axis=1)
+        return jax.vmap(lambda u: allowed_features_for(groups_lw, u))(used)
 
     def clamp_out_batch(sums, constr, parent_out=None):
         out = jax.vmap(lambda s: leaf_output(s[0], s[1], params))(sums)

@@ -27,8 +27,11 @@ computes the histograms of all ``2K`` children in ONE batched device pass:
   (serial_tree_learner.cpp:358-425).
 
 At ``K = 1`` the schedule IS the reference's best-first order (one leaf per
-round, ranked by argmax over the frontier) and produces identical trees to
-the sequential grower (tests/test_wave_grower.py).  At ``K > 1`` the tree
+round, ranked by argmax over the frontier) and reproduces the sequential
+grower's trees split-for-split up to fp summation differences — the
+sequential grower derives the larger child histogram by parent subtraction
+while this one computes both children directly, so histogram values can
+differ at the ulp level and flip near-tie splits (tests/test_wave_grower.py).  At ``K > 1`` the tree
 can deviate from strict best-first only through the budget boundary: a
 round commits its top-K leaves together, so children created inside the
 round cannot displace the round's lower-ranked picks.  Rounds are
@@ -44,7 +47,6 @@ feature-/voting-parallel learners substitute ``split_fn``.
 
 from __future__ import annotations
 
-import math
 from typing import Callable, NamedTuple, Optional
 
 import jax
@@ -61,6 +63,7 @@ from ..ops.split import (
     leaf_output,
     smooth_output,
 )
+from .grower import _node_feature_mask, allowed_features_for
 from .tree import TreeArrays, empty_tree
 
 
@@ -101,21 +104,6 @@ def _topk_by_rank(gains: jax.Array, K: int):
     vals = jnp.sum(jnp.where(sel, gains[None, :], 0.0), axis=1)
     # rows whose rank never matched (can't happen: ranks are a permutation)
     return vals, leafs
-
-
-def _node_feature_mask(key, uid, base_mask, fraction: float):
-    """Per-node column sampling (reference ColSampler bynode,
-    src/treelearner/col_sampler.hpp:20) — same stream as the sequential
-    grower (uids 2·node+1 / 2·node+2)."""
-    if fraction >= 1.0:
-        return base_mask
-    F = base_mask.shape[0]
-    scores = jax.random.uniform(jax.random.fold_in(key, uid), (F,))
-    scores = jnp.where(base_mask, scores, jnp.inf)
-    n_allowed = jnp.sum(base_mask)
-    k = jnp.maximum(1, jnp.ceil(fraction * n_allowed)).astype(jnp.int32)
-    thresh = jnp.sort(scores)[jnp.maximum(k - 1, 0)]
-    return base_mask & (scores <= thresh)
 
 
 def make_wave_grower(
@@ -167,11 +155,7 @@ def make_wave_grower(
             return g3.sum(axis=0)
 
     def allowed_features(used):
-        """reference ColSampler::GetByNode branch-features semantics."""
-        if groups is None:
-            return jnp.ones_like(used)
-        fits = jnp.all(groups | ~used[None, :], axis=1)       # (G,)
-        return used | jnp.any(groups & fits[:, None], axis=0)
+        return allowed_features_for(groups, used)
 
     def clamp_out(sums, constr, parent_out):
         out = leaf_output(sums[0], sums[1], params)

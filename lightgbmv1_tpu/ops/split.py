@@ -542,6 +542,7 @@ def per_feature_best_gain(
     meta: FeatureMeta,
     feature_mask: jax.Array,  # (F,) bool
     params: SplitParams,
+    parent_output=0.0,        # leaf's current output (path smoothing shift)
 ) -> jax.Array:               # (F,) best split gain per feature (-inf if none)
     """Per-feature best numerical gain — the PV-Tree voting score
     (reference: VotingParallelTreeLearner computes local best splits per
@@ -574,8 +575,16 @@ def per_feature_best_gain(
     best = jnp.maximum(ga.max(axis=1), gb.max(axis=1))
     # votes rank RELATIVE gains with the feature_contri penalty applied,
     # like the full search (the constant shift is rank-neutral without
-    # contri, but with per-feature multipliers it changes the ordering)
-    shift = leaf_gain(total_g, total_h, params) + params.min_gain_to_split
+    # contri, but with per-feature multipliers it changes the ordering);
+    # with path smoothing the shift is the smoothed parent gain, matching
+    # find_best_split's baseline so votes rank consistently with the
+    # search they gate
+    if params.path_smooth > 0:
+        parent_gain = leaf_gain_given_output(total_g, total_h,
+                                             parent_output, params)
+    else:
+        parent_gain = leaf_gain(total_g, total_h, params)
+    shift = parent_gain + params.min_gain_to_split
     best = jnp.where(jnp.isfinite(best), best - shift, best)
     if meta.contri is not None:
         best = jnp.where(jnp.isfinite(best), best * meta.contri, best)

@@ -222,9 +222,17 @@ def build_trainer(
     wave_size = config.leafwise_wave_size
     if wave_size == 0:   # auto: batched for big trees, sequential for small
         wave_size = max(1, config.num_leaves // 16)
+    # cap bounds the unrolled per-round decision loop's compile-time graph
+    if wave_size > 64:
+        log_warning(f"leafwise_wave_size={wave_size} capped to 64 (the "
+                    "per-round decision pass unrolls over the wave)")
+        wave_size = 64
+    # auto wave_size == 1 routes to the sequential grower (same trees,
+    # compacted-segment histograms); an EXPLICIT leafwise_wave_size >= 1
+    # forces the wave grower (K=1 == sequential order, used by parity tests)
     use_wave = (config.tree_growth == "leafwise"
-                and wave_size > 1
-                and not use_cegb)
+                and not use_cegb
+                and (config.leafwise_wave_size >= 1 or wave_size > 1))
 
     if config.monotone_constraints and \
             config.monotone_constraints_method not in ("basic", ""):
@@ -318,7 +326,7 @@ def build_trainer(
             # local parent stats: any feature's bin sums cover the shard rows
             local_parent = local_hist[0].sum(axis=0)
             gains = per_feature_best_gain(local_hist, local_parent, meta,
-                                          mask, params)
+                                          mask, params, parent_output)
             if cegb_pen is not None:
                 # CEGB must influence WHICH features win the vote, not just
                 # the final reduced search (serial-semantics parity)
