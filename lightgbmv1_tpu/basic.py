@@ -29,6 +29,11 @@ from .models.tree import HostTree
 from .utils.log import LightGBMError, log_fatal, log_info, log_warning
 
 
+def _is_scipy_sparse(data) -> bool:
+    return type(data).__module__.split(".")[0] == "scipy" and hasattr(
+        data, "tocsr")
+
+
 def _to_2d_numpy(data) -> np.ndarray:
     if hasattr(data, "values") and not isinstance(data, np.ndarray):  # pandas
         data = data.values
@@ -155,6 +160,10 @@ class Dataset:
                     if init_score is None else init_score
                 if df.feature_names and feature_name == "auto":
                     self.feature_name = df.feature_names
+        elif _is_scipy_sparse(data):
+            # kept sparse: construct() feeds the CSR triplets straight into
+            # the EFB bundling path (reference: LGBM_DatasetCreateFromCSR)
+            self.data = data.tocsr()
         else:
             self.data = _to_2d_numpy(data) if data is not None else None
 
@@ -189,17 +198,32 @@ class Dataset:
                 else:
                     cat.append(int(c))
         ref_binned = self.reference.construct()._binned if self.reference is not None else None
-        self._binned = BinnedDataset.from_numpy(
-            self.data,
-            label=self.label,
-            weight=self.weight,
-            group=self.group,
-            init_score=self.init_score,
-            config=cfg,
-            categorical_features=cat,
-            feature_names=self._feature_names_list(),
-            reference=ref_binned,
-        )
+        if _is_scipy_sparse(self.data):
+            csr = self.data
+            self._binned = BinnedDataset.from_csr(
+                csr.indptr, csr.indices, csr.data,
+                num_data=csr.shape[0], num_features=csr.shape[1],
+                label=self.label,
+                weight=self.weight,
+                group=self.group,
+                init_score=self.init_score,
+                config=cfg,
+                categorical_features=cat,
+                feature_names=self._feature_names_list(),
+                reference=ref_binned,
+            )
+        else:
+            self._binned = BinnedDataset.from_numpy(
+                self.data,
+                label=self.label,
+                weight=self.weight,
+                group=self.group,
+                init_score=self.init_score,
+                config=cfg,
+                categorical_features=cat,
+                feature_names=self._feature_names_list(),
+                reference=ref_binned,
+            )
         if self.free_raw_data:
             self.data = None
         return self
@@ -519,6 +543,17 @@ class Booster:
         **kwargs,
     ) -> np.ndarray:
         """Prediction on raw features (reference basic.py:2816 / Predictor)."""
+        if _is_scipy_sparse(data) and data.shape[0] > 65536:
+            # chunked densification bounds peak memory on wide-sparse input
+            outs = [
+                self.predict(data[i:i + 65536].toarray(),
+                             start_iteration=start_iteration,
+                             num_iteration=num_iteration,
+                             raw_score=raw_score, pred_leaf=pred_leaf,
+                             pred_contrib=pred_contrib, **kwargs)
+                for i in range(0, data.shape[0], 65536)
+            ]
+            return np.concatenate(outs, axis=0)
         if isinstance(data, (str, os.PathLike)):
             df = load_data_file(str(data), is_predict=True)
             X = df.X

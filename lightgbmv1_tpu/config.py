@@ -317,6 +317,9 @@ class Config:
     valid_data_initscores: List[str] = field(default_factory=list)
     pre_partition: bool = False
     enable_bundle: bool = True
+    max_conflict_rate: float = 0.0  # EFB conflict budget (fraction of rows
+                                    # where bundled features may collide —
+                                    # reference config.h max_conflict_rate)
     use_missing: bool = True
     zero_as_missing: bool = False
     two_round: bool = False

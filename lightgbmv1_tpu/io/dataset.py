@@ -74,22 +74,60 @@ class BinnedDataset:
 
     def __init__(
         self,
-        binned: np.ndarray,
+        binned: Optional[np.ndarray],
         bin_mappers: List[BinMapper],
         metadata: Metadata,
         feature_names: Optional[List[str]] = None,
         max_bin: int = 255,
+        num_data: Optional[int] = None,
     ):
-        self.binned = binned
+        self.binned = binned          # (F, N) dense bins; None for the
+                                      # sparse-input path (bundled only)
+        self.bundled = None           # (BF, N) EFB matrix (io/bundle.py)
+        self.bundle_layout = None
         self.bin_mappers = bin_mappers
         self.metadata = metadata
-        self.num_features = binned.shape[0]
-        self.num_data = binned.shape[1]
+        self.num_features = len(bin_mappers)
+        self.num_data = binned.shape[1] if binned is not None else num_data
         self.max_bin = max_bin
         self.feature_names = feature_names or [
             f"Column_{i}" for i in range(self.num_features)
         ]
         self._build_feature_meta()
+
+    # ------------------------------------------------------------------
+    @property
+    def train_matrix(self) -> np.ndarray:
+        """The matrix the trainer uploads: the EFB-bundled columns when
+        bundling applied, else the plain (F, N) binned matrix."""
+        return self.bundled if self.bundled is not None else self.binned
+
+    def bundle_features(self, config: Config,
+                        reference: Optional["BinnedDataset"] = None) -> None:
+        """Apply Exclusive Feature Bundling (reference: enable_bundle,
+        Dataset::Construct -> FindGroups/FastFeatureBundling,
+        src/io/dataset.cpp:97-315).  Valid sets reuse the training layout."""
+        from .bundle import apply_bundles_dense, maybe_bundle
+
+        if self.binned is None:
+            return  # sparse path bundles at construction time
+        if reference is not None:
+            if reference.bundle_layout is not None:
+                self.bundle_layout = reference.bundle_layout
+                self.bundled = apply_bundles_dense(
+                    self.binned, self.zero_bins, self.bundle_layout)
+            return
+        bundled, layout = maybe_bundle(
+            self.binned, self.zero_bins, self.num_bins,
+            max_conflict_rate=config.max_conflict_rate)
+        if layout is not None:
+            self.bundled = bundled
+            self.bundle_layout = layout
+
+    @property
+    def padded_bundle_bin(self) -> int:
+        assert self.bundle_layout is not None
+        return max(8, _next_pow2(int(self.bundle_layout.bundle_nbins.max())))
 
     # ------------------------------------------------------------------
     def _build_feature_meta(self) -> None:
@@ -200,6 +238,151 @@ class BinnedDataset:
             f"Constructed binned dataset: {num_data} rows, {num_features} features "
             f"({n_used} informative), max {ds.num_total_bin} bins"
         )
+        if config.enable_bundle:
+            ds.bundle_features(config, reference=reference)
+        return ds
+
+    # ------------------------------------------------------------------
+    @classmethod
+    def from_csr(
+        cls,
+        indptr: np.ndarray,
+        indices: np.ndarray,
+        values: np.ndarray,
+        num_data: int,
+        num_features: int,
+        label=None,
+        weight=None,
+        group=None,
+        init_score=None,
+        config: Optional[Config] = None,
+        categorical_features: Optional[Sequence[int]] = None,
+        feature_names: Optional[List[str]] = None,
+        reference: Optional["BinnedDataset"] = None,
+    ) -> "BinnedDataset":
+        """Build from CSR triplets WITHOUT materializing the dense (F, N)
+        matrix — the wide-sparse input path (reference:
+        ``LGBM_DatasetCreateFromCSR`` src/c_api.cpp + sparse push into
+        FeatureGroups).  Sampling uses the sparse contract of
+        ``BinMapper.find_bin`` (absent entries are implicit zeros), and the
+        training representation is built directly as EFB bundle columns
+        (io/bundle.py), so peak memory is O(nnz + num_bundles * num_data).
+        """
+        from .bundle import BundleLayout, apply_bundles_csr, find_bundles
+
+        config = config or Config()
+        indptr = np.asarray(indptr, np.int64)
+        indices = np.asarray(indices, np.int32)
+        values = np.asarray(values, np.float64)
+        categorical = set(categorical_features or [])
+        rows = np.repeat(np.arange(num_data), np.diff(indptr))
+
+        if reference is not None:
+            mappers = reference.bin_mappers
+            feature_names = feature_names or reference.feature_names
+        else:
+            sample_cnt = min(num_data, config.bin_construct_sample_cnt)
+            rng = np.random.RandomState(config.data_random_seed)
+            samp = (rng.choice(num_data, size=sample_cnt, replace=False)
+                    if sample_cnt < num_data else np.arange(num_data))
+            in_sample = np.zeros(num_data, bool)
+            in_sample[samp] = True
+            sel = in_sample[rows]
+            f_sel, v_sel = indices[sel], values[sel]
+            order = np.argsort(f_sel, kind="stable")
+            f_sorted, v_sorted = f_sel[order], v_sel[order]
+            starts = np.searchsorted(f_sorted, np.arange(num_features + 1))
+            max_bins = (list(config.max_bin_by_feature)
+                        or [config.max_bin] * num_features)
+            if len(max_bins) != num_features:
+                log_fatal("max_bin_by_feature length must equal number of "
+                          "features")
+            from .binning import get_forced_bins
+
+            forced = get_forced_bins(config.forcedbins_filename,
+                                     num_features, categorical)
+            mappers = [
+                BinMapper.find_bin(
+                    v_sorted[starts[j]:starts[j + 1]],
+                    total_sample_cnt=sample_cnt,
+                    max_bin=max_bins[j],
+                    min_data_in_bin=config.min_data_in_bin,
+                    bin_type=(BIN_CATEGORICAL if j in categorical
+                              else BIN_NUMERICAL),
+                    use_missing=config.use_missing,
+                    zero_as_missing=config.zero_as_missing,
+                    forced_bounds=forced[j],
+                )
+                for j in range(num_features)
+            ]
+
+        meta = Metadata()
+        if label is not None:
+            meta.label = np.asarray(label, dtype=np.float32).ravel()
+            if len(meta.label) != num_data:
+                log_fatal("label length mismatch")
+        if weight is not None:
+            meta.weight = np.asarray(weight, dtype=np.float32).ravel()
+        if init_score is not None:
+            meta.init_score = np.asarray(init_score, dtype=np.float64)
+        meta.set_group(group)
+
+        ds = cls(None, mappers, meta, feature_names,
+                 max_bin=config.max_bin, num_data=num_data)
+
+        # bin the non-zero entries feature-by-feature (host, vectorized via
+        # one stable sort over the nnz instead of F passes)
+        bin_values = np.zeros(len(values), np.int32)
+        order_all = np.argsort(indices, kind="stable")
+        starts_all = np.searchsorted(indices[order_all],
+                                     np.arange(num_features + 1))
+        for j in range(num_features):
+            seg = order_all[starts_all[j]:starts_all[j + 1]]
+            if len(seg):
+                bin_values[seg] = mappers[j].value_to_bin(values[seg])
+
+        if reference is not None and reference.bundle_layout is not None:
+            layout = reference.bundle_layout
+        elif reference is not None:
+            # unbundled reference (e.g. dense training data that found no
+            # exclusivity): identity bundles keep bundle bins == original
+            # bins so the matrices stay directly comparable
+            layout = BundleLayout(
+                bundle_of=np.arange(num_features, dtype=np.int32),
+                offset=np.zeros(num_features, np.int32),
+                is_bundled=np.zeros(num_features, bool),
+                bundle_nbins=np.asarray(ds.num_bins, np.int32),
+            )
+        else:
+            # conflict masks from the sampled non-zero pattern
+            sample_cnt_c = min(num_data, 32768)
+            rng2 = np.random.RandomState(config.data_random_seed + 1)
+            samp2 = (rng2.choice(num_data, size=sample_cnt_c, replace=False)
+                     if sample_cnt_c < num_data else np.arange(num_data))
+            pos = np.full(num_data, -1, np.int64)
+            pos[samp2] = np.arange(len(samp2))
+            masks = np.zeros((num_features, len(samp2)), bool)
+            r_pos = pos[rows]
+            hit = (r_pos >= 0) & (bin_values != ds.zero_bins[indices])
+            masks[indices[hit], r_pos[hit]] = True
+            layout = (find_bundles(masks, ds.num_bins,
+                                   config.max_conflict_rate)
+                      if config.enable_bundle else None)
+            if layout is None:
+                # no exclusivity to exploit: fall back to identity bundles
+                layout = BundleLayout(
+                    bundle_of=np.arange(num_features, dtype=np.int32),
+                    offset=np.zeros(num_features, np.int32),
+                    is_bundled=np.zeros(num_features, bool),
+                    bundle_nbins=np.asarray(ds.num_bins, np.int32),
+                )
+        ds.bundle_layout = layout
+        ds.bundled = apply_bundles_csr(indptr, indices, bin_values,
+                                       num_data, ds.zero_bins, layout)
+        log_info(
+            f"Constructed sparse binned dataset: {num_data} rows, "
+            f"{num_features} features -> {layout.num_bundles} bundle "
+            f"columns ({len(values)} non-zeros)")
         return ds
 
     # ------------------------------------------------------------------

@@ -90,10 +90,42 @@ class GBDT:
         for m in self.train_metrics:
             m.init(train_set.metadata, self.num_data)
 
-        # device-resident training data
-        self.binned = jnp.asarray(train_set.binned)
+        # device-resident training data (the EFB bundle matrix when
+        # bundling applied — trees and meta always speak ORIGINAL features)
+        self._bundle = None
+        if train_set.bundle_layout is not None:
+            from ..io.bundle import BundleArrays
+
+            incompatible = (config.tree_learner in ("voting", "feature")
+                            or bool(config.forcedsplits_filename))
+            if incompatible and train_set.binned is None:
+                log_fatal("tree_learner=voting/feature and forced splits do "
+                          "not support EFB-bundled sparse datasets; load "
+                          "dense data or drop the incompatible option")
+            if incompatible:
+                log_warning("EFB disabled (tree_learner=voting/feature and "
+                            "forced splits run on unbundled features)")
+                train_set.bundled = None
+                train_set.bundle_layout = None
+            else:
+                self._bundle = BundleArrays(train_set.bundle_layout,
+                                            train_set.zero_bins,
+                                            train_set.num_bins)
+        if getattr(train_set, "is_row_sharded", False):
+            # process-sharded training data: the global device array is
+            # assembled from per-process shards by the trainer
+            # (parallel/dist_data.py make_process_sharded)
+            if config.tree_learner != "data":
+                log_fatal("process-sharded datasets require "
+                          "tree_learner=data")
+            self.binned = None
+        else:
+            self.binned = jnp.asarray(train_set.train_matrix)
         self.meta = make_feature_meta(train_set, config.monotone_constraints,
                                       config.feature_contri)
+        rv = getattr(train_set, "row_valid", None)
+        self._row_valid = (jnp.asarray(rv, jnp.float32)
+                           if rv is not None else None)
         self.num_bins = train_set.padded_bin
         self.split_params = SplitParams(
             lambda_l1=config.lambda_l1,
@@ -177,12 +209,18 @@ class GBDT:
 
         self._grow, self._grow_binned, _ = build_trainer(
             self.config,
-            self.train_set.binned,
+            self.train_set.train_matrix,
             self.meta,
             self.split_params,
             self.num_bins,
             bin_mappers=self.train_set.bin_mappers,
+            bundle=self._bundle,
+            bundle_num_bins=(self.train_set.padded_bundle_bin
+                             if self._bundle is not None else None),
+            row_sharded=getattr(self.train_set, "is_row_sharded", False),
         )
+        if self.binned is None:
+            self.binned = self._grow_binned
         self._step = None  # fused per-iteration step, built lazily
 
     # ------------------------------------------------------------------
@@ -253,7 +291,8 @@ class GBDT:
                 new_valid = []
                 for vb, vscore in zip(valid_binned, valid_scores):
                     pred = tree_predict_binned(
-                        shrunk, vb, self.meta.nan_bin, self.meta.missing_type
+                        shrunk, vb, self.meta.nan_bin,
+                        self.meta.missing_type, self._bundle
                     )
                     new_valid.append(vscore.at[:, k].add(pred))
                 valid_scores = tuple(new_valid) if new_valid else valid_scores
@@ -379,7 +418,30 @@ class GBDT:
             log_fatal("Cannot add validation data after training started")
         self._valid_sets.append(valid_set)
         self._valid_names.append(name)
-        self._valid_binned.append(jnp.asarray(valid_set.binned))
+        if self._bundle is not None:
+            # valid data must share the training bundle layout (the analog
+            # of the reference's shared FeatureGroups for valid sets)
+            if (valid_set.bundled is None
+                    or valid_set.bundle_layout
+                    is not self.train_set.bundle_layout):
+                if valid_set.binned is None:
+                    log_fatal("validation set was bundled with a different "
+                              "EFB layout and has no dense bins to "
+                              "re-bundle; construct it with "
+                              "reference=<train dataset>")
+                from ..io.bundle import apply_bundles_dense
+
+                valid_set.bundled = apply_bundles_dense(
+                    valid_set.binned, valid_set.zero_bins,
+                    self.train_set.bundle_layout)
+                valid_set.bundle_layout = self.train_set.bundle_layout
+            self._valid_binned.append(jnp.asarray(valid_set.bundled))
+        else:
+            # sparse valid sets built against an unbundled reference carry
+            # identity bundles: bundle bins == original bins
+            vb = (valid_set.binned if valid_set.binned is not None
+                  else valid_set.train_matrix)
+            self._valid_binned.append(jnp.asarray(vb))
         self._valid_scores.append(
             _ScoreUpdater(valid_set.num_data, self.num_class, init)
         )
@@ -436,11 +498,17 @@ class GBDT:
         return grad, hess
 
     def _sample_g3(self, grad_k, hess_k, bag, iteration):
-        """Assemble the (N, 3) [grad, hess, count] channels with bagging."""
+        """Assemble the (N, 3) [grad, hess, count] channels with bagging.
+        Process-sharded datasets carry phantom pad rows (weight 0): they
+        must also have count 0 so min_data_in_leaf gating and count-based
+        smoothing see only real rows."""
         if bag is None:
             cnt = jnp.ones_like(grad_k)
-            return jnp.stack([grad_k, hess_k, cnt], axis=1)
-        return jnp.stack([grad_k * bag, hess_k * bag, bag], axis=1)
+        else:
+            grad_k, hess_k, cnt = grad_k * bag, hess_k * bag, bag
+        if self._row_valid is not None:
+            cnt = cnt * self._row_valid
+        return jnp.stack([grad_k, hess_k, cnt], axis=1)
 
     # ------------------------------------------------------------------
     def train_one_iter(
@@ -540,7 +608,8 @@ class GBDT:
         self._train_scores.add_leaf_values(shrunk.leaf_value, leaf_id, k)
         for vb, vs in zip(self._valid_binned, self._valid_scores):
             pred = tree_predict_binned(
-                shrunk, vb, self.meta.nan_bin, self.meta.missing_type
+                shrunk, vb, self.meta.nan_bin, self.meta.missing_type,
+                self._bundle
             )
             vs.add_pred(pred, k)
 
@@ -630,8 +699,25 @@ class GBDT:
         self._prev_state = None
 
     # ------------------------------------------------------------------
+    @staticmethod
+    def _host_array(arr) -> np.ndarray:
+        """Fetch a (possibly cross-process-sharded) score array to host.
+        With process-sharded training data the jitted score updates leave
+        the scores row-sharded across processes; a jitted identity with a
+        replicated out-sharding inserts the all-gather (the analog of the
+        reference's score sync for metric evaluation)."""
+        if getattr(arr, "is_fully_addressable", True) or \
+                getattr(arr, "is_fully_replicated", False):
+            return np.asarray(arr, dtype=np.float64)
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        rep = jax.jit(
+            lambda a: a,
+            out_shardings=NamedSharding(arr.sharding.mesh, P()))(arr)
+        return np.asarray(rep, dtype=np.float64)
+
     def _converted_pred(self, scores: _ScoreUpdater, objective) -> np.ndarray:
-        raw = scores.score
+        raw = self._host_array(scores.score)
         s = raw[:, 0] if self.num_class == 1 else raw
         if objective is not None:
             s = objective.convert_output(s)
@@ -640,7 +726,7 @@ class GBDT:
     def _raw_pred(self, scores: _ScoreUpdater) -> np.ndarray:
         """Raw margins for ``wants_raw`` metrics (reference: metrics reading
         score_ directly, e.g. AucMuMetric multiclass_metric.hpp:254)."""
-        raw = scores.score
+        raw = self._host_array(scores.score)
         s = raw[:, 0] if self.num_class == 1 else raw
         return np.asarray(s, dtype=np.float64)
 
@@ -682,14 +768,7 @@ class GBDT:
 
     # ------------------------------------------------------------------
     def raw_train_scores(self) -> np.ndarray:
-        score = self._train_scores.score
-        if jax.process_count() > 1:
-            # row-sharded across processes (data-parallel leaf_id output):
-            # gather the full array onto every host before fetching
-            from jax.experimental import multihost_utils
-
-            score = multihost_utils.process_allgather(score, tiled=True)
-        return np.asarray(score, dtype=np.float64)
+        return self._host_array(self._train_scores.score)
 
     def num_trees(self) -> int:
         return len(self.models)
@@ -882,13 +961,15 @@ class DART(GBDT):
                 if b:
                     tree = tree._replace(leaf_value=tree.leaf_value + b)
                 pred = tree_predict_binned(
-                    tree, self.binned, self.meta.nan_bin, self.meta.missing_type
+                    tree, self.binned, self.meta.nan_bin,
+                    self.meta.missing_type, self._bundle
                 )
                 self._train_scores.add_pred(-pred, k)
                 vpreds = []
                 for vb, vs in zip(self._valid_binned, self._valid_scores):
                     vp = tree_predict_binned(
-                        tree, vb, self.meta.nan_bin, self.meta.missing_type
+                        tree, vb, self.meta.nan_bin,
+                        self.meta.missing_type, self._bundle
                     )
                     vs.add_pred(-vp, k)
                     vpreds.append(vp)

@@ -124,6 +124,7 @@ def make_leafwise_grower(
     hist_fn: Callable = None,
     split_fn: Callable = None,
     sums_fn: Callable = None,
+    bins_of_fn: Callable = None,
 ):
     """Build the jittable ``grow(binned, g3, base_mask, key)`` function.
 
@@ -202,6 +203,10 @@ def make_leafwise_grower(
         def sums_fn(g3):
             return g3.sum(axis=0)
 
+    if bins_of_fn is None:
+        def bins_of_fn(binned, feat):
+            return binned[feat]
+
     def clamp_out(sums, constr, parent_out=0.0):
         out = leaf_output(sums[0], sums[1], params)
         if params.path_smooth > 0:
@@ -212,7 +217,7 @@ def make_leafwise_grower(
 
     def apply_decision(binned, leaf_id, leaf, new_leaf, feat, thr, dl,
                        is_cat, bitset):
-        bins_f = binned[feat]                       # (N,) dynamic row gather
+        bins_f = bins_of_fn(binned, feat)           # (N,) original bins
         is_na = (meta.missing_type[feat] == MISSING_NAN) & (
             bins_f == meta.nan_bin[feat]
         )
@@ -225,7 +230,8 @@ def make_leafwise_grower(
 
     def grow(binned, g3, base_mask, key, cegb_used=None):
         N = binned.shape[1]
-        F = binned.shape[0]
+        F = base_mask.shape[0]    # ORIGINAL features (binned may be the
+                                  # narrower EFB bundle matrix)
         B = num_bins
         if cegb_used is None:
             cegb_used = jnp.zeros(F, bool)
@@ -250,7 +256,7 @@ def make_leafwise_grower(
                                   iscat, bitset):
                 """Stable two-way partition of one leaf's segment
                 (reference DataPartition::Split, data_partition.hpp:101)."""
-                bins_row = binned[feat]                    # (N,)
+                bins_row = bins_of_fn(binned, feat)        # (N,) orig bins
 
                 def make_branch(CAP):
                     def br(op):
@@ -339,7 +345,8 @@ def make_leafwise_grower(
         W = res0.cat_bitset.shape[0]
         st = GrowerState(
             leaf_id=leaf_id,
-            hist_pool=jnp.zeros((L, F, B, 3), jnp.float32).at[0].set(hist0),
+            hist_pool=jnp.zeros((L,) + hist0.shape,
+                                jnp.float32).at[0].set(hist0),
             leaf_sums=jnp.zeros((L, 3), jnp.float32).at[0].set(root_sum),
             leaf_depth=jnp.zeros(L, jnp.int32),
             best_gain=jnp.full(L, -jnp.inf, jnp.float32).at[0].set(res0.gain),
@@ -619,6 +626,7 @@ def make_levelwise_grower(
     hist_frontier_fn: Callable = None,
     split_fn: Callable = None,
     sums_fn: Callable = None,
+    bins_of_rows_fn: Callable = None,
 ):
     """Depth-wise tree growth with the whole frontier batched per level.
 
@@ -675,6 +683,10 @@ def make_levelwise_grower(
         def sums_fn(g3):
             return g3.sum(axis=0)
 
+    if bins_of_rows_fn is None:
+        def bins_of_rows_fn(binned, f_row):
+            return jnp.take_along_axis(binned, f_row[None, :], axis=0)[0]
+
     def allowed_features_batch(used):
         if groups_lw is None:
             return jnp.ones_like(used)
@@ -690,7 +702,7 @@ def make_levelwise_grower(
 
     def grow(binned, g3, base_mask, key, cegb_used=None):
         N = binned.shape[1]
-        F = binned.shape[0]
+        F = base_mask.shape[0]    # ORIGINAL features (EFB: binned narrower)
         if cegb_used is None:
             cegb_used = jnp.zeros(F, bool)
         from .tree import empty_tree
@@ -760,7 +772,7 @@ def make_levelwise_grower(
             lid_c = jnp.minimum(leaf_id, Ld - 1)
             f_row = feat_l[lid_c]
             in_split = split_mask[lid_c] & (leaf_id < Ld)
-            b_row = jnp.take_along_axis(binned, f_row[None, :], axis=0)[0]
+            b_row = bins_of_rows_fn(binned, f_row)
             is_na = (meta.missing_type[f_row] == MISSING_NAN) & (
                 b_row == meta.nan_bin[f_row]
             )

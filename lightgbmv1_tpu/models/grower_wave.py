@@ -120,6 +120,7 @@ def make_wave_grower(
     hist_wave_fn: Callable = None,
     split_fn: Callable = None,
     sums_fn: Callable = None,
+    bins_of_fn: Callable = None,
 ):
     """Build the jittable ``grow(binned, g3, base_mask, key)`` function.
 
@@ -130,6 +131,9 @@ def make_wave_grower(
     parent_output) -> SplitResult`` — vmapped over the 2K children.
     ``sums_fn(g3) -> (3,)`` — root totals (psum over the row axis when
     data-parallel).
+    ``bins_of_fn(binned, feat) -> (N,)`` — ORIGINAL bins of a feature; the
+    EFB path substitutes the bundle-column decode (io/bundle.py
+    bundle_bins_of_feat), so ``binned`` may be the (BF, N) bundled matrix.
     """
     L = num_leaves
     L1 = max(L - 1, 1)
@@ -154,6 +158,10 @@ def make_wave_grower(
         def sums_fn(g3):
             return g3.sum(axis=0)
 
+    if bins_of_fn is None:
+        def bins_of_fn(binned, feat):
+            return binned[feat]
+
     def allowed_features(used):
         return allowed_features_for(groups, used)
 
@@ -167,7 +175,8 @@ def make_wave_grower(
 
     def grow(binned, g3, base_mask, key, cegb_used=None):
         N = binned.shape[1]
-        F = binned.shape[0]
+        F = base_mask.shape[0]    # ORIGINAL feature count (binned may be
+                                  # the narrower EFB bundle matrix)
         del cegb_used  # CEGB routes to the sequential grower (order-exact)
 
         leaf_id0 = jnp.zeros(N, jnp.int32)
@@ -233,7 +242,7 @@ def make_wave_grower(
             label = jnp.full(N, 2 * K, jnp.int32)
             for j in range(K):
                 fj = feats[j]
-                bins_f = binned[fj]                           # (N,) row slice
+                bins_f = bins_of_fn(binned, fj)               # (N,) orig bins
                 is_na = (meta.missing_type[fj] == MISSING_NAN) & (
                     bins_f == meta.nan_bin[fj])
                 gl = jnp.where(is_na, dls[j], bins_f <= thrs[j])

@@ -80,9 +80,10 @@ def empty_tree(max_leaves: int, cat_words: int = 1) -> TreeArrays:
 
 def tree_leaf_index_binned(
     tree: TreeArrays,
-    binned: jax.Array,        # (F, N)
+    binned: jax.Array,        # (F, N) bins, or (BF, N) EFB bundle matrix
     nan_bins: jax.Array,      # (F,) int32
     missing_types: jax.Array,  # (F,) int32
+    bundle=None,              # io/bundle.py BundleArrays when EFB applied
 ) -> jax.Array:               # (N,) int32 leaf index per row
     N = binned.shape[1]
 
@@ -95,7 +96,12 @@ def tree_leaf_index_binned(
         active = node >= 0
         nd = jnp.maximum(node, 0)
         f = tree.split_feature[nd]
-        b = jnp.take_along_axis(binned, f[None, :], axis=0)[0]
+        if bundle is not None:
+            from ..io.bundle import bundle_bins_of_rows
+
+            b = bundle_bins_of_rows(binned, f, bundle)
+        else:
+            b = jnp.take_along_axis(binned, f[None, :], axis=0)[0]
         t = tree.threshold_bin[nd]
         dl = tree.default_left[nd]
         is_na = (missing_types[f] == MISSING_NAN) & (b == nan_bins[f])
@@ -118,8 +124,9 @@ def tree_leaf_index_binned(
     return -node - 1   # ~node
 
 
-def tree_predict_binned(tree, binned, nan_bins, missing_types):
-    leaf = tree_leaf_index_binned(tree, binned, nan_bins, missing_types)
+def tree_predict_binned(tree, binned, nan_bins, missing_types, bundle=None):
+    leaf = tree_leaf_index_binned(tree, binned, nan_bins, missing_types,
+                                  bundle)
     return tree.leaf_value[leaf]
 
 

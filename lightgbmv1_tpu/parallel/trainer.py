@@ -172,21 +172,34 @@ def parse_forced_splits(filename: str, bin_mappers, num_leaves: int):
 
 def build_trainer(
     config: Config,
-    binned_np: np.ndarray,           # (F, N) uint8/int16 host array
+    binned_np: np.ndarray,           # (F, N) bins or (BF, N) EFB bundles
     meta: FeatureMeta,
     params: SplitParams,
     num_bins: int,
     bin_mappers=None,
+    bundle=None,                     # io/bundle.py BundleArrays (EFB) or None
+    bundle_num_bins: Optional[int] = None,   # padded bundle-space bin count
+    row_sharded: bool = False,       # binned_np is THIS process's row shard
 ) -> Tuple[Callable, jax.Array, int]:
     """Return ``(grow_fn, binned_device, num_data)`` for the configured
     tree_learner.  ``grow_fn(binned_device, g3, base_mask, key)`` has the
     serial grower's signature; ``binned_device`` is already placed/padded
-    for the chosen topology."""
+    for the chosen topology.  With ``bundle`` set, histograms run in bundle
+    space and the split search expands them back to original features
+    (io/bundle.py expand_bundle_hist — the FixHistogram analog)."""
     learner = config.tree_learner
     method = default_hist_method(config.hist_method, binned_np.dtype)
     precision = config.hist_dtype
-    F, N = binned_np.shape
+    N = binned_np.shape[1]
+    if row_sharded:
+        if learner != "data":
+            log_fatal("row-sharded datasets require tree_learner=data")
+        # binned_np holds only THIS process's rows; the global row count is
+        # world * R (parallel/dist_data.py make_process_sharded contract)
+        N = binned_np.shape[1] * jax.process_count()
+    F = int(meta.num_bins.shape[0])  # ORIGINAL feature count
     B = num_bins
+    Bh = bundle_num_bins if bundle is not None else B   # histogram bin axis
 
     if config.device_type in ("gpu", "cuda"):
         # reference configs select the OpenCL/CUDA learners here; this
@@ -202,16 +215,45 @@ def build_trainer(
     levelwise = config.tree_growth == "levelwise"
 
     def local_hist(binned, g3, leaf_id, target):
-        return hist_one_leaf(binned, g3, leaf_id, target, B,
+        return hist_one_leaf(binned, g3, leaf_id, target, Bh,
                              method=method, precision=precision)
 
     def local_frontier(binned, g3, leaf_id, L_level):
-        return hist_frontier(binned, g3, leaf_id, L_level, B,
+        return hist_frontier(binned, g3, leaf_id, L_level, Bh,
                              method=method, precision=precision)
 
     def local_wave(binned, g3, label, nslots):
-        return hist_wave(binned, g3, label, nslots, B,
+        return hist_wave(binned, g3, label, nslots, Bh,
                          method=method, precision=precision)
+
+    # EFB: split search + decisions speak ORIGINAL features; only the
+    # histogram pass runs over bundle columns
+    if bundle is not None:
+        from ..io.bundle import (bundle_bins_of_feat, bundle_bins_of_rows,
+                                 expand_bundle_hist)
+
+        def split_bundle(hist, parent, mask, key, uid, constraint, depth,
+                         parent_output, cegb_pen=None):
+            h = expand_bundle_hist(hist, parent, bundle, B)
+            rk = jax.random.fold_in(key,
+                                    uid + 1_000_003 + params.extra_seed) \
+                if params.extra_trees else None
+            return find_best_split(h, parent, meta, mask, params,
+                                   constraint, depth,
+                                   config.monotone_penalty, parent_output,
+                                   rk, cegb_pen)
+
+        split_local = split_bundle
+
+        def bins_feat_fn(binned, f):
+            return bundle_bins_of_feat(binned, f, bundle)
+
+        def bins_rows_fn(binned, f_row):
+            return bundle_bins_of_rows(binned, f_row, bundle)
+    else:
+        split_local = None
+        bins_feat_fn = None
+        bins_rows_fn = None
 
     # the wave-batched best-first schedule is the leaf-wise default; CEGB
     # needs the sequential grower's exact split ORDER (its penalties depend
@@ -269,17 +311,22 @@ def build_trainer(
 
     if learner in ("serial", ""):
         if levelwise:
-            grow = make_levelwise_grower(hist_frontier_fn=local_frontier, **common)
+            grow = make_levelwise_grower(
+                hist_frontier_fn=local_frontier, split_fn=split_local,
+                bins_of_rows_fn=bins_rows_fn, **common)
         elif use_wave and forced is None:
             # wave-batched best-first: the leaf-wise default schedule
             # (models/grower_wave.py)
-            grow = make_wave_grower(hist_wave_fn=local_wave, **wave_common)
+            grow = make_wave_grower(hist_wave_fn=local_wave,
+                                    split_fn=split_local,
+                                    bins_of_fn=bins_feat_fn, **wave_common)
         else:
             # sequential best-first (the reference's exact split order):
             # DataPartition fast path by default; tree_growth=leafwise_masked
             # keeps the O(N)-per-split variant
             grow = make_leafwise_grower(
                 hist_fn=local_hist, forced_splits=forced,
+                split_fn=split_local, bins_of_fn=bins_feat_fn,
                 partition=(config.tree_growth != "leafwise_masked"),
                 **common)
         return jax.jit(grow), jnp.asarray(binned_np), N
@@ -303,7 +350,8 @@ def build_trainer(
         mesh = _make_mesh(config.num_shards, "data")
         ndev = mesh.devices.size
         N_pad = ((N + ndev - 1) // ndev) * ndev
-        binned_p = np.zeros((F, N_pad), dtype=binned_np.dtype)
+        binned_p = np.zeros((binned_np.shape[0], N_pad),
+                            dtype=binned_np.dtype)
         binned_p[:, :N] = binned_np
         binned_dev = jax.device_put(
             jnp.asarray(binned_p), NamedSharding(mesh, P(None, "data"))
@@ -386,23 +434,32 @@ def build_trainer(
     if learner == "data":
         mesh = _make_mesh(config.num_shards, "data")
         ndev = mesh.devices.size
-        N_pad = ((N + ndev - 1) // ndev) * ndev
-        binned_p = np.zeros((F, N_pad), dtype=binned_np.dtype)
-        binned_p[:, :N] = binned_np
         sharding = NamedSharding(mesh, P(None, "data"))
-        if jax.process_count() > 1:
-            # multi-host: every process carries the full host array and
-            # contributes its addressable row shards (the analog of the
-            # reference's loader-level rank pre-partition,
-            # dataset_loader.cpp:167 LoadFromFile(fname, rank, num_machines))
-            binned_dev = jax.make_array_from_callback(
-                binned_p.shape, sharding,
-                lambda idx: jnp.asarray(binned_p[idx]))
+        if row_sharded:
+            # process-local shards -> one global sharded array; no process
+            # ever materializes the full matrix (the reference's per-rank
+            # memory win, dataset_loader.cpp:167 + Experiments.rst:228-240)
+            N_pad = N                      # already world * R, R % d == 0
+            binned_dev = jax.make_array_from_process_local_data(
+                sharding, binned_np)
         else:
-            binned_dev = jax.device_put(jnp.asarray(binned_p), sharding)
+            N_pad = ((N + ndev - 1) // ndev) * ndev
+            binned_p = np.zeros((binned_np.shape[0], N_pad),
+                                dtype=binned_np.dtype)
+            binned_p[:, :N] = binned_np
+            if jax.process_count() > 1:
+                # host-replicated multi-host input: every process carries
+                # the full array and contributes its addressable shards
+                binned_dev = jax.make_array_from_callback(
+                    binned_p.shape, sharding,
+                    lambda idx: jnp.asarray(binned_p[idx]))
+            else:
+                binned_dev = jax.device_put(jnp.asarray(binned_p), sharding)
         log_info(f"Data-parallel training over {ndev} devices "
                  f"({N_pad // ndev} rows/device, "
-                 f"{jax.process_count()} processes)")
+                 f"{jax.process_count()} processes"
+                 + (", process-sharded storage" if row_sharded else "")
+                 + ")")
 
         def hist_fn(binned, g3, leaf_id, target):
             # local histogram + Allreduce — the reference's
@@ -418,7 +475,8 @@ def build_trainer(
                     local_frontier(binned, g3, leaf_id, L_level), "data")
 
             grow = make_levelwise_grower(
-                hist_frontier_fn=frontier_fn, sums_fn=sums_fn, **common)
+                hist_frontier_fn=frontier_fn, sums_fn=sums_fn,
+                split_fn=split_local, bins_of_rows_fn=bins_rows_fn, **common)
         elif use_wave:
             # one histogram Allreduce per ROUND (up to 2K child histograms
             # batched in a single psum) instead of one per split — the wave
@@ -427,9 +485,12 @@ def build_trainer(
                 return lax.psum(local_wave(binned, g3, label, nslots), "data")
 
             grow = make_wave_grower(hist_wave_fn=wave_fn, sums_fn=sums_fn,
-                                    **wave_common)
+                                    split_fn=split_local,
+                                    bins_of_fn=bins_feat_fn, **wave_common)
         else:
-            grow = make_leafwise_grower(hist_fn=hist_fn, sums_fn=sums_fn, **common)
+            grow = make_leafwise_grower(hist_fn=hist_fn, sums_fn=sums_fn,
+                                        split_fn=split_local,
+                                        bins_of_fn=bins_feat_fn, **common)
         sharded = shard_map(
             grow,
             mesh=mesh,

@@ -1,0 +1,174 @@
+"""Exclusive Feature Bundling (io/bundle.py) tests.
+
+reference: EFB grouping src/io/dataset.cpp:41-235, per-feature offsets
+feature_group.h:36-48, zero-bin recovery FixHistogram dataset.cpp:1410.
+"""
+
+import numpy as np
+import pytest
+
+import lightgbmv1_tpu as lgb
+from lightgbmv1_tpu.io.bundle import (BundleArrays, apply_bundles_dense,
+                                      expand_bundle_hist, find_bundles,
+                                      maybe_bundle)
+
+
+def make_sparse_problem(n=4000, blocks=6, seed=0):
+    """blocks groups of 4 mutually-exclusive features (one-hot-ish)."""
+    rng = np.random.RandomState(seed)
+    F = blocks * 4
+    X = np.zeros((n, F))
+    logit = np.zeros(n)
+    for b in range(blocks):
+        which = rng.randint(0, 4, n)
+        vals = rng.rand(n) + 0.5
+        for j in range(4):
+            col = b * 4 + j
+            m = which == j
+            X[m, col] = vals[m]
+            logit += np.where(m, (j - 1.5) * 0.3 * (b % 3 - 1), 0.0)
+    y = (logit + rng.randn(n) * 0.5 > 0).astype(float)
+    return X, y
+
+
+def test_find_bundles_exclusive():
+    # 4 mutually exclusive features + 1 dense feature
+    S = 100
+    masks = np.zeros((5, S), bool)
+    for j in range(4):
+        masks[j, j * 25:(j + 1) * 25] = True
+    masks[4, :] = True                    # dense: conflicts with everyone
+    layout = find_bundles(masks, [10, 10, 10, 10, 10])
+    assert layout is not None
+    assert layout.num_bundles == 2
+    g = layout.bundle_of[:4]
+    assert len(set(g.tolist())) == 1      # the 4 exclusive ones share
+    assert not layout.is_bundled[4]
+    # offsets disjoint and nonzero for bundled members
+    offs = sorted(layout.offset[:4].tolist())
+    assert offs[0] >= 1
+    assert all(offs[i + 1] - offs[i] >= 10 for i in range(3))
+
+
+def test_find_bundles_bin_capacity():
+    S = 100
+    masks = np.zeros((4, S), bool)       # all mutually exclusive
+    for j in range(4):
+        masks[j, j * 25:(j + 1) * 25] = True
+    layout = find_bundles(masks, [100, 100, 100, 100], max_bundle_bins=256)
+    assert layout is not None
+    # 100*4 + 1 > 256: at most 2 features fit per bundle
+    for g in range(layout.num_bundles):
+        assert layout.bundle_nbins[g] <= 256
+
+
+def test_expand_bundle_hist_exact():
+    """Bundle-space histogram expanded back == original-feature histogram
+    (incl. the recovered zero bin)."""
+    import jax.numpy as jnp
+
+    from lightgbmv1_tpu.ops.histogram import hist_leaves_scatter
+
+    X, y = make_sparse_problem(1000)
+    cfg = lgb.Config.from_dict({"objective": "binary", "verbosity": -1})
+    from lightgbmv1_tpu.io.dataset import BinnedDataset
+
+    ds = BinnedDataset.from_numpy(X, label=y, config=cfg)
+    assert ds.bundle_layout is not None, "EFB should fire on this data"
+    F, N = ds.binned.shape
+    B = ds.padded_bin
+    Bb = ds.padded_bundle_bin
+    g3 = np.stack([np.random.RandomState(1).randn(N),
+                   np.abs(np.random.RandomState(2).randn(N)),
+                   np.ones(N)], axis=1).astype(np.float32)
+    zeros = jnp.zeros(N, jnp.int32)
+    h_orig = hist_leaves_scatter(jnp.asarray(ds.binned), jnp.asarray(g3),
+                                 zeros, 1, B)[0]
+    h_bund = hist_leaves_scatter(jnp.asarray(ds.bundled), jnp.asarray(g3),
+                                 zeros, 1, Bb)[0]
+    ba = BundleArrays(ds.bundle_layout, ds.zero_bins, ds.num_bins)
+    parent = jnp.asarray(g3.sum(axis=0))
+    h_exp = expand_bundle_hist(h_bund, parent, ba, B)
+    np.testing.assert_allclose(np.asarray(h_exp), np.asarray(h_orig),
+                               rtol=1e-4, atol=1e-3)
+
+
+@pytest.mark.parametrize("growth", ["leafwise", "leafwise_serial",
+                                    "levelwise"])
+def test_efb_training_parity(growth):
+    """Bundled and unbundled training must produce equivalent models."""
+    X, y = make_sparse_problem()
+    params = {"objective": "binary", "num_leaves": 31, "verbosity": -1,
+              "min_data_in_leaf": 5, "tree_growth": growth}
+    a = lgb.train({**params, "enable_bundle": True},
+                  lgb.Dataset(X, label=y), num_boost_round=5)
+    b = lgb.train({**params, "enable_bundle": False},
+                  lgb.Dataset(X, label=y), num_boost_round=5)
+    np.testing.assert_allclose(a.predict(X), b.predict(X),
+                               rtol=1e-3, atol=1e-4)
+
+
+def test_efb_data_parallel_parity():
+    X, y = make_sparse_problem(2000)
+    params = {"objective": "binary", "num_leaves": 15, "verbosity": -1,
+              "min_data_in_leaf": 5}
+    a = lgb.train({**params, "tree_learner": "data"},
+                  lgb.Dataset(X, label=y), num_boost_round=3)
+    b = lgb.train({**params, "enable_bundle": False},
+                  lgb.Dataset(X, label=y), num_boost_round=3)
+    np.testing.assert_allclose(a.predict(X), b.predict(X),
+                               rtol=1e-3, atol=1e-4)
+
+
+def test_csr_input_no_densify():
+    """Wide-sparse CSR input trains without a dense (F, N) matrix and with
+    binned bytes proportional to the bundle count."""
+    import scipy.sparse as sp
+    from sklearn.metrics import roc_auc_score
+
+    rng = np.random.RandomState(0)
+    n, F = 20000, 2000
+    density = 0.01
+    nnz = int(n * F * density)
+    rows = rng.randint(0, n, nnz)
+    cols = rng.randint(0, F, nnz)
+    vals = rng.rand(nnz) + 0.1
+    Xs = sp.csr_matrix((vals, (rows, cols)), shape=(n, F))
+    w = rng.randn(F) * (rng.rand(F) < 0.05)
+    y = (np.asarray(Xs @ w).ravel() + 0.1 * rng.randn(n) > 0).astype(float)
+
+    ds = lgb.Dataset(Xs, label=y)
+    bst = lgb.train({"objective": "binary", "num_leaves": 31,
+                     "verbosity": -1, "min_data_in_leaf": 20},
+                    ds, num_boost_round=10)
+    binned = ds._binned
+    assert binned.binned is None          # never densified to (F, N)
+    BF = binned.bundled.shape[0]
+    assert BF < F / 3, f"expected strong bundling, got {BF} bundles"
+    auc = roc_auc_score(y, bst.predict(Xs))
+    assert auc > 0.6, auc
+
+
+def test_efb_valid_set_alignment():
+    X, y = make_sparse_problem(3000)
+    Xv, yv = make_sparse_problem(1000, seed=7)
+    dtrain = lgb.Dataset(X, label=y)
+    dvalid = lgb.Dataset(Xv, label=yv, reference=dtrain)
+    evals = {}
+    lgb.train({"objective": "binary", "num_leaves": 31, "verbosity": -1,
+               "metric": "auc"}, dtrain, num_boost_round=5,
+              valid_sets=[dvalid], valid_names=["v"],
+              callbacks=[lgb.record_evaluation(evals)])
+    assert evals["v"]["auc"][-1] > 0.55
+
+
+def test_efb_with_missing_values():
+    X, y = make_sparse_problem(2500)
+    X[::13, 1] = np.nan
+    params = {"objective": "binary", "num_leaves": 15, "verbosity": -1,
+              "min_data_in_leaf": 5}
+    a = lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=4)
+    b = lgb.train({**params, "enable_bundle": False},
+                  lgb.Dataset(X, label=y), num_boost_round=4)
+    np.testing.assert_allclose(a.predict(X), b.predict(X),
+                               rtol=1e-3, atol=1e-4)

@@ -1,0 +1,126 @@
+"""Lambdarank objective tests: length-bucketed pairwise gradients.
+
+reference: rank_objective.hpp:98-230 (per-query sigmoid-weighted lambdas,
+|ΔNDCG| scaling, truncation, lambdarank_norm).  The bucketed layout
+(objectives._bucket_queries) must (a) match a direct per-query oracle
+exactly and (b) survive MSLR-shaped query-length distributions (30k+
+queries, docs/query up to ~1300) without materializing (Q, Mmax, Mmax).
+"""
+
+import numpy as np
+import pytest
+
+import lightgbmv1_tpu as lgb
+from lightgbmv1_tpu.config import Config
+from lightgbmv1_tpu.io.dataset import Metadata
+from lightgbmv1_tpu.objectives import LambdarankNDCG, _bucket_queries
+
+
+def _oracle_lambdarank(scores, labels, qb, gains, sigmoid, trunc, norm):
+    """Direct per-query numpy port of the reference's GetGradientsForOneQuery
+    (rank_objective.hpp:139-230) under this repo's formulation."""
+    N = len(scores)
+    grad = np.zeros(N)
+    hess = np.zeros(N)
+    for b, e in zip(qb[:-1], qb[1:]):
+        sc = scores[b:e]
+        g = gains[labels[b:e]]
+        n = e - b
+        order = np.argsort(-sc, kind="stable")
+        ranks = np.empty(n, np.int64)
+        ranks[order] = np.arange(n)
+        disc = np.where(ranks < trunc, 1.0 / np.log2(2.0 + ranks), 0.0)
+        ideal = np.sort(g)[::-1][: max(trunc, 1)]
+        idcg = (ideal / np.log2(np.arange(2, len(ideal) + 2))).sum()
+        inv = 1.0 / idcg if idcg > 0 else 0.0
+        lam = np.zeros((n, n))
+        hes = np.zeros((n, n))
+        for i in range(n):
+            for j in range(n):
+                if g[i] <= g[j] or (disc[i] == 0 and disc[j] == 0):
+                    continue
+                delta = abs(g[i] - g[j]) * abs(disc[i] - disc[j]) * inv
+                p = 1.0 / (1.0 + np.exp(sigmoid * (sc[i] - sc[j])))
+                lam[i, j] = -sigmoid * p * delta
+                hes[i, j] = sigmoid * sigmoid * p * (1 - p) * delta
+        gq = lam.sum(axis=1) - lam.sum(axis=0)
+        hq = hes.sum(axis=1) + hes.sum(axis=0)
+        if norm:
+            s = np.abs(lam).sum() + 1e-10
+            scale = np.log2(1.0 + s) / s
+            gq, hq = gq * scale, hq * scale
+        grad[b:e] = gq
+        hess[b:e] = hq
+    return grad, np.maximum(hess, 1e-20)
+
+
+def _make_objective(labels, group, cfg_extra=None):
+    cfg = Config.from_dict({"objective": "lambdarank", "verbosity": -1,
+                            **(cfg_extra or {})})
+    obj = LambdarankNDCG(cfg)
+    meta = Metadata(label=np.asarray(labels, np.float32))
+    meta.set_group(np.asarray(group))
+    obj.init(meta, len(labels))
+    return obj, cfg
+
+
+@pytest.mark.parametrize("norm", [True, False])
+def test_bucketed_matches_oracle(norm):
+    rng = np.random.RandomState(0)
+    group = rng.randint(3, 40, size=25)              # mixed query lengths
+    N = int(group.sum())
+    labels = rng.randint(0, 4, N)
+    scores = rng.randn(N).astype(np.float32)
+    obj, cfg = _make_objective(labels, group,
+                               {"lambdarank_norm": norm})
+    import jax.numpy as jnp
+
+    g, h = obj.get_gradients(jnp.asarray(scores))
+    qb = np.concatenate([[0], np.cumsum(group)])
+    gains = np.asarray(cfg.label_gain_or_default)
+    go, ho = _oracle_lambdarank(scores.astype(np.float64), labels, qb, gains,
+                                cfg.sigmoid,
+                                cfg.lambdarank_truncation_level, norm)
+    np.testing.assert_allclose(np.asarray(g), go, rtol=2e-4, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(h), ho, rtol=2e-4, atol=1e-6)
+
+
+def test_bucket_layout_covers_all_queries():
+    rng = np.random.RandomState(1)
+    group = rng.randint(1, 700, size=400)
+    qb = np.concatenate([[0], np.cumsum(group)])
+    chunks = _bucket_queries(qb)
+    seen = np.zeros(int(group.sum()), np.int32)
+    for idx, mask, qids in chunks:
+        # bucket width is the pow2 pad of its longest query
+        assert idx.shape[1] >= mask.sum(axis=1).max()
+        seen[idx[mask]] += 1
+    assert (seen == 1).all()             # every row exactly once
+
+
+def test_mslr_shaped_scale():
+    """MSLR/Yahoo-regime query widths (up to ~1300 docs/query): the
+    bucketed gradients must fit in memory — the old global-pad layout
+    would need a (Q, 1300, 1300) pairwise tensor (~200 TB at the full 30k
+    queries).  8k queries here keeps CI wall-clock sane; memory scales
+    linearly in Q, the width axis is what the bucketing fixes."""
+    rng = np.random.RandomState(2)
+    Q = 8000
+    u = rng.rand(Q)
+    sizes = np.where(u < 0.85, rng.randint(8, 200, Q),
+                     np.where(u < 0.97, rng.randint(200, 600, Q),
+                              rng.randint(600, 1300, Q)))
+    N = int(sizes.sum())
+    labels = rng.randint(0, 5, N)
+    scores = rng.randn(N).astype(np.float32)
+    obj, _ = _make_objective(labels, sizes)
+    import jax.numpy as jnp
+
+    g, h = obj.get_gradients(jnp.asarray(scores))
+    g, h = np.asarray(g), np.asarray(h)
+    assert g.shape == (N,)
+    assert np.isfinite(g).all() and np.isfinite(h).all()
+    assert (h > 0).any()
+    # winners (high label) should on average be pushed up (negative grad
+    # means score increase in GBDT convention: new tree fits -grad)
+    assert g[labels >= 3].mean() < g[labels == 0].mean()
