@@ -89,11 +89,22 @@ def find_bundles(
     group_bins: List[int] = []               # bins used (incl. shared bin 0)
     group_members: List[List[int]] = []
 
+    # bounded search, like the reference's max_search_group random fallback
+    # (dataset.cpp:119-130): without a cap the greedy loop is
+    # O(F * groups * S) and stalls on 100k-feature inputs
+    MAX_SEARCH = 256
+    rng = np.random.RandomState(3)
+
     for f in order:
         fm = nonzero_masks[f]
         nb = int(num_bins[f])
         placed = False
-        for g in range(len(group_masks)):
+        n_groups = len(group_masks)
+        if n_groups <= MAX_SEARCH:
+            candidates = range(n_groups)
+        else:
+            candidates = rng.choice(n_groups, size=MAX_SEARCH, replace=False)
+        for g in candidates:
             # (reference GetConflictCount, dataset.cpp:50): rows where both
             # the bundle and the candidate are non-zero
             if group_bins[g] + nb > max_bundle_bins:

@@ -52,6 +52,10 @@ class Metadata:
     group: Optional[np.ndarray] = None          # per-query sizes
     query_boundaries: Optional[np.ndarray] = None  # cumulative, len num_queries+1
     init_score: Optional[np.ndarray] = None
+    valid_rows: Optional[np.ndarray] = None     # bool mask: False marks the
+                                                # phantom pad rows of process-
+                                                # sharded datasets; None =
+                                                # every row is real
 
     def set_group(self, group: Optional[np.ndarray]) -> None:
         if group is None:
@@ -376,9 +380,16 @@ class BinnedDataset:
                     is_bundled=np.zeros(num_features, bool),
                     bundle_nbins=np.asarray(ds.num_bins, np.int32),
                 )
-        ds.bundle_layout = layout
-        ds.bundled = apply_bundles_csr(indptr, indices, bin_values,
-                                       num_data, ds.zero_bins, layout)
+        built = apply_bundles_csr(indptr, indices, bin_values,
+                                  num_data, ds.zero_bins, layout)
+        if not layout.is_bundled.any():
+            # identity layout: bundle bins == original bins, so this IS the
+            # plain dense binned matrix — record it as such (no decode path,
+            # no spurious EFB incompatibility gates)
+            ds.binned = built
+        else:
+            ds.bundle_layout = layout
+            ds.bundled = built
         log_info(
             f"Constructed sparse binned dataset: {num_data} rows, "
             f"{num_features} features -> {layout.num_bundles} bundle "
@@ -409,10 +420,29 @@ class BinnedDataset:
         meta = self.metadata
         fh = open(path, "wb")   # keep the exact filename (savez appends .npz
                                 # to bare string paths)
+        bl = self.bundle_layout
         np.savez_compressed(
             fh,
             magic=np.frombuffer(self.BINARY_MAGIC.encode(), dtype=np.uint8),
-            binned=self.binned,
+            # sparse-path datasets carry only the EFB bundle matrix;
+            # load_binary reconstructs whichever representation was saved
+            binned=(self.binned if self.binned is not None
+                    else np.zeros((0, 0), np.uint8)),
+            # dense-path bundles are re-derived on load from binned + the
+            # layout (writing both matrices would double the cache size);
+            # only the sparse path persists the bundle matrix itself
+            bundled=(self.bundled
+                     if self.bundled is not None and self.binned is None
+                     else np.zeros((0, 0), np.uint8)),
+            bundle_of=(bl.bundle_of if bl is not None
+                       else np.zeros(0, np.int32)),
+            bundle_offset=(bl.offset if bl is not None
+                           else np.zeros(0, np.int32)),
+            bundle_is_bundled=(bl.is_bundled if bl is not None
+                               else np.zeros(0, bool)),
+            bundle_nbins=(bl.bundle_nbins if bl is not None
+                          else np.zeros(0, np.int32)),
+            num_data=np.int64(self.num_data),
             max_bin=np.int64(self.max_bin),
             feature_names=np.array(self.feature_names),
             mapper_scalars=scalars,
@@ -474,9 +504,23 @@ class BinnedDataset:
                 meta.set_group(z["group"])
             if z["init_score"].size:
                 meta.init_score = z["init_score"]
-            ds = cls(z["binned"], mappers, meta,
+            binned = z["binned"] if z["binned"].size else None
+            num_data = (int(z["num_data"]) if "num_data" in z
+                        else z["binned"].shape[1])
+            ds = cls(binned, mappers, meta,
                      feature_names=[str(s) for s in z["feature_names"]],
-                     max_bin=int(z["max_bin"]))
+                     max_bin=int(z["max_bin"]), num_data=num_data)
+            if "bundle_of" in z and z["bundle_of"].size:
+                from .bundle import BundleLayout, apply_bundles_dense
+
+                ds.bundle_layout = BundleLayout(
+                    bundle_of=z["bundle_of"], offset=z["bundle_offset"],
+                    is_bundled=z["bundle_is_bundled"],
+                    bundle_nbins=z["bundle_nbins"])
+                ds.bundled = (z["bundled"] if z["bundled"].size
+                              else apply_bundles_dense(
+                                  ds.binned, ds.zero_bins,
+                                  ds.bundle_layout))
         log_info(f"Loaded binary dataset cache from {path}: "
                  f"{ds.num_data} rows, {ds.num_features} features")
         return ds
@@ -485,9 +529,11 @@ class BinnedDataset:
     def bin_raw_features(self, X: np.ndarray) -> np.ndarray:
         """Bin new raw data with this dataset's mappers → (F, N) bins."""
         X = np.asarray(X)
-        out = np.empty((self.num_features, X.shape[0]), dtype=self.binned.dtype)
+        dtype = (self.binned.dtype if self.binned is not None
+                 else (np.uint8 if self.num_total_bin <= 256 else np.int16))
+        out = np.empty((self.num_features, X.shape[0]), dtype=dtype)
         for j, m in enumerate(self.bin_mappers):
-            out[j] = m.value_to_bin(X[:, j]).astype(self.binned.dtype)
+            out[j] = m.value_to_bin(X[:, j]).astype(dtype)
         return out
 
     def feature_infos(self) -> List[str]:

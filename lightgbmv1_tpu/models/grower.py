@@ -82,6 +82,30 @@ class GrowerState(NamedTuple):
     done: jax.Array           # () bool
 
 
+def forced_split_stats(hf, parent_sum, ffeat, fbin, fdl, meta, params):
+    """Left/right sums + ACTUAL gain of a forced split, from the leaf's
+    histogram of the forced feature (the reference computes the real
+    SplitInfo for forced thresholds, serial_tree_learner.cpp:500-520).
+    Shared by the sequential and level-wise growers so the NaN
+    default-direction accounting and the relative-gain convention cannot
+    drift apart."""
+    from ..ops.split import leaf_gain
+
+    cumf = jnp.cumsum(hf, axis=0)                    # (B, 3)
+    has_nan = meta.missing_type[ffeat] == MISSING_NAN
+    nan_c = hf[jnp.maximum(meta.nan_bin[ffeat], 0)] * jnp.where(
+        has_nan, 1.0, 0.0)
+    in_cum = has_nan & (meta.nan_bin[ffeat] <= fbin)
+    flsum = cumf[fbin] + nan_c * (
+        jnp.asarray(fdl).astype(jnp.float32) - in_cum.astype(jnp.float32))
+    frsum = parent_sum - flsum
+    fgain = (leaf_gain(flsum[0], flsum[1], params)
+             + leaf_gain(frsum[0], frsum[1], params)
+             - leaf_gain(parent_sum[0], parent_sum[1], params)
+             - params.min_gain_to_split)
+    return flsum, frsum, fgain
+
+
 def allowed_features_for(groups, used):
     """reference ColSampler::GetByNode: branch features + union of
     interaction-constraint groups containing ALL branch features
@@ -391,19 +415,13 @@ def make_leafwise_grower(
                 fleaf = jnp.maximum(fleaf_raw, 0)
                 ffeat = f_feat[sidx]
                 fthr, fdl = f_bin[sidx], f_dl[sidx]
-                hf = st.hist_pool[fleaf, ffeat]               # (B, 3)
-                cumf = jnp.cumsum(hf, axis=0)
-                has_nan = meta.missing_type[ffeat] == MISSING_NAN
-                nan_c = hf[jnp.maximum(meta.nan_bin[ffeat], 0)] * jnp.where(
-                    has_nan, 1.0, 0.0)
-                in_cum = has_nan & (meta.nan_bin[ffeat] <= fthr)
-                flsum = cumf[fthr] + nan_c * (
-                    fdl.astype(jnp.float32) - in_cum.astype(jnp.float32))
-                frsum = st.leaf_sums[fleaf] - flsum
+                flsum, frsum, forced_gain = forced_split_stats(
+                    st.hist_pool[fleaf, ffeat], st.leaf_sums[fleaf],
+                    ffeat, fthr, fdl, meta, params)
                 ok_f = maybe & parent_ok & (flsum[2] > 0) & (frsum[2] > 0)
                 is_forced = ok_f
                 leaf = jnp.where(ok_f, fleaf, leaf)
-                gain = jnp.where(ok_f, jnp.float32(0.0), gain)
+                gain = jnp.where(ok_f, forced_gain, gain)
             active = (~st.done) & ((gain > 0) | is_forced)
 
             def do_split(st: GrowerState) -> GrowerState:
@@ -623,12 +641,21 @@ def make_levelwise_grower(
     monotone_penalty: float = 0.0,
     interaction_groups=None,
     cegb_coupled=None,
+    forced_splits=None,
     hist_frontier_fn: Callable = None,
     split_fn: Callable = None,
     sums_fn: Callable = None,
     bins_of_rows_fn: Callable = None,
 ):
     """Depth-wise tree growth with the whole frontier batched per level.
+
+    ``forced_splits``: optional (S, 6) int array [parent_step, side,
+    feature, bin, default_left, depth] in BFS order (parse_forced_splits).
+    A forced step applies at its BFS depth's level: the targeted frontier
+    leaf splits on the forced (feature, bin) instead of its best split,
+    bypassing the gain test and the per-level budget ranking (reference:
+    SerialTreeLearner::ForceSplits, serial_tree_learner.cpp:427-539 —
+    forced splits occupy the top of the tree in both growth orders).
 
     Rationale: an exact leaf-wise step histograms ONE leaf, which on the MXU
     is a 3-row matmul (3/128 utilization).  Batching all `2^d` leaves of a
@@ -654,6 +681,18 @@ def make_levelwise_grower(
     use_mc = bool(np.asarray(meta.monotone_type).any())
     groups_lw = (jnp.asarray(interaction_groups)
                  if interaction_groups is not None else None)
+
+    S_forced = 0 if forced_splits is None else min(len(forced_splits), L - 1)
+    steps_at_depth = {}
+    if S_forced:
+        fs_np = np.asarray(forced_splits)[:S_forced]
+        if max_depth <= 0:
+            # forced chains deeper than ceil(log2(L)) extend the level loop
+            levels = max(levels, min(int(fs_np[:, 5].max()) + 1, L - 1))
+        for s in range(S_forced):
+            d = int(fs_np[s, 5])
+            if d < levels:
+                steps_at_depth.setdefault(d, []).append(s)
 
     use_cegb_lw = (params.cegb_penalty_split > 0) or (cegb_coupled is not None)
     coupled_lw = (jnp.asarray(cegb_coupled, jnp.float32)
@@ -722,6 +761,7 @@ def make_levelwise_grower(
         leaf_is_left = jnp.zeros(L, bool)
         num_leaves_cur = jnp.asarray(1, jnp.int32)
         num_nodes_cur = jnp.asarray(0, jnp.int32)
+        forced_leaf = jnp.full((max(S_forced, 1), 2), -1, jnp.int32)
 
         for d in range(levels):
             Ld = min(1 << d, L)
@@ -752,10 +792,45 @@ def make_levelwise_grower(
                 )(hist, leaf_sums[:Ld], masks, leaf_constr[:Ld],
                   leaf_out[:Ld], uids, cegb_pen)
 
+            # ---- forced splits for this level (BFS depth == d) ------------
+            forced_now = jnp.zeros(Ld, bool)
+            forced_steps_d = steps_at_depth.get(d, [])
+            forced_resolved = {}          # s -> (tleaf, ok) for recording
+            for s in forced_steps_d:
+                pstep, side = int(fs_np[s, 0]), int(fs_np[s, 1])
+                ffeat, fbin = int(fs_np[s, 2]), int(fs_np[s, 3])
+                fdl = bool(fs_np[s, 4])
+                traw = (jnp.asarray(0, jnp.int32) if pstep < 0
+                        else forced_leaf[pstep, side])
+                ok_p = (traw >= 0) & (traw < Ld)
+                tleaf = jnp.clip(traw, 0, Ld - 1)
+                flsum, frsum, fgain = forced_split_stats(
+                    hist[tleaf, ffeat], leaf_sums[tleaf], ffeat, fbin, fdl,
+                    meta, params)
+                ok = ok_p & leaf_active[tleaf] & (flsum[2] > 0) & \
+                    (frsum[2] > 0)
+                forced_resolved[s] = (tleaf, ok)
+                sel = jax.nn.one_hot(tleaf, Ld, dtype=bool) & ok
+                res = res._replace(
+                    gain=jnp.where(sel, fgain, res.gain),
+                    feature=jnp.where(sel, ffeat, res.feature),
+                    threshold_bin=jnp.where(sel, fbin, res.threshold_bin),
+                    default_left=jnp.where(sel, fdl, res.default_left),
+                    is_cat=jnp.where(sel, False, res.is_cat),
+                    left_sum=jnp.where(sel[:, None], flsum[None, :],
+                                       res.left_sum),
+                    right_sum=jnp.where(sel[:, None], frsum[None, :],
+                                        res.right_sum),
+                )
+                forced_now = forced_now | sel
+
             gains = jnp.where(leaf_active[:Ld], res.gain, -jnp.inf)
-            want = gains > 0
-            # budget: rank wanted splits by gain, keep the top (L - current)
-            order = jnp.argsort(-jnp.where(want, gains, -jnp.inf))
+            rank_gains = jnp.where(forced_now, jnp.inf, gains)
+            want = rank_gains > 0
+            # budget: rank wanted splits by gain, keep the top (L - current);
+            # forced splits rank first (reference applies them regardless of
+            # the gain test)
+            order = jnp.argsort(-jnp.where(want, rank_gains, -jnp.inf))
             rank = jnp.zeros(Ld, jnp.int32).at[order].set(
                 jnp.arange(Ld, dtype=jnp.int32))
             budget = L - num_leaves_cur
@@ -764,6 +839,15 @@ def make_levelwise_grower(
             split_order = jnp.cumsum(split_mask.astype(jnp.int32)) - 1
             node_idx = num_nodes_cur + split_order          # (Ld,)
             new_leaf = num_leaves_cur + split_order
+            for s in forced_steps_d:
+                # record the REALIZED children of applied forced steps so
+                # deeper forced steps resolve against actual leaf ids
+                # (left child keeps the leaf slot, right child is new_leaf)
+                tleaf, ok = forced_resolved[s]
+                applied = ok & split_mask[tleaf]
+                forced_leaf = forced_leaf.at[s].set(jnp.where(
+                    applied, jnp.stack([tleaf, new_leaf[tleaf]]),
+                    forced_leaf[s]))
 
             # per-row partition update (vectorized over all rows at once)
             feat_l = jnp.where(split_mask, res.feature, 0)

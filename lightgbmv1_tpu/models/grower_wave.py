@@ -67,6 +67,69 @@ from .grower import _node_feature_mask, allowed_features_for
 from .tree import TreeArrays, empty_tree
 
 
+def _box_adjacency_per_feature(lo, hi, feats):
+    """Yield ``(f, adj_up, adj_dn)`` pairwise adjacency matrices for leaf
+    boxes along each feature in ``feats``: A→B adjacent-up along f means
+    hi_A[f] == lo_B[f] with the boxes overlapping in EVERY other feature.
+    Overlap counts are accumulated in feature blocks so peak residency is
+    (L, L, 256), not (L, L, F).  Shared by the per-round constraint
+    recomputation and the same-round split deferral so the adjacency
+    definition cannot drift between them."""
+    L, F = lo.shape
+    ov_cnt = jnp.zeros((L, L), jnp.int32)
+    FB = 256
+    for c0 in range(0, F, FB):
+        c1 = min(c0 + FB, F)
+        ovb = (lo[:, None, c0:c1] < hi[None, :, c0:c1]) & \
+              (lo[None, :, c0:c1] < hi[:, None, c0:c1])
+        ov_cnt = ov_cnt + ovb.sum(axis=2).astype(jnp.int32)
+    for f in feats:
+        ov_f = (lo[:, None, f] < hi[None, :, f]) & \
+               (lo[None, :, f] < hi[:, None, f])
+        other = (ov_cnt - ov_f.astype(jnp.int32)) == (F - 1)
+        adj_up = (hi[:, None, f] == lo[None, :, f]) & other
+        adj_dn = (lo[:, None, f] == hi[None, :, f]) & other
+        yield f, adj_up, adj_dn
+
+
+def intermediate_constraints(boxes, outs, num_leaves, mono_feats,
+                             mono_types):
+    """Vectorized re-design of the reference's IntermediateLeafConstraints
+    (src/treelearner/monotone_constraints.hpp:125-310).
+
+    The reference walks the tree recursively after every split
+    (GoUpToFindLeavesToUpdate / GoDownToFindLeavesToUpdate) to find leaves
+    whose region is CONTIGUOUS to the new children along a monotone feature
+    and tightens their bounds against the new outputs.  Here every leaf
+    carries its bin-space box (``boxes`` (L, F, 2) [lo, hi)), and all
+    constraints are recomputed from scratch each round as a pairwise
+    adjacency reduction: leaf A's upper bound along an increasing feature f
+    is the min output over leaves adjacent above it (hi_A[f] == lo_B[f],
+    overlapping in every other feature) — O(L²·F) vectorized ops, trivial
+    per round, no recursion.  Bounds come from neighbouring leaf OUTPUTS
+    instead of the basic mode's split midpoints, which is the point of the
+    intermediate mode: tighter leaves, better gains.
+    """
+    L, F, _ = boxes.shape
+    lo = boxes[..., 0]
+    hi = boxes[..., 1]
+    iota = jnp.arange(L, dtype=jnp.int32)
+    valid_b = (iota[None, :] < num_leaves) & (iota[:, None] != iota[None, :])
+    max_c = jnp.full(L, NO_CONSTRAINT[1], jnp.float32)
+    min_c = jnp.full(L, NO_CONSTRAINT[0], jnp.float32)
+    types = dict(zip(mono_feats, mono_types))
+    for f, adj_up, adj_dn in _box_adjacency_per_feature(lo, hi, mono_feats):
+        adj_up = adj_up & valid_b
+        adj_dn = adj_dn & valid_b
+        if types[f] < 0:           # decreasing: roles of up/down swap
+            adj_up, adj_dn = adj_dn, adj_up
+        max_c = jnp.minimum(max_c, jnp.min(
+            jnp.where(adj_up, outs[None, :], jnp.inf), axis=1))
+        min_c = jnp.maximum(min_c, jnp.max(
+            jnp.where(adj_dn, outs[None, :], -jnp.inf), axis=1))
+    return jnp.stack([min_c, max_c], axis=1)           # (L, 2)
+
+
 class WaveState(NamedTuple):
     leaf_id: jax.Array        # (N,) int32 — current leaf of every row
     best_gain: jax.Array      # (L,) — frontier priority queue (−inf = closed)
@@ -78,6 +141,8 @@ class WaveState(NamedTuple):
     best_iscat: jax.Array     # (L,) bool
     best_bitset: jax.Array    # (L, W) uint32
     leaf_constr: jax.Array    # (L, 2) — monotone [min, max] output bounds
+    leaf_box: jax.Array       # (L, F, 2) — bin-space region per leaf
+                              # (intermediate monotone mode; (1, 1, 2) dummy)
     leaf_out: jax.Array       # (L,) — current leaf output (path smoothing)
     leaf_used: jax.Array      # (L, F) bool — branch features (interactions)
     leaf_depth: jax.Array     # (L,) int32
@@ -115,6 +180,7 @@ def make_wave_grower(
     max_depth: int = -1,
     feature_fraction_bynode: float = 1.0,
     monotone_penalty: float = 0.0,
+    monotone_mode: str = "basic",
     interaction_groups=None,
     wave_size: int = 32,
     hist_wave_fn: Callable = None,
@@ -142,6 +208,11 @@ def make_wave_grower(
     W = -(-B // 32)
     use_mc = bool(np.asarray(meta.monotone_type).any())
     use_cat = bool(np.asarray(meta.is_categorical).any())
+    use_inter = use_mc and monotone_mode == "intermediate"
+    if use_inter:
+        _mt = np.asarray(meta.monotone_type)
+        inter_feats = [int(f) for f in np.where(_mt != 0)[0]]
+        inter_types = [int(_mt[f]) for f in inter_feats]
     groups = (jnp.asarray(interaction_groups)
               if interaction_groups is not None else None)
 
@@ -202,6 +273,9 @@ def make_wave_grower(
             best_bitset=jnp.zeros((L, W), jnp.uint32).at[0].set(res0.cat_bitset),
             leaf_constr=jnp.tile(jnp.asarray(NO_CONSTRAINT, jnp.float32),
                                  (L, 1)),
+            leaf_box=(jnp.zeros((L, F, 2), jnp.int32)
+                      .at[0, :, 1].set(meta.num_bins)
+                      if use_inter else jnp.zeros((1, 1, 2), jnp.int32)),
             leaf_out=jnp.zeros(L, jnp.float32).at[0].set(out0),
             leaf_used=jnp.zeros((L, F), bool),
             leaf_depth=jnp.zeros(L, jnp.int32),
@@ -220,6 +294,24 @@ def make_wave_grower(
             budget = L - st.num_leaves
             vals, leafs = _topk_by_rank(st.best_gain, K)      # (K,) gain order
             valid = (vals > 0) & (kiota < budget)
+            if use_inter and K > 1:
+                # soundness: two leaves ADJACENT along a monotone feature
+                # must not split in the same round — each child would be
+                # clamped against the neighbour's stale pre-round output
+                # and monotonicity could break between the new children.
+                # Defer the lower-ranked leaf of any adjacent pair to a
+                # later round (it stays in the queue); the sequential
+                # reference orders such splits implicitly.
+                kb = st.leaf_box[leafs]                        # (K, F, 2)
+                adj = jnp.zeros((K, K), bool)
+                for _f, adj_up, adj_dn in _box_adjacency_per_feature(
+                        kb[..., 0], kb[..., 1], inter_feats):
+                    adj = adj | adj_up | adj_dn
+                kept = valid
+                for j in range(1, K):
+                    clash = jnp.any(adj[j, :j] & kept[:j])
+                    kept = kept.at[j].set(kept[j] & (~clash))
+                valid = kept
             n_split = valid.sum()
             order = jnp.cumsum(valid.astype(jnp.int32)) - 1
             nodes = st.num_leaves - 1 + order                 # (K,) int32
@@ -265,11 +357,40 @@ def make_wave_grower(
             # ---- children metadata --------------------------------------
             cleafs = jnp.stack([leafs, nls], axis=1).reshape(2 * K)
             csums = jnp.stack([lsums, rsums], axis=1).reshape(2 * K, 3)
-            pconstr = st.leaf_constr[leafs]                   # (K, 2)
+            if use_inter:
+                # fresh per-round constraints from leaf-region adjacency —
+                # the outputs of neighbouring leaves may have changed since
+                # this leaf's constraint was stored (the reference's
+                # leaves_to_update_ propagation, monotone_constraints.hpp)
+                constr_tab = intermediate_constraints(
+                    st.leaf_box, st.leaf_out, st.num_leaves,
+                    inter_feats, inter_types)
+                pconstr = constr_tab[leafs]                   # (K, 2)
+            else:
+                pconstr = st.leaf_constr[leafs]               # (K, 2)
             pout = st.leaf_out[leafs]                         # (K,)
             out_l = jax.vmap(clamp_out)(lsums, pconstr, pout)
             out_r = jax.vmap(clamp_out)(rsums, pconstr, pout)
-            if use_mc:
+            if use_inter:
+                # children bounded by the SIBLING's actual output
+                # (UpdateConstraintsWithOutputs, monotone_constraints.hpp:154)
+                mono = meta.monotone_type[feats]
+                upd = (~iscats) & (mono != 0)
+                max_l = jnp.where(upd & (mono > 0),
+                                  jnp.minimum(pconstr[:, 1], out_r),
+                                  pconstr[:, 1])
+                min_l = jnp.where(upd & (mono < 0),
+                                  jnp.maximum(pconstr[:, 0], out_r),
+                                  pconstr[:, 0])
+                max_r = jnp.where(upd & (mono < 0),
+                                  jnp.minimum(pconstr[:, 1], out_l),
+                                  pconstr[:, 1])
+                min_r = jnp.where(upd & (mono > 0),
+                                  jnp.maximum(pconstr[:, 0], out_l),
+                                  pconstr[:, 0])
+                constr_l = jnp.stack([min_l, max_l], axis=1)
+                constr_r = jnp.stack([min_r, max_r], axis=1)
+            elif use_mc:
                 # BasicLeafConstraints::Update (monotone_constraints.hpp:99)
                 mono = meta.monotone_type[feats]
                 mid = 0.5 * (out_l + out_r)
@@ -307,6 +428,16 @@ def make_wave_grower(
             else:
                 cmask = jnp.broadcast_to(base_mask, (2 * K, F)) & allow
 
+            if use_inter:
+                # child regions: a numerical split cuts the parent's box at
+                # thr+1 along the split feature; categorical children keep
+                # the parent box (conservative: more adjacency, never less)
+                pbox = st.leaf_box[leafs]                     # (K, F, 2)
+                kio = jnp.arange(K)
+                cut = jnp.where(iscats, pbox[kio, feats, 1], thrs + 1)
+                box_l = pbox.at[kio, feats, 1].set(cut)
+                cut_lo = jnp.where(iscats, pbox[kio, feats, 0], thrs + 1)
+                box_r = pbox.at[kio, feats, 0].set(cut_lo)
             # ---- batched split finding over the 2K children ---------------
             res = jax.vmap(
                 lambda h, p, m, u, c, dd, po: split_fn(h, p, m, key, u, c,
@@ -374,6 +505,9 @@ def make_wave_grower(
                 best_bitset=st.best_bitset.at[cidx].set(res.cat_bitset,
                                                         mode="drop"),
                 leaf_constr=st.leaf_constr.at[cidx].set(cconstr, mode="drop"),
+                leaf_box=(st.leaf_box.at[lidx].set(box_l, mode="drop")
+                          .at[nlidx].set(box_r, mode="drop")
+                          if use_inter else st.leaf_box),
                 leaf_out=st.leaf_out.at[cidx].set(couts, mode="drop"),
                 leaf_used=st.leaf_used.at[cidx].set(cused, mode="drop"),
                 leaf_depth=st.leaf_depth.at[cidx].set(cdepth, mode="drop"),

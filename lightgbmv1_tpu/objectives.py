@@ -283,8 +283,24 @@ class Binary(ObjectiveFunction):
         uniq = np.unique(self._np_label)
         if not np.all(np.isin(uniq, [0.0, 1.0])):
             log_fatal("[binary]: labels must be 0 or 1")
-        npos = float((self._np_label == 1).sum())
-        nneg = float(num_data - npos)
+        # is_unbalance uses UNWEIGHTED row counts (binary_objective.hpp:60-95)
+        # over REAL rows only: process-sharded datasets mark their phantom
+        # pad rows in metadata.valid_rows (parallel/dist_data.py); genuine
+        # user zero-weight rows still count, as in the reference
+        if metadata.valid_rows is not None:
+            valid = np.asarray(metadata.valid_rows, bool)
+        else:
+            valid = np.ones(num_data, bool)
+        npos = float(((self._np_label == 1) & valid).sum())
+        nneg = float(((self._np_label != 1) & valid).sum())
+        if metadata.weight is not None:
+            # BoostFromScore is the WEIGHTED label mean
+            # (binary_objective.hpp:136-153)
+            w = np.asarray(metadata.weight, np.float64)
+            pavg = float((w * (self._np_label == 1)).sum()
+                         / max(w.sum(), 1e-20))
+        else:
+            pavg = npos / max(npos + nneg, 1)
         if self.config.is_unbalance and npos > 0 and nneg > 0:
             # reference binary_objective.hpp:60-80: weight the smaller class up
             if npos > nneg:
@@ -294,7 +310,7 @@ class Binary(ObjectiveFunction):
         else:
             self.pos_w = self.config.scale_pos_weight
             self.neg_w = 1.0
-        self._pavg = min(max(npos / max(num_data, 1), 1e-15), 1 - 1e-15)
+        self._pavg = min(max(pavg, 1e-15), 1 - 1e-15)
 
     def _grad_hess(self, s):
         sig = self.config.sigmoid

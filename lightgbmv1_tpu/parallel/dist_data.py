@@ -163,7 +163,8 @@ def make_process_sharded(ds: BinnedDataset, config: Config) -> BinnedDataset:
 
     meta = Metadata(label=g_label.astype(np.float32),
                     weight=g_weight.astype(np.float32),
-                    init_score=g_init)
+                    init_score=g_init,
+                    valid_rows=g_valid > 0.5)
     out = BinnedDataset(binned_local, ds.bin_mappers, meta,
                         ds.feature_names, max_bin=ds.max_bin)
     out.num_data = R * world                        # GLOBAL padded rows

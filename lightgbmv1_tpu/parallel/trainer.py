@@ -161,7 +161,8 @@ def parse_forced_splits(filename: str, bin_mappers, num_leaves: int):
         thr = float(node["threshold"])
         b = int(bin_mappers[f].value_to_bin(np.asarray([thr]))[0])
         dl = bool(node.get("default_left", False))
-        out.append([pstep, side, f, b, int(dl)])
+        depth = 0 if pstep < 0 else int(out[pstep][5]) + 1
+        out.append([pstep, side, f, b, int(dl), depth])
         if node.get("left"):
             queue.append((node["left"], step, 0))
         if node.get("right"):
@@ -269,19 +270,33 @@ def build_trainer(
         log_warning(f"leafwise_wave_size={wave_size} capped to 64 (the "
                     "per-round decision pass unrolls over the wave)")
         wave_size = 64
+    mono_mode = config.monotone_constraints_method or "basic"
+    has_mono = bool(config.monotone_constraints) and any(
+        config.monotone_constraints)
+    if has_mono and mono_mode == "advanced":
+        log_warning("monotone_constraints_method=advanced (slow constraint "
+                    "recomputation) is approximated by 'intermediate'")
+        mono_mode = "intermediate"
     # auto wave_size == 1 routes to the sequential grower (same trees,
     # compacted-segment histograms); an EXPLICIT leafwise_wave_size >= 1
-    # forces the wave grower (K=1 == sequential order, used by parity tests)
+    # forces the wave grower (K=1 == sequential order, used by parity
+    # tests), as does intermediate-mode monotonicity (implemented there)
+    wants_inter = has_mono and mono_mode == "intermediate"
     use_wave = (config.tree_growth == "leafwise"
                 and not use_cegb
-                and (config.leafwise_wave_size >= 1 or wave_size > 1))
-
-    if config.monotone_constraints and \
-            config.monotone_constraints_method not in ("basic", ""):
-        log_warning(
-            f"monotone_constraints_method="
-            f"{config.monotone_constraints_method} is not implemented; "
-            "using 'basic' (reference BasicLeafConstraints semantics)")
+                and (config.leafwise_wave_size >= 1 or wave_size > 1
+                     or wants_inter))
+    if has_mono and mono_mode == "intermediate" and (
+            not use_wave or bool(config.forcedsplits_filename)):
+        # forced splits route leaf-wise growth to the sequential grower,
+        # which implements basic-mode constraints only
+        log_warning("monotone_constraints_method=intermediate is "
+                    "implemented by the wave-batched leaf-wise grower; "
+                    f"falling back to 'basic' for this configuration "
+                    f"(tree_growth={config.tree_growth}"
+                    + (", forced splits" if config.forcedsplits_filename
+                       else "") + ")")
+        mono_mode = "basic"
     _warn_unimplemented(config)
 
     common = dict(
@@ -298,13 +313,11 @@ def build_trainer(
     )
     wave_common = {k: v for k, v in common.items() if k != "cegb_coupled"}
     wave_common["wave_size"] = wave_size
+    wave_common["monotone_mode"] = mono_mode
     forced = None
     if config.forcedsplits_filename:
         if bin_mappers is None:
             log_warning("forcedsplits_filename requires bin mappers; ignored")
-        elif levelwise:
-            log_warning("forcedsplits_filename is only supported by the "
-                        "leaf-wise grower; ignored for tree_growth=levelwise")
         else:
             forced = parse_forced_splits(config.forcedsplits_filename,
                                          bin_mappers, config.num_leaves)
@@ -313,7 +326,8 @@ def build_trainer(
         if levelwise:
             grow = make_levelwise_grower(
                 hist_frontier_fn=local_frontier, split_fn=split_local,
-                bins_of_rows_fn=bins_rows_fn, **common)
+                bins_of_rows_fn=bins_rows_fn, forced_splits=forced,
+                **common)
         elif use_wave and forced is None:
             # wave-batched best-first: the leaf-wise default schedule
             # (models/grower_wave.py)
@@ -335,6 +349,11 @@ def build_trainer(
         log_warning("tree_learner=voting requires the leaf-wise grower; "
                     "using tree_learner=data for tree_growth=levelwise")
         learner = "data"
+
+    if forced is not None and learner in ("voting", "feature"):
+        log_warning(f"forcedsplits_filename is not supported with "
+                    f"tree_learner={learner}; ignored")
+        forced = None
 
     if learner == "voting":
         # PV-Tree voting (reference: VotingParallelTreeLearner,
@@ -476,8 +495,9 @@ def build_trainer(
 
             grow = make_levelwise_grower(
                 hist_frontier_fn=frontier_fn, sums_fn=sums_fn,
-                split_fn=split_local, bins_of_rows_fn=bins_rows_fn, **common)
-        elif use_wave:
+                split_fn=split_local, bins_of_rows_fn=bins_rows_fn,
+                forced_splits=forced, **common)
+        elif use_wave and forced is None:
             # one histogram Allreduce per ROUND (up to 2K child histograms
             # batched in a single psum) instead of one per split — the wave
             # schedule's distributed dividend
@@ -490,7 +510,8 @@ def build_trainer(
         else:
             grow = make_leafwise_grower(hist_fn=hist_fn, sums_fn=sums_fn,
                                         split_fn=split_local,
-                                        bins_of_fn=bins_feat_fn, **common)
+                                        bins_of_fn=bins_feat_fn,
+                                        forced_splits=forced, **common)
         sharded = shard_map(
             grow,
             mesh=mesh,
@@ -515,9 +536,6 @@ def build_trainer(
         return grow_fn, binned_dev, N
 
     if learner == "feature":
-        if levelwise:
-            log_warning("tree_growth=levelwise is not yet available with "
-                        "tree_learner=feature; using leafwise")
         mesh = _make_mesh(config.num_shards, "feature")
         ndev = mesh.devices.size
         F_pad = ((F + ndev - 1) // ndev) * ndev
@@ -595,7 +613,26 @@ def build_trainer(
             interaction_groups=parse_interaction_constraints(
                 config.interaction_constraints, F_pad),
         )
-        if use_wave:
+        if not levelwise and use_wave:
+            # the wave grower implements intermediate-mode monotonicity;
+            # the level-wise grower is basic-only (warned above)
+            fp_kwargs["monotone_mode"] = mono_mode
+        if levelwise:
+            # feature-sharded frontier histograms + vmapped all_gather
+            # argmax per leaf — the level-wise grower composes with the
+            # feature-parallel learner like the leaf-wise ones do
+            def fp_frontier(binned, g3, leaf_id, L_level):
+                lo = lax.axis_index("feature") * F_loc
+                block = lax.dynamic_slice(binned, (lo, 0), (F_loc, N))
+                h = hist_frontier(block, g3, leaf_id, L_level, Bh,
+                                  method=method, precision=precision)
+                full = jnp.zeros((L_level, F_pad, Bh, 3), jnp.float32)
+                return lax.dynamic_update_slice(full, h, (0, lo, 0, 0))
+
+            grow = make_levelwise_grower(
+                hist_frontier_fn=fp_frontier, split_fn=split_fn,
+                cegb_coupled=coupled_fp, **fp_kwargs)
+        elif use_wave:
             grow = make_wave_grower(
                 hist_wave_fn=hist_wave_fp, split_fn=split_fn,
                 wave_size=wave_size, **fp_kwargs)

@@ -162,6 +162,32 @@ def test_efb_valid_set_alignment():
     assert evals["v"]["auc"][-1] > 0.55
 
 
+def test_sparse_binary_cache_roundtrip(tmp_path):
+    """save_binary/load_binary must persist bundle matrices + layout for
+    sparse-path datasets (no dense binned to fall back on)."""
+    import scipy.sparse as sp
+    from lightgbmv1_tpu.io.dataset import BinnedDataset
+
+    rng = np.random.RandomState(0)
+    n, F = 2000, 60
+    nnz = int(n * F * 0.05)
+    Xs = sp.csr_matrix((rng.rand(nnz) + 0.1,
+                        (rng.randint(0, n, nnz), rng.randint(0, F, nnz))),
+                       shape=(n, F))
+    y = (np.asarray(Xs @ rng.randn(F)).ravel() > 0).astype(float)
+    ds = lgb.Dataset(Xs, label=y)
+    ds.construct()
+    path = tmp_path / "sparse.bin"
+    ds._binned.save_binary(str(path))
+    loaded = BinnedDataset.load_binary(str(path))
+    assert loaded.num_data == n
+    np.testing.assert_array_equal(np.asarray(loaded.train_matrix),
+                                  np.asarray(ds._binned.train_matrix))
+    if ds._binned.bundle_layout is not None:
+        np.testing.assert_array_equal(loaded.bundle_layout.bundle_of,
+                                      ds._binned.bundle_layout.bundle_of)
+
+
 def test_efb_with_missing_values():
     X, y = make_sparse_problem(2500)
     X[::13, 1] = np.nan

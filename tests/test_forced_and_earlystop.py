@@ -35,6 +35,44 @@ def test_forced_splits(tmp_path):
     assert acc > 0.8                                 # still learns after
 
 
+def test_forced_splits_levelwise(tmp_path):
+    """Forced splits apply at their BFS depth in the level-wise grower too
+    (reference CLI configs with forcedsplits_filename must run regardless
+    of growth order)."""
+    rng = np.random.RandomState(0)
+    X = rng.randn(2000, 4)
+    y = (X[:, 0] - X[:, 1] > 0).astype(float)
+    spec = {"feature": 3, "threshold": 0.5,
+            "left": {"feature": 2, "threshold": -0.25},
+            "right": {"feature": 2, "threshold": 0.75}}
+    path = tmp_path / "forced.json"
+    path.write_text(json.dumps(spec))
+    bst = lgb.train({"objective": "binary", "num_leaves": 15,
+                     "tree_growth": "levelwise",
+                     "verbosity": -1, "forcedsplits_filename": str(path)},
+                    lgb.Dataset(X, label=y), num_boost_round=3)
+    for t in bst._all_trees():
+        assert int(t.split_feature[0]) == 3          # forced root
+        # the level-1 forced nodes are among the nodes split at that level
+        feats_lvl1 = {int(t.split_feature[1]), int(t.split_feature[2])}
+        assert feats_lvl1 == {2}
+        assert abs(float(t.threshold[0]) - 0.5) < 0.1
+    acc = ((bst.predict(X) > 0.5) == (y > 0.5)).mean()
+    assert acc > 0.8
+
+
+def test_forced_splits_levelwise_skips_empty(tmp_path):
+    X, y = make_binary_problem(n=800)
+    spec = {"feature": 0, "threshold": 1e9}
+    path = tmp_path / "forced.json"
+    path.write_text(json.dumps(spec))
+    bst = lgb.train({"objective": "binary", "num_leaves": 7, "verbosity": -1,
+                     "tree_growth": "levelwise",
+                     "forcedsplits_filename": str(path)},
+                    lgb.Dataset(X, label=y), num_boost_round=2)
+    assert bst.num_trees() == 2
+
+
 def test_forced_splits_skips_empty_children(tmp_path):
     X, y = make_binary_problem(n=800)
     # threshold far outside the data range => forced split would create an

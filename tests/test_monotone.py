@@ -56,6 +56,62 @@ def test_monotone_constraints_enforced(growth):
     assert np.corrcoef(pred, y)[0, 1] > 0.9
 
 
+def test_intermediate_mode_enforced_and_tighter():
+    """reference: IntermediateLeafConstraints
+    (src/treelearner/monotone_constraints.hpp:125-310) — constraints come
+    from neighbouring leaf OUTPUTS instead of split midpoints, so the model
+    is less constrained and fits at least as well, while monotonicity must
+    still hold everywhere."""
+    X, y = make_mono_problem()
+    base = {
+        "objective": "regression", "num_leaves": 31, "min_data_in_leaf": 20,
+        "learning_rate": 0.1, "verbosity": -1,
+        "monotone_constraints": [1, -1, 0],
+    }
+    inter = lgb.train({**base, "monotone_constraints_method": "intermediate"},
+                      lgb.Dataset(X, label=y), num_boost_round=25)
+    assert is_monotone(inter, 0, +1)
+    assert is_monotone(inter, 1, -1)
+    basic = lgb.train({**base, "monotone_constraints_method": "basic"},
+                      lgb.Dataset(X, label=y), num_boost_round=25)
+    mse_i = float(np.mean((inter.predict(X) - y) ** 2))
+    mse_b = float(np.mean((basic.predict(X) - y) ** 2))
+    # the looser-bounded mode must not fit meaningfully worse
+    assert mse_i <= mse_b * 1.1, (mse_i, mse_b)
+
+
+def test_intermediate_wave_batching_sound():
+    """Adversarial: a staircase target creates many monotone-adjacent
+    leaves that want to split in the SAME wave round; without in-round
+    deferral of adjacent pairs, children clamp against stale neighbour
+    outputs and monotonicity breaks between new children."""
+    rng = np.random.RandomState(0)
+    X = rng.rand(4000, 3) * 8
+    y = np.floor(X[:, 0]) + rng.randn(4000) * 0.3
+    bst = lgb.train({"objective": "regression", "num_leaves": 63,
+                     "verbosity": -1, "monotone_constraints": [1, 0, 0],
+                     "monotone_constraints_method": "intermediate"},
+                    lgb.Dataset(X, label=y), num_boost_round=25)
+    for _ in range(100):
+        base = rng.rand(3) * 8
+        pts = np.tile(base, (100, 1))
+        pts[:, 0] = np.linspace(0, 8, 100)
+        assert (np.diff(bst.predict(pts)) >= -1e-9).all()
+
+
+def test_intermediate_small_tree_and_missing():
+    """intermediate mode through the forced-wave route (num_leaves < 32)
+    plus NaN rows."""
+    X, y = make_mono_problem(2000)
+    X[::17, 0] = np.nan
+    bst = lgb.train({
+        "objective": "regression", "num_leaves": 15, "min_data_in_leaf": 10,
+        "verbosity": -1, "monotone_constraints": [1, -1, 0],
+        "monotone_constraints_method": "intermediate",
+    }, lgb.Dataset(X, label=y), num_boost_round=10)
+    assert np.corrcoef(bst.predict(np.nan_to_num(X)), y)[0, 1] > 0.8
+
+
 def test_unconstrained_violates():
     """Sanity: without constraints the same data is NOT monotone everywhere
     (otherwise the test above proves nothing)."""
