@@ -111,6 +111,21 @@ class GBDT:
                 self._bundle = BundleArrays(train_set.bundle_layout,
                                             train_set.zero_bins,
                                             train_set.num_bins)
+        # 4-bit packing (reference DenseBin<..,IS_4BIT>, dense_bin.hpp:52):
+        # two bins per byte when every feature fits 4 bits — halves the
+        # binned matrix in HBM and the hist pass's dominant read stream.
+        # Pallas-path only; feature-parallel shards features, not bytes.
+        self._packed = False
+        self._host_matrix = train_set.train_matrix
+        method = default_hist_method(config.hist_method,
+                                     self._host_matrix.dtype)
+        if (self._bundle is None and method == "pallas"
+                and train_set.num_total_bin <= 16
+                and config.tree_learner != "feature"):
+            from ..ops.hist_pallas import pack4bit
+
+            self._packed = True
+            self._host_matrix = pack4bit(self._host_matrix)
         if getattr(train_set, "is_row_sharded", False):
             # process-sharded training data: the global device array is
             # assembled from per-process shards by the trainer
@@ -120,7 +135,7 @@ class GBDT:
                           "tree_learner=data")
             self.binned = None
         else:
-            self.binned = jnp.asarray(train_set.train_matrix)
+            self.binned = jnp.asarray(self._host_matrix)
         self.meta = make_feature_meta(train_set, config.monotone_constraints,
                                       config.feature_contri)
         rv = getattr(train_set, "row_valid", None)
@@ -209,7 +224,7 @@ class GBDT:
 
         self._grow, self._grow_binned, _ = build_trainer(
             self.config,
-            self.train_set.train_matrix,
+            self._host_matrix,
             self.meta,
             self.split_params,
             self.num_bins,
@@ -218,6 +233,7 @@ class GBDT:
             bundle_num_bins=(self.train_set.padded_bundle_bin
                              if self._bundle is not None else None),
             row_sharded=getattr(self.train_set, "is_row_sharded", False),
+            packed=self._packed,
         )
         if self.binned is None:
             self.binned = self._grow_binned
@@ -292,7 +308,7 @@ class GBDT:
                 for vb, vscore in zip(valid_binned, valid_scores):
                     pred = tree_predict_binned(
                         shrunk, vb, self.meta.nan_bin,
-                        self.meta.missing_type, self._bundle
+                        self.meta.missing_type, self._bundle, self._packed
                     )
                     new_valid.append(vscore.at[:, k].add(pred))
                 valid_scores = tuple(new_valid) if new_valid else valid_scores
@@ -441,6 +457,10 @@ class GBDT:
             # identity bundles: bundle bins == original bins
             vb = (valid_set.binned if valid_set.binned is not None
                   else valid_set.train_matrix)
+            if self._packed:
+                from ..ops.hist_pallas import pack4bit
+
+                vb = pack4bit(vb)
             self._valid_binned.append(jnp.asarray(vb))
         self._valid_scores.append(
             _ScoreUpdater(valid_set.num_data, self.num_class, init)
@@ -609,7 +629,7 @@ class GBDT:
         for vb, vs in zip(self._valid_binned, self._valid_scores):
             pred = tree_predict_binned(
                 shrunk, vb, self.meta.nan_bin, self.meta.missing_type,
-                self._bundle
+                self._bundle, self._packed
             )
             vs.add_pred(pred, k)
 
@@ -962,14 +982,14 @@ class DART(GBDT):
                     tree = tree._replace(leaf_value=tree.leaf_value + b)
                 pred = tree_predict_binned(
                     tree, self.binned, self.meta.nan_bin,
-                    self.meta.missing_type, self._bundle
+                    self.meta.missing_type, self._bundle, self._packed
                 )
                 self._train_scores.add_pred(-pred, k)
                 vpreds = []
                 for vb, vs in zip(self._valid_binned, self._valid_scores):
                     vp = tree_predict_binned(
                         tree, vb, self.meta.nan_bin,
-                        self.meta.missing_type, self._bundle
+                        self.meta.missing_type, self._bundle, self._packed
                     )
                     vs.add_pred(-vp, k)
                     vpreds.append(vp)

@@ -80,10 +80,12 @@ def empty_tree(max_leaves: int, cat_words: int = 1) -> TreeArrays:
 
 def tree_leaf_index_binned(
     tree: TreeArrays,
-    binned: jax.Array,        # (F, N) bins, or (BF, N) EFB bundle matrix
+    binned: jax.Array,        # (F, N) bins, (BF, N) EFB bundles, or
+                              # (ceil(F/2), N) 4-bit packed bytes
     nan_bins: jax.Array,      # (F,) int32
     missing_types: jax.Array,  # (F,) int32
     bundle=None,              # io/bundle.py BundleArrays when EFB applied
+    packed: bool = False,     # 4-bit packed bins (two features per byte)
 ) -> jax.Array:               # (N,) int32 leaf index per row
     N = binned.shape[1]
 
@@ -100,6 +102,10 @@ def tree_leaf_index_binned(
             from ..io.bundle import bundle_bins_of_rows
 
             b = bundle_bins_of_rows(binned, f, bundle)
+        elif packed:
+            from ..ops.hist_pallas import packed_bins_of_rows
+
+            b = packed_bins_of_rows(binned, f)
         else:
             b = jnp.take_along_axis(binned, f[None, :], axis=0)[0]
         t = tree.threshold_bin[nd]
@@ -124,9 +130,10 @@ def tree_leaf_index_binned(
     return -node - 1   # ~node
 
 
-def tree_predict_binned(tree, binned, nan_bins, missing_types, bundle=None):
+def tree_predict_binned(tree, binned, nan_bins, missing_types, bundle=None,
+                        packed: bool = False):
     leaf = tree_leaf_index_binned(tree, binned, nan_bins, missing_types,
-                                  bundle)
+                                  bundle, packed)
     return tree.leaf_value[leaf]
 
 

@@ -63,13 +63,19 @@ def _row_tile_for(m_pad: int, num_lanes: int, num_bins: int) -> int:
 
 
 def _kernel(iota_ref, bins_ref, g3_ref, leaf_ref, out_ref, *, lpad, num_bins,
-            fblk, precision, interpret):
+            fblk, precision, interpret, packed=False):
     """Grid: (feature_blocks, row_tiles); out revisited across row tiles.
 
     iota_ref: (1, FBLK*B) bf16         — precomputed ``lane // FBLK`` pattern
                                          (bin ids are < 256 => exact in bf16;
                                          v5e has no int8 vector compare)
-    bins_ref: (T, FBLK) uint8          — row-major bin tile
+    bins_ref: (T, FBLK) uint8          — row-major bin tile; with ``packed``
+                                         each byte holds TWO 4-bit bins
+                                         (lo nibble = feature 2p, hi = 2p+1 —
+                                         reference DenseBin<.., IS_4BIT=true>
+                                         src/io/dense_bin.hpp:52) and the
+                                         effective feature block is 2*FBLK
+                                         wide, ordered [lo nibbles | hi]
     g3_ref:   (3, T) f32               — grad / hess / count (pre-transposed)
     leaf_ref: (1, T) int32             — leaf id per row
     out_ref:  (1, 3*Lpad, FBLK*B) f32  — rows are (leaf-major, channel-minor)
@@ -124,7 +130,14 @@ def _kernel(iota_ref, bins_ref, g3_ref, leaf_ref, out_ref, *, lpad, num_bins,
     # ids over one chunk of bins is chunk-invariant, so it is hoisted.
     cb = max(1, min(B, 512 // fblk))         # bins per chunk
     n_chunks = -(-B // cb)
-    bins_f = bins_ref[...].astype(jnp.int32).astype(jnp.float32)
+    if packed:
+        # unpack two 4-bit bins per byte in VMEM: HBM traffic for the
+        # binned matrix halves (the hist pass's dominant stream)
+        bi = bins_ref[...].astype(jnp.int32)
+        bins_f = jnp.concatenate([bi & 15, bi >> 4], axis=1) \
+            .astype(jnp.float32)
+    else:
+        bins_f = bins_ref[...].astype(jnp.int32).astype(jnp.float32)
 
     for c in range(n_chunks):
         cb_c = min(cb, B - c * cb)
@@ -152,13 +165,42 @@ def _kernel(iota_ref, bins_ref, g3_ref, leaf_ref, out_ref, *, lpad, num_bins,
                 precision=lax.Precision.HIGHEST)
 
 
+def pack4bit(binned: np.ndarray) -> np.ndarray:
+    """(F, N) uint8 bins < 16 -> (ceil(F/2), N) packed bytes, two features
+    per byte (lo nibble = feature 2p, hi = 2p+1) — the analog of the
+    reference's 4-bit dense bins (DenseBin<VAL_T, IS_4BIT=true>,
+    src/io/dense_bin.hpp:52): halves the binned matrix's HBM footprint and
+    the hist pass's dominant memory stream at max_bin <= 15."""
+    binned = np.asarray(binned)
+    F, N = binned.shape
+    if F % 2:
+        binned = np.concatenate(
+            [binned, np.zeros((1, N), binned.dtype)], axis=0)
+    return (binned[0::2] | (binned[1::2] << 4)).astype(np.uint8)
+
+
+def packed_bins_of_feat(binned, feat):
+    """(ceil(F/2), N) packed bytes -> (N,) bins of ORIGINAL feature ``feat``
+    (traced scalar).  The single source of truth for the nibble layout
+    (lo nibble = feature 2p, hi = 2p+1) outside the kernel."""
+    byte = binned[feat >> 1].astype(jnp.int32)
+    return (byte >> (4 * (feat & 1))) & 15
+
+
+def packed_bins_of_rows(binned, f_row):
+    """Per-row feature variant: ``f_row`` (N,) -> (N,) original bins."""
+    byte = jnp.take_along_axis(
+        binned, (f_row >> 1)[None, :], axis=0)[0].astype(jnp.int32)
+    return (byte >> (4 * (f_row & 1))) & 15
+
+
 @functools.partial(
     jax.jit,
     static_argnames=("num_leaves", "num_bins", "precision", "row_tile",
-                     "interpret"),
+                     "interpret", "packed", "num_features"),
 )
 def hist_leaves_pallas(
-    binned: jax.Array,      # (F, N) uint8
+    binned: jax.Array,      # (F, N) uint8; packed: (ceil(F/2), N)
     g3: jax.Array,          # (N, 3) f32
     leaf_id: jax.Array,     # (N,) int32
     num_leaves: int,
@@ -166,17 +208,33 @@ def hist_leaves_pallas(
     precision: str = "int8",
     row_tile: int = 0,
     interpret: bool = False,
+    packed: bool = False,
+    num_features: int = 0,  # REAL feature count when packed (else derived)
 ) -> jax.Array:             # (L, F, B, 3) f32
-    F, N = binned.shape
     L, B = num_leaves, num_bins
     if binned.dtype not in (jnp.uint8, np.uint8):
         raise ValueError(
             "hist_leaves_pallas requires uint8 bins (num_bins <= 256); "
             "route int16-binned data to the onehot/scatter path")
+    if packed:
+        if B > 16:
+            raise ValueError("packed (4-bit) bins require num_bins <= 16")
+        Fp, N = binned.shape
+        F = num_features or 2 * Fp
+    else:
+        F, N = binned.shape
 
-    fblk = max(1, min(F, MAX_LANES // B))
-    nfb = -(-F // fblk)
-    f_pad = nfb * fblk
+    if packed:
+        # fblk counts UNPACKED features and must be even (each byte column
+        # contributes its lo and hi nibble feature)
+        fblk = max(2, min(2 * Fp, MAX_LANES // B) & ~1)
+        fpb = fblk // 2                      # packed byte columns per block
+        nfb = -(-Fp // fpb)
+        f_pad = nfb * fblk
+    else:
+        fblk = max(1, min(F, MAX_LANES // B))
+        nfb = -(-F // fblk)
+        f_pad = nfb * fblk
     lpad = -(-L // 8) * 8
     m_pad = 3 * lpad
     T = row_tile if row_tile > 0 else _row_tile_for(m_pad, fblk * B, B)
@@ -185,9 +243,15 @@ def hist_leaves_pallas(
 
     # row-major bins; padded features get bin 255 (matches no b < 256 when
     # B < 256; for B == 256 padded features land in bin 255 of a feature
-    # that is sliced away below). padded rows carry zero g3 => no effect.
-    binned_rm = jnp.pad(binned, ((0, f_pad - F), (0, n_pad - N)),
-                        constant_values=255).T           # (n_pad, f_pad)
+    # that is sliced away below; packed pad bytes are 0 -> phantom features
+    # collect bin 0 and are dropped by the permutation below). padded rows
+    # carry zero g3 => no effect.
+    tile_cols = fpb if packed else fblk      # stored byte columns per block
+    stored_pad = nfb * tile_cols
+    binned_rm = jnp.pad(
+        binned,
+        ((0, stored_pad - binned.shape[0]), (0, n_pad - N)),
+        constant_values=0 if packed else 255).T     # (n_pad, stored_pad)
     g3t = jnp.pad(g3.astype(jnp.float32), ((0, n_pad - N), (0, 0))).T  # (3, n_pad)
     leaf_p = jnp.pad(leaf_id.astype(jnp.int32), (0, n_pad - N),
                      constant_values=lpad)[None, :]      # (1, n_pad)
@@ -197,7 +261,7 @@ def hist_leaves_pallas(
 
     kernel = functools.partial(
         _kernel, lpad=lpad, num_bins=B, fblk=fblk, precision=precision,
-        interpret=interpret,
+        interpret=interpret, packed=packed,
     )
 
     def one_block(bins_block):
@@ -209,7 +273,7 @@ def hist_leaves_pallas(
             grid=(1, nrt),
             in_specs=[
                 pl.BlockSpec((1, fblk * B), lambda fb, rt: (0, 0)),
-                pl.BlockSpec((T, fblk), lambda fb, rt: (rt, 0)),
+                pl.BlockSpec((T, tile_cols), lambda fb, rt: (rt, 0)),
                 pl.BlockSpec((3, T), lambda fb, rt: (0, rt)),
                 pl.BlockSpec((1, T), lambda fb, rt: (0, rt)),
             ],
@@ -219,11 +283,22 @@ def hist_leaves_pallas(
             interpret=interpret,
         )(iota_bins, bins_block, g3t, leaf_p)
 
-    blocks = [one_block(binned_rm[:, fb * fblk:(fb + 1) * fblk])
+    blocks = [one_block(binned_rm[:, fb * tile_cols:(fb + 1) * tile_cols])
               for fb in range(nfb)]
     out = jnp.concatenate(blocks, axis=0) if nfb > 1 else blocks[0]
 
     # (nfb, 3*Lpad, B*fblk) -> (L, F, B, 3)
     h = out.reshape(nfb, lpad, 3, B, fblk)
     h = h.transpose(1, 0, 4, 3, 2).reshape(lpad, f_pad, B, 3)
+    if packed:
+        # per block the unpacked feature order is [lo nibbles | hi nibbles]
+        # = [2p0, 2p0+2, ... | 2p0+1, 2p0+3, ...]; invert it
+        perm = np.empty(f_pad, np.int64)
+        pos = 0
+        for fb in range(nfb):
+            ps = np.arange(fb * fpb, (fb + 1) * fpb)
+            perm[pos:pos + fblk] = np.concatenate([2 * ps, 2 * ps + 1])
+            pos += fblk
+        inv = np.argsort(perm)
+        h = h[:, inv]
     return h[:L, :F]

@@ -155,6 +155,8 @@ def hist_one_leaf(
     num_bins: int,
     method: str = "scatter",
     precision: str = "bf16x2",
+    packed: bool = False,
+    num_features: int = 0,
 ) -> jax.Array:             # (F, B, 3)
     """Histogram over the rows currently in ``target_leaf`` only — the
     smaller-child pass of the histogram-subtraction trick (reference:
@@ -163,13 +165,16 @@ def hist_one_leaf(
     mask = (leaf_id == target_leaf).astype(jnp.float32)
     g3m = g3 * mask[:, None]
     zeros = jnp.zeros_like(leaf_id)
-    if method == "onehot":
-        return hist_leaves_onehot(binned, g3m, zeros, 1, num_bins, precision)[0]
     if method == "pallas":
         from .hist_pallas import hist_leaves_pallas
 
         return hist_leaves_pallas(binned, g3m, zeros, 1, num_bins,
-                                  precision=precision)[0]
+                                  precision=precision, packed=packed,
+                                  num_features=num_features)[0]
+    if packed:
+        raise ValueError("4-bit packed bins require the pallas hist method")
+    if method == "onehot":
+        return hist_leaves_onehot(binned, g3m, zeros, 1, num_bins, precision)[0]
     return hist_leaves_scatter(binned, g3m, zeros, 1, num_bins)[0]
 
 
@@ -181,15 +186,20 @@ def hist_frontier(
     num_bins: int,
     method: str = "scatter",
     precision: str = "bf16x2",
+    packed: bool = False,
+    num_features: int = 0,
 ) -> jax.Array:
     """All-leaves histogram in a single pass (level-wise grower)."""
-    if method == "onehot":
-        return hist_leaves_onehot(binned, g3, leaf_id, num_leaves, num_bins, precision)
     if method == "pallas":
         from .hist_pallas import hist_leaves_pallas
 
         return hist_leaves_pallas(binned, g3, leaf_id, num_leaves, num_bins,
-                                  precision=precision)
+                                  precision=precision, packed=packed,
+                                  num_features=num_features)
+    if packed:
+        raise ValueError("4-bit packed bins require the pallas hist method")
+    if method == "onehot":
+        return hist_leaves_onehot(binned, g3, leaf_id, num_leaves, num_bins, precision)
     return hist_leaves_scatter(binned, g3, leaf_id, num_leaves, num_bins)
 
 
@@ -201,13 +211,16 @@ def hist_wave(
     num_bins: int,
     method: str = "scatter",
     precision: str = "bf16x2",
+    packed: bool = False,
+    num_features: int = 0,
 ) -> jax.Array:             # (nslots, F, B, 3)
     """Histograms of the rows labeled ``0..nslots-1`` in one pass; rows
     labeled ``nslots`` (not part of the current wave) contribute nothing.
     Used by the wave-batched leaf-wise grower (models/grower_wave.py): one
     sacrificial slot absorbs the dead rows, then is sliced away."""
     return hist_frontier(binned, g3, label, nslots + 1, num_bins,
-                         method=method, precision=precision)[:nslots]
+                         method=method, precision=precision,
+                         packed=packed, num_features=num_features)[:nslots]
 
 
 def default_hist_method(config_method: str = "auto",

@@ -181,6 +181,7 @@ def build_trainer(
     bundle=None,                     # io/bundle.py BundleArrays (EFB) or None
     bundle_num_bins: Optional[int] = None,   # padded bundle-space bin count
     row_sharded: bool = False,       # binned_np is THIS process's row shard
+    packed: bool = False,            # binned_np is 4-bit packed (2 feat/byte)
 ) -> Tuple[Callable, jax.Array, int]:
     """Return ``(grow_fn, binned_device, num_data)`` for the configured
     tree_learner.  ``grow_fn(binned_device, g3, base_mask, key)`` has the
@@ -217,15 +218,18 @@ def build_trainer(
 
     def local_hist(binned, g3, leaf_id, target):
         return hist_one_leaf(binned, g3, leaf_id, target, Bh,
-                             method=method, precision=precision)
+                             method=method, precision=precision,
+                             packed=packed, num_features=F)
 
     def local_frontier(binned, g3, leaf_id, L_level):
         return hist_frontier(binned, g3, leaf_id, L_level, Bh,
-                             method=method, precision=precision)
+                             method=method, precision=precision,
+                             packed=packed, num_features=F)
 
     def local_wave(binned, g3, label, nslots):
         return hist_wave(binned, g3, label, nslots, Bh,
-                         method=method, precision=precision)
+                         method=method, precision=precision,
+                         packed=packed, num_features=F)
 
     # EFB: split search + decisions speak ORIGINAL features; only the
     # histogram pass runs over bundle columns
@@ -251,6 +255,15 @@ def build_trainer(
 
         def bins_rows_fn(binned, f_row):
             return bundle_bins_of_rows(binned, f_row, bundle)
+    elif packed:
+        # 4-bit packed bins: decisions decode the nibble of their feature
+        # (reference DenseBin<.., IS_4BIT>::data access, dense_bin.hpp:425)
+        from ..ops.hist_pallas import (packed_bins_of_feat,
+                                       packed_bins_of_rows)
+
+        split_local = None
+        bins_feat_fn = packed_bins_of_feat
+        bins_rows_fn = packed_bins_of_rows
     else:
         split_local = None
         bins_feat_fn = None
@@ -382,8 +395,8 @@ def build_trainer(
 
         def hist_fn(binned, g3, leaf_id, target):
             # local histogram only — the reduce happens per-split in split_fn
-            return hist_one_leaf(binned, g3, leaf_id, target, B,
-                                 method=method, precision=precision)
+            # (local_hist handles 4-bit packed and bundle-space bins)
+            return local_hist(binned, g3, leaf_id, target)
 
         def sums_fn(g3):
             return lax.psum(g3.sum(axis=0), "data")
@@ -423,10 +436,11 @@ def build_trainer(
             # round — same PV-Tree semantics, one collective round-trip
             grow = make_wave_grower(hist_wave_fn=local_wave,
                                     split_fn=split_fn, sums_fn=sums_fn,
-                                    **wave_common)
+                                    bins_of_fn=bins_feat_fn, **wave_common)
         else:
             grow = make_leafwise_grower(
-                hist_fn=hist_fn, split_fn=split_fn, sums_fn=sums_fn, **common)
+                hist_fn=hist_fn, split_fn=split_fn, sums_fn=sums_fn,
+                bins_of_fn=bins_feat_fn, **common)
         sharded = shard_map(
             grow,
             mesh=mesh,

@@ -115,6 +115,24 @@ def test_pallas_matches_scatter(rng, precision):
                                    rtol=5e-2, atol=5e-1)
 
 
+@pytest.mark.parametrize("F", [6, 7])   # odd F exercises the phantom nibble
+def test_pallas_packed_4bit_matches_scatter(rng, F):
+    """4-bit packed bins (reference DenseBin<..,IS_4BIT>, dense_bin.hpp:52):
+    the packed kernel must reproduce the unpacked histograms exactly."""
+    from lightgbmv1_tpu.ops.hist_pallas import hist_leaves_pallas, pack4bit
+
+    N, B, L = 1234, 16, 5
+    binned, g3, leaf_id = make_inputs(rng, N=N, F=F, B=B, L=L)
+    g3 = g3.at[:, 2].set(1.0)
+    ref = np.asarray(hist_leaves_scatter(binned, g3, leaf_id, L, B))
+    packed = jnp.asarray(pack4bit(np.asarray(binned)))
+    got = np.asarray(hist_leaves_pallas(
+        packed, g3, leaf_id, L, B, precision="f32",
+        interpret=_PALLAS_INTERPRET, packed=True, num_features=F))
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-4)
+    np.testing.assert_array_equal(got[..., 2], ref[..., 2])
+
+
 def test_pallas_feature_padding_and_big_bins(rng):
     """F not a multiple of the feature block and B=256 (max uint8 bins)."""
     from lightgbmv1_tpu.ops.hist_pallas import hist_leaves_pallas
