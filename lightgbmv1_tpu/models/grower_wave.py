@@ -328,28 +328,29 @@ def make_wave_grower(
             # ---- decision + child labeling, one vectorized pass -----------
             # (the analog of K DataPartition::Split scatters); rows of leaf
             # ``leafs[j]`` go to slot 2j (left, keeps the leaf id) or 2j+1
-            # (right, becomes leaf ``nls[j]``); all other rows are dead (2K)
+            # (right, becomes leaf ``nls[j]``); all other rows are dead (2K).
+            # Batched over the wave: (K, N) intermediates stream once
+            # instead of K sequential read-modify-write passes over (N,)
+            # accumulators (each pass re-reads ~5 N-sized arrays).
             leaf_id = st.leaf_id
-            new_id = leaf_id
-            label = jnp.full(N, 2 * K, jnp.int32)
-            for j in range(K):
-                fj = feats[j]
-                bins_f = bins_of_fn(binned, fj)               # (N,) orig bins
-                is_na = (meta.missing_type[fj] == MISSING_NAN) & (
-                    bins_f == meta.nan_bin[fj])
-                gl = jnp.where(is_na, dls[j], bins_f <= thrs[j])
-                if use_cat:  # categorical bitset membership (bin-space)
-                    bi = bins_f.astype(jnp.int32)
-                    word = jnp.zeros(N, jnp.uint32)
-                    for wv in range(W):
-                        word = jnp.where((bi >> 5) == wv, bitsets[j, wv], word)
-                    in_set = ((word >> (bi.astype(jnp.uint32) & 31)) & 1) == 1
-                    gl = jnp.where(iscats[j], in_set, gl)
-                mine = valid[j] & (leaf_id == leafs[j])
-                new_id = jnp.where(mine & (~gl), nls[j], new_id)
-                label = jnp.where(mine, 2 * j + (~gl).astype(jnp.int32),
-                                  label)
-            leaf_id = new_id
+            bins_k = jax.vmap(lambda f: bins_of_fn(binned, f))(feats)  # (K,N)
+            bins_k = bins_k.astype(jnp.int32)
+            is_na = (meta.missing_type[feats][:, None] == MISSING_NAN) & (
+                bins_k == meta.nan_bin[feats][:, None])
+            gl = jnp.where(is_na, dls[:, None], bins_k <= thrs[:, None])
+            if use_cat:  # categorical bitset membership (bin-space)
+                word = jnp.zeros((K, N), jnp.uint32)
+                for wv in range(W):
+                    word = jnp.where((bins_k >> 5) == wv,
+                                     bitsets[:, wv][:, None], word)
+                in_set = ((word >> (bins_k.astype(jnp.uint32) & 31)) & 1) == 1
+                gl = jnp.where(iscats[:, None], in_set, gl)
+            mine = valid[:, None] & (leaf_id[None, :] == leafs[:, None])
+            go_r = mine & (~gl)                               # (K, N) disjoint
+            leaf_id = leaf_id + jnp.sum(
+                jnp.where(go_r, nls[:, None] - leaf_id[None, :], 0), axis=0)
+            slot = 2 * kiota[:, None] + (~gl).astype(jnp.int32)
+            label = jnp.sum(jnp.where(mine, slot - 2 * K, 0), axis=0) + 2 * K
 
             # ---- one batched histogram pass for all 2K children -----------
             hist = hist_wave_fn(binned, g3, label, 2 * K)     # (2K, F, B, 3)

@@ -277,7 +277,7 @@ def build_trainer(
                 or bool(config.cegb_penalty_feature_coupled))
     wave_size = config.leafwise_wave_size
     if wave_size == 0:   # auto: batched for big trees, sequential for small
-        wave_size = max(1, config.num_leaves // 16)
+        wave_size = max(1, (config.num_leaves + 7) // 8)
     # cap bounds the unrolled per-round decision loop's compile-time graph
     if wave_size > 64:
         log_warning(f"leafwise_wave_size={wave_size} capped to 64 (the "
