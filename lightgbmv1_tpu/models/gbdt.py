@@ -210,10 +210,21 @@ class GBDT:
         self._valid_metrics: List[List[Metric]] = []
         self._prev_state = None
         # CEGB model-level used-feature mask (reference
-        # is_feature_used_in_split_, persists across trees)
+        # is_feature_used_in_split_, persists across trees) and, for
+        # cegb_penalty_feature_lazy, the per-row feature marks (reference
+        # feature_used_in_data_ bitset) — both persist across iterations
+        self._cegb_lazy_active = (
+            bool(config.cegb_penalty_feature_lazy)
+            and config.tree_learner in ("serial", "")
+            and config.tree_growth != "levelwise")
         self._cegb_enabled = (config.cegb_penalty_split > 0
-                              or bool(config.cegb_penalty_feature_coupled))
+                              or bool(config.cegb_penalty_feature_coupled)
+                              or self._cegb_lazy_active)
         self._cegb_used = jnp.zeros(train_set.num_features, bool)
+        if self._cegb_lazy_active:
+            self._cegb_used = (
+                self._cegb_used,
+                jnp.zeros((self.num_data, train_set.num_features), bool))
         self._rng_key = jax.random.PRNGKey(config.seed)
         self._bag_mask: Optional[jax.Array] = None
         self._feat_rng = np.random.RandomState(config.feature_fraction_seed)
@@ -300,8 +311,8 @@ class GBDT:
                     binned, g3, feat_masks[k], key, cegb_used
                 )
                 if self._cegb_enabled:
-                    cegb_used = cegb_used | tree_used_features(
-                        tree_dev, cegb_used.shape[0])
+                    cegb_used = self._update_cegb_state(
+                        cegb_used, tree_dev, leaf_id)
                 shrunk = tree_dev._replace(leaf_value=tree_dev.leaf_value * rate)
                 train_score = train_score.at[:, k].add(shrunk.leaf_value[leaf_id])
                 new_valid = []
@@ -517,6 +528,22 @@ class GBDT:
             grad, hess = grad[:, None], hess[:, None]
         return grad, hess
 
+    def _update_cegb_state(self, state, tree_dev, leaf_id):
+        """Post-tree CEGB bookkeeping. ``state`` is the (F,) used-feature
+        mask, or ((F,), (N, F)) with the per-row lazy marks.  The marks
+        update is exact: a row 'used' precisely the features on its final
+        leaf's root path (the union over the tree of the reference's
+        per-split row marking, cost_effective_gradient_boosting.hpp:110)."""
+        if isinstance(state, tuple):
+            used, marks = state
+            used = used | tree_used_features(tree_dev, used.shape[0])
+            from .tree import leaf_path_features
+
+            pf = leaf_path_features(tree_dev, marks.shape[1])
+            marks = marks | pf[leaf_id]
+            return (used, marks)
+        return state | tree_used_features(tree_dev, state.shape[0])
+
     def _sample_g3(self, grad_k, hess_k, bag, iteration):
         """Assemble the (N, 3) [grad, hess, count] channels with bagging.
         Process-sharded datasets carry phantom pad rows (weight 0): they
@@ -572,8 +599,8 @@ class GBDT:
             tree_dev, leaf_id, root_sum = self._grow(
                 self._grow_binned, g3, base_mask, key, self._cegb_used)
             if self._cegb_enabled:
-                self._cegb_used = self._cegb_used | tree_used_features(
-                    tree_dev, self._cegb_used.shape[0])
+                self._cegb_used = self._update_cegb_state(
+                    self._cegb_used, tree_dev, leaf_id)
             new_trees.append(self._finish_tree(tree_dev, leaf_id, k))
         self.iter += 1
         stopped = False
@@ -928,8 +955,8 @@ class DART(GBDT):
             tree_dev, leaf_id, _ = self._grow(
                 self._grow_binned, g3, base_mask, key, self._cegb_used)
             if self._cegb_enabled:
-                self._cegb_used = self._cegb_used | tree_used_features(
-                    tree_dev, self._cegb_used.shape[0])
+                self._cegb_used = self._update_cegb_state(
+                    self._cegb_used, tree_dev, leaf_id)
             new_trees.append(
                 self._finish_tree(tree_dev, leaf_id, k, shrinkage=shrink_new)
             )
@@ -1089,8 +1116,8 @@ class RF(GBDT):
             tree_dev, leaf_id, _ = self._grow(
                 self._grow_binned, g3, base_mask, key, self._cegb_used)
             if self._cegb_enabled:
-                self._cegb_used = self._cegb_used | tree_used_features(
-                    tree_dev, self._cegb_used.shape[0])
+                self._cegb_used = self._update_cegb_state(
+                    self._cegb_used, tree_dev, leaf_id)
             new_trees.append(self._finish_tree(tree_dev, leaf_id, k, shrinkage=1.0))
         self.iter += 1
         if custom_grad is None and check_stop:

@@ -68,6 +68,9 @@ class GrowerState(NamedTuple):
     leaf_used: jax.Array      # (L, F) bool — branch features per leaf
                               # (reference Tree::branch_features)
     cegb_used: jax.Array      # (F,) bool — model-level used features (CEGB)
+    cegb_marks: jax.Array     # (N, F) bool — rows already charged for a
+                              # feature (cegb_penalty_feature_lazy;
+                              # (1, 1) dummy when lazy costs are off)
     order: jax.Array          # (N+CAPMAX,) int32 — rows grouped by leaf
                               # (reference DataPartition indices_; ghost
                               # entries hold N). dummy (1,) when masked mode
@@ -144,6 +147,7 @@ def make_leafwise_grower(
     interaction_groups=None,
     forced_splits=None,
     cegb_coupled=None,
+    cegb_lazy=None,
     partition: bool = False,
     hist_fn: Callable = None,
     split_fn: Callable = None,
@@ -194,13 +198,21 @@ def make_leafwise_grower(
         f_bin = jnp.asarray(forced_splits[:S_forced, 3], jnp.int32)
         f_dl = jnp.asarray(forced_splits[:S_forced, 4] != 0)
 
-    use_cegb = (params.cegb_penalty_split > 0) or (cegb_coupled is not None)
+    use_cegb = ((params.cegb_penalty_split > 0) or (cegb_coupled is not None)
+                or (cegb_lazy is not None))
     coupled = (jnp.asarray(cegb_coupled, jnp.float32)
                if cegb_coupled is not None else None)
+    lazy = (jnp.asarray(cegb_lazy, jnp.float32)
+            if cegb_lazy is not None else None)
+    if lazy is not None and partition:
+        raise ValueError("cegb_penalty_feature_lazy requires the masked "
+                         "leaf-wise grower (per-row leaf ids)")
 
-    def cegb_penalty_vec(parent_cnt, used_model):
+    def cegb_penalty_vec(parent_cnt, used_model, unmarked_cnt=None):
         """reference: CostEfficientGradientBoosting::DetlaGain —
-        tradeoff*(penalty_split*n_leaf + coupled_penalty[unused features])."""
+        tradeoff*(penalty_split*n_leaf + coupled_penalty[unused features]
+        + lazy_penalty[f]*#unmarked-rows-in-leaf
+        (CalculateOndemandCosts, cost_effective_gradient_boosting.hpp:125))."""
         if not use_cegb:
             return None
         pen = jnp.full(meta.num_bins.shape[0],
@@ -209,6 +221,8 @@ def make_leafwise_grower(
         if coupled is not None:
             pen = pen + params.cegb_tradeoff * coupled * (
                 ~used_model).astype(jnp.float32)
+        if lazy is not None and unmarked_cnt is not None:
+            pen = pen + params.cegb_tradeoff * lazy * unmarked_cnt
         return pen
 
     if split_fn is None:
@@ -257,8 +271,16 @@ def make_leafwise_grower(
         F = base_mask.shape[0]    # ORIGINAL features (binned may be the
                                   # narrower EFB bundle matrix)
         B = num_bins
+        marks_in = None
+        if isinstance(cegb_used, (tuple, list)):
+            cegb_used, marks_in = cegb_used
         if cegb_used is None:
             cegb_used = jnp.zeros(F, bool)
+        if lazy is not None:
+            marks0 = (marks_in if marks_in is not None
+                      else jnp.zeros((N, F), bool))
+        else:
+            marks0 = jnp.zeros((1, 1), bool)
 
         # ---- bucketed static capacities for the partition fast path -----
         if partition:
@@ -361,8 +383,10 @@ def make_leafwise_grower(
         out0 = leaf_output(root_sum[0], root_sum[1], params)
         if params.path_smooth > 0:
             out0 = smooth_output(out0, root_sum[2], 0.0, params)
+        unmk0 = ((~marks0).sum(axis=0).astype(jnp.float32)
+                 if lazy is not None else None)
         res0 = split_fn(hist0, root_sum, mask0, key, 0, no_constr, 0, out0,
-                        cegb_penalty_vec(root_sum[2], cegb_used))
+                        cegb_penalty_vec(root_sum[2], cegb_used, unmk0))
 
         from ..models.tree import empty_tree
 
@@ -385,6 +409,7 @@ def make_leafwise_grower(
             leaf_out=jnp.zeros(L, jnp.float32).at[0].set(out0),
             leaf_used=jnp.zeros((L, F), bool),
             cegb_used=cegb_used,
+            cegb_marks=marks0,
             order=order0,
             leaf_begin=leaf_begin0,
             leaf_phys=leaf_phys0,
@@ -519,12 +544,26 @@ def make_leafwise_grower(
                 ) & allow_child
                 cegb_next = st.cegb_used.at[feat].set(True) \
                     if use_cegb else st.cegb_used
+                if lazy is not None:
+                    # mark the split leaf's rows for the split feature
+                    # (UpdateLeafBestSplits, cegb hpp:110-121), THEN price
+                    # the children's candidates by their unmarked rows
+                    in_parent = st.leaf_id == leaf
+                    marks_next = st.cegb_marks | (
+                        in_parent[:, None]
+                        & jax.nn.one_hot(feat, F, dtype=bool))
+                    notm = (~marks_next).astype(jnp.float32)
+                    unmk_l = (leaf_id == leaf).astype(jnp.float32) @ notm
+                    unmk_r = (leaf_id == nl).astype(jnp.float32) @ notm
+                else:
+                    marks_next = st.cegb_marks
+                    unmk_l = unmk_r = None
                 res_l = split_fn(h_left, lsum, mask_l, key, 2 * s + 1,
                                  constr_l, d, out_l,
-                                 cegb_penalty_vec(lsum[2], cegb_next))
+                                 cegb_penalty_vec(lsum[2], cegb_next, unmk_l))
                 res_r = split_fn(h_right, rsum, mask_r, key, 2 * s + 2,
                                  constr_r, d, out_r,
-                                 cegb_penalty_vec(rsum[2], cegb_next))
+                                 cegb_penalty_vec(rsum[2], cegb_next, unmk_r))
                 gain_l = jnp.where(depth_ok, res_l.gain, -jnp.inf)
                 gain_r = jnp.where(depth_ok, res_r.gain, -jnp.inf)
 
@@ -583,6 +622,7 @@ def make_leafwise_grower(
                     leaf_used=st.leaf_used.at[leaf].set(used_child)
                     .at[nl].set(used_child),
                     cegb_used=cegb_next,
+                    cegb_marks=marks_next,
                     order=order2,
                     leaf_begin=st.leaf_begin.at[nl].set(
                         st.leaf_begin[leaf] + n_l_phys) if partition

@@ -130,6 +130,38 @@ def tree_leaf_index_binned(
     return -node - 1   # ~node
 
 
+def leaf_path_features(tree: TreeArrays, num_features: int) -> jax.Array:
+    """(L, F) bool — the features split on along each leaf's root path
+    (the reference's per-leaf branch features).  Used to mark rows for
+    cegb_penalty_feature_lazy: a row 'uses' exactly the features on its
+    leaf's path (cost_effective_gradient_boosting.hpp:110-121 marks the
+    split leaf's rows at every applied split — the union over the tree is
+    precisely the path features of each row's final leaf)."""
+    L1 = tree.left_child.shape[0]
+    L = tree.leaf_parent.shape[0]
+    nidx = jnp.arange(L1, dtype=jnp.int32)
+    par = jnp.full(L1, -1, jnp.int32)
+    par = par.at[jnp.where(tree.left_child >= 0, tree.left_child,
+                           L1 + 1)].set(nidx, mode="drop")
+    par = par.at[jnp.where(tree.right_child >= 0, tree.right_child,
+                           L1 + 1)].set(nidx, mode="drop")
+
+    def body(_, carry):
+        node, feats = carry
+        active = node >= 0
+        nd = jnp.maximum(node, 0)
+        f = tree.split_feature[nd]
+        feats = feats | (jax.nn.one_hot(f, num_features, dtype=bool)
+                         & active[:, None])
+        node = jnp.where(active, par[nd], -1)
+        return node, feats
+
+    node0 = tree.leaf_parent
+    feats0 = jnp.zeros((L, num_features), bool)
+    _, feats = lax.fori_loop(0, max(L1, 1), body, (node0, feats0))
+    return feats
+
+
 def tree_predict_binned(tree, binned, nan_bins, missing_types, bundle=None,
                         packed: bool = False):
     leaf = tree_leaf_index_binned(tree, binned, nan_bins, missing_types,

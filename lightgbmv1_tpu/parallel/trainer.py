@@ -111,14 +111,25 @@ def parse_interaction_constraints(spec, num_features: int):
     return np.stack(groups)
 
 
-def _warn_unimplemented(config: Config) -> None:
-    """Loudly reject accepted-but-unimplemented parameters instead of
-    silently ignoring them (the reference either enforces or rejects)."""
-    if config.cegb_penalty_feature_lazy:
-        log_warning(
-            "cegb_penalty_feature_lazy (per-row on-demand feature costs) is "
-            "not implemented — the parameter has NO effect; split and "
-            "coupled-feature CEGB penalties ARE enforced")
+def _cegb_lazy(config: Config, num_features: int, learner: str,
+               levelwise: bool):
+    """cegb_penalty_feature_lazy validated -> (F,) np array or None.
+    Implemented by the masked sequential leaf-wise grower (per-row marks);
+    other learners/growth orders warn and drop it, like the reference's
+    serial-learner-only CEGB."""
+    pen = config.cegb_penalty_feature_lazy
+    if not pen:
+        return None
+    if len(pen) != num_features:
+        log_fatal("cegb_penalty_feature_lazy should be the same size as "
+                  f"feature number ({len(pen)} vs {num_features})")
+    if learner not in ("serial", "") or levelwise:
+        log_warning("cegb_penalty_feature_lazy requires the serial "
+                    "leaf-wise learner; lazy feature costs are ignored for "
+                    f"tree_learner={learner or 'serial'}"
+                    + (", tree_growth=levelwise" if levelwise else ""))
+        return None
+    return np.asarray(pen, np.float64)
 
 
 def _cegb_coupled(config: Config, num_features: int):
@@ -274,7 +285,9 @@ def build_trainer(
     # on the features used by earlier splits of the same tree), and forced
     # splits occupy the first steps of the sequential order
     use_cegb = (config.cegb_tradeoff * config.cegb_penalty_split > 0
-                or bool(config.cegb_penalty_feature_coupled))
+                or bool(config.cegb_penalty_feature_coupled)
+                or bool(config.cegb_penalty_feature_lazy))
+    cegb_lazy = _cegb_lazy(config, F, learner, levelwise)
     wave_size = config.leafwise_wave_size
     if wave_size == 0:   # auto: batched for big trees, sequential for small
         wave_size = max(1, (config.num_leaves + 7) // 8)
@@ -310,7 +323,6 @@ def build_trainer(
                     + (", forced splits" if config.forcedsplits_filename
                        else "") + ")")
         mono_mode = "basic"
-    _warn_unimplemented(config)
 
     common = dict(
         num_leaves=config.num_leaves,
@@ -350,11 +362,14 @@ def build_trainer(
         else:
             # sequential best-first (the reference's exact split order):
             # DataPartition fast path by default; tree_growth=leafwise_masked
-            # keeps the O(N)-per-split variant
+            # keeps the O(N)-per-split variant; per-row lazy feature costs
+            # need the masked variant's leaf ids
             grow = make_leafwise_grower(
                 hist_fn=local_hist, forced_splits=forced,
                 split_fn=split_local, bins_of_fn=bins_feat_fn,
-                partition=(config.tree_growth != "leafwise_masked"),
+                cegb_lazy=cegb_lazy,
+                partition=(config.tree_growth != "leafwise_masked"
+                           and cegb_lazy is None),
                 **common)
         return jax.jit(grow), jnp.asarray(binned_np), N
 

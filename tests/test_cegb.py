@@ -78,3 +78,39 @@ def test_levelwise_cegb():
                     lgb.Dataset(X, label=y), num_boost_round=5)
     imp = bst.feature_importance()
     assert imp[0] > 0 and imp[3:].sum() == 0
+
+
+def test_lazy_penalty_avoids_expensive_features():
+    """cegb_penalty_feature_lazy (reference CalculateOndemandCosts,
+    cost_effective_gradient_boosting.hpp:125-149): per-ROW on-demand costs —
+    a feature's candidate splits are penalized by the number of rows in the
+    leaf that have not yet passed through a split on that feature."""
+    X, y = make_problem()
+    # huge lazy cost on every informative feature except f0
+    pen = [0.0, 80.0, 80.0, 80.0, 80.0, 80.0]
+    bst = lgb.train({**BASE, "cegb_penalty_feature_lazy": pen},
+                    lgb.Dataset(X, label=y), num_boost_round=5)
+    imp = bst.feature_importance()
+    assert imp[0] > 0
+    assert imp[3:].sum() == 0      # noise features never worth the row cost
+
+
+def test_lazy_penalty_marked_rows_become_free():
+    """Rows already charged for a feature are free afterwards (the per-row
+    bitset persists across trees): with a cost that blocks nothing at the
+    root, later trees keep using the feature without paying again."""
+    X, y = make_problem(n=1500)
+    pen = [0.001] * 6
+    with_lazy = lgb.train({**BASE, "cegb_penalty_feature_lazy": pen},
+                          lgb.Dataset(X, label=y), num_boost_round=6)
+    without = lgb.train(BASE, lgb.Dataset(X, label=y), num_boost_round=6)
+    # tiny cost: the model must be essentially unchanged
+    np.testing.assert_allclose(with_lazy.predict(X), without.predict(X),
+                               rtol=1e-2, atol=1e-2)
+
+
+def test_lazy_penalty_wrong_size_fatal():
+    X, y = make_problem()
+    with pytest.raises(lgb.LightGBMError):
+        lgb.train({**BASE, "cegb_penalty_feature_lazy": [1.0]},
+                  lgb.Dataset(X, label=y), num_boost_round=2)
