@@ -18,11 +18,15 @@ bins, leaves) sized to this chip and reports:
     auc_ref     = reference LightGBM (C++, leaf-wise) AUC on the SAME data
                   and config, recorded from a run of the reference binary
 
-See PERF.md for measured ceilings of the benchmarked device — the tunneled
-single TPU chip in this environment sustains ~1.9 TF/s matmul and ~8.6 GB/s
-HBM (about 1% of a physical v5e), which bounds any implementation far below
-the 2x-Xeon baseline; vs_baseline on this device is therefore a relative
-engineering metric, not a statement about TPU silicon.
+See PERF.md for measured ceilings of the benchmarked device.  The chip is
+reached through a network tunnel with ~113 ms round-trip dispatch latency,
+so everything is measured with multi-iteration scanned steps (one dispatch
+per timed block); compute-wise the tunneled chip profiles near physical
+v5e rates once dispatch is amortized (tools/microbench_hist.py measures
+the device matmul peak used for the roofline fraction below).
+vs_baseline compares against the 2x-Xeon HIGGS number from
+docs/Experiments.rst; vs_ref_same_host against the reference C++ binary
+run on THIS host — the like-for-like comparison.
 
 Prints exactly one JSON line.
 """
@@ -42,6 +46,82 @@ def make_data(n, seed):
              + 0.4 * X[:, 4] + 0.3 * np.sin(3.0 * X[:, 5]))
     y = (logit + rng.randn(n).astype(np.float32) > 0).astype(np.float64)
     return X, y
+
+
+def measure_hist_and_roofline(ds, N):
+    """Measured feature-histogram pass time + roofline fraction — the
+    BASELINE.json tracked metric ("feature-histogram build ms/iter") and
+    the evidence behind PERF.md's kernel-quality claim.  Methodology of
+    docs/GPU-Performance.rst:108-124 (time the device histogram kernel on
+    the benchmark config), plus a same-session matmul peak measurement so
+    the roofline fraction compares against THIS device's real ceiling.
+    Every number is from R reps inside one jit scan (one dispatch), with
+    per-rep input perturbation to defeat CSE."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from lightgbmv1_tpu.ops.histogram import hist_wave
+
+    SLOTS = 64            # the wave grower's 2K child slots at num_leaves=255
+    B = 64                # padded bin axis for max_bin=63
+    R = 10
+    binned = ds.device_binned()
+    F = binned.shape[0]
+    rng = np.random.RandomState(7)
+    g3 = jnp.asarray(rng.randn(N, 3).astype(np.float32))
+    label = jnp.asarray(rng.randint(0, SLOTS, size=N).astype(np.int32))
+
+    @jax.jit
+    def hist_reps(binned, g3, label):
+        def body(c, i):
+            g = g3 * (1.0 + 1e-6 * i.astype(jnp.float32))   # defeat CSE
+            h = hist_wave(binned, g, label, SLOTS, B)
+            return c + h.sum(), None
+        s, _ = lax.scan(body, jnp.float32(0), jnp.arange(R))
+        return s
+
+    jax.device_get(hist_reps(binned, g3, label))            # compile
+    best = 1e30
+    for _ in range(3):
+        t0 = time.time()
+        jax.device_get(hist_reps(binned, g3, label))
+        best = min(best, (time.time() - t0) / R)
+    hist_ms = best * 1e3
+    # one-hot MXU formulation: (3*(SLOTS+1), rows) @ (rows, B*F) per pass,
+    # bf16x2 = 2 passes (ops/hist_pallas.py)
+    hist_flops = 2 * 3 * (SLOTS + 1) * N * B * F * 2
+    hist_tfs = hist_flops / best / 1e12
+
+    # device matmul peak, same session, same measurement discipline
+    M = 4096
+    a = jnp.asarray(rng.randn(M, M).astype(np.float32), jnp.bfloat16)
+    b = jnp.asarray(rng.randn(M, M).astype(np.float32), jnp.bfloat16)
+
+    @jax.jit
+    def mm_reps(a, b):
+        def body(c, i):
+            out = jnp.dot(a * (1 + 1e-3 * i.astype(jnp.bfloat16)), b,
+                          preferred_element_type=jnp.float32)
+            return c + out.sum(), None
+        s, _ = lax.scan(body, jnp.float32(0), jnp.arange(R))
+        return s
+
+    jax.device_get(mm_reps(a, b))
+    mm_best = 1e30
+    for _ in range(3):
+        t0 = time.time()
+        jax.device_get(mm_reps(a, b))
+        mm_best = min(mm_best, (time.time() - t0) / R)
+    peak_tfs = (2 * M ** 3) / mm_best / 1e12
+    return {
+        "hist_ms_per_pass": round(hist_ms, 2),
+        # a 255-leaf wave tree runs ceil(254/32) = 8 wave rounds per iter
+        "hist_ms_per_iter": round(hist_ms * 8, 2),
+        "hist_achieved_tf_s": round(hist_tfs, 2),
+        "device_matmul_peak_tf_s": round(peak_tfs, 2),
+        "hist_roofline_frac": round(hist_tfs / peak_tfs, 4),
+    }
 
 
 def main():
@@ -134,10 +214,76 @@ def main():
         if name == "auc":
             auc = float(value)
     # reference LightGBM (C++ CLI built from /root/reference, run on THIS
-    # host, leaf-wise, same synthetic data/config, 100 iters): valid AUC and
-    # throughput measured 2026-07-30, recorded in PERF.md
+    # host, leaf-wise, same synthetic data/config): valid AUC and throughput
+    # re-measured 2026-07-30 (round 4; machine idle, metric_freq=500 so the
+    # timing is training-only like ours): 100 iters in 25.57 s, 500 iters in
+    # 93.23 s train wall-clock.  Round 3's recorded 2.360 M row-trees/s is
+    # superseded — the host was evidently contended then.
     auc_ref = 0.913227          # reference valid_1 auc at iteration 100
-    ref_same_host_mrt = 2.360   # reference M row-trees/s on this host's CPU
+    ref_same_host_mrt = 3.911   # reference M row-trees/s, first 100 iters
+    ref_500_wall_s = 93.23      # reference 500-iter training wall-clock
+    ref_500_auc = 0.912632      # reference valid_1 auc at iteration 500
+
+    extra = {}
+    if backend != "cpu" and os.environ.get("BENCH_FULL", "1") == "1":
+        try:
+            extra.update(measure_hist_and_roofline(ds, N))
+        except Exception as e:  # noqa: BLE001 — partial records beat none
+            extra["hist_error"] = f"{type(e).__name__}: {e}"[:200]
+
+        # DART per-iteration cost (fused single-dispatch iteration):
+        # VERDICT r3 #7 asks this within ~2x of the scanned GBDT path
+        try:
+            cfg_dart = Config.from_dict({
+                "objective": "binary", "boosting": "dart", "num_leaves": 255,
+                "max_bin": 63, "learning_rate": 0.1, "min_data_in_leaf": 20,
+                "drop_rate": 0.1, "verbosity": -1,
+                "tree_growth": "leafwise"})
+            gbd = create_boosting(cfg_dart, ds)
+            for _ in range(3):                       # warm both jit variants
+                gbd.train_one_iter(check_stop=False)
+            sync_d = lambda: jax.device_get(gbd._train_scores.score)
+            sync_d()
+            DIT = 12
+            t0 = time.time()
+            for _ in range(DIT):
+                gbd.train_one_iter(check_stop=False)
+            sync_d()
+            dart_dt = time.time() - t0
+            dart_mrt = N * DIT / dart_dt / 1e6
+            extra["dart_M_row_trees_per_s"] = round(dart_mrt, 3)
+            extra["dart_frac_of_scanned_gbdt"] = round(
+                dart_mrt / max(row_trees_per_s, 1e-9), 3)
+        except Exception as e:  # noqa: BLE001
+            extra["dart_error"] = f"{type(e).__name__}: {e}"[:200]
+
+        # 500-tree north star (docs/Experiments.rst:110-135 methodology on
+        # this host's data): reference side measured with the same binary
+        # the goldens use; our side timed over trees 100..500 (the first
+        # 100 run under compile) and scaled to 500
+        try:
+            gb5 = create_boosting(cfg_lw, ds)
+            gb5.add_valid(dt_test, "test")
+            gb5.train_iters(100)
+            jax.device_get(gb5._train_scores.score)
+            t0 = time.time()
+            for _ in range(4):
+                gb5.train_iters(100)
+            jax.device_get(gb5._train_scores.score)
+            wall400 = time.time() - t0
+            wall500 = wall400 * 500.0 / 400.0
+            auc500 = None
+            for (_, name, value, _) in gb5.eval_valid():
+                if name == "auc":
+                    auc500 = float(value)
+            extra["tpu_500iter_wall_s"] = round(wall500, 2)
+            extra["tpu_500iter_auc"] = (round(auc500, 6)
+                                        if auc500 is not None else None)
+            extra["ref_cpp_500iter_wall_s"] = ref_500_wall_s
+            extra["ref_cpp_500iter_auc"] = ref_500_auc
+            extra["vs_ref_500iter"] = round(ref_500_wall_s / wall500, 4)
+        except Exception as e:  # noqa: BLE001
+            extra["northstar_error"] = f"{type(e).__name__}: {e}"[:200]
 
     baseline = 10.5e6 * 500 / 130.094 / 1e6   # reference CPU HIGGS throughput
     print(json.dumps({
@@ -163,6 +309,7 @@ def main():
         "leafwise_auc_iters": int(gb_lw.iter),
         "leafwise_vs_ref_same_host": round(leafwise_mrt / ref_same_host_mrt,
                                            4),
+        **extra,
     }))
 
 

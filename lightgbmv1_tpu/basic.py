@@ -26,6 +26,7 @@ from .io.parser import load_data_file
 from .metrics import create_metrics
 from .models.gbdt import GBDT, create_boosting
 from .models.tree import HostTree
+from .utils import fileio
 from .utils.log import LightGBMError, log_fatal, log_info, log_warning
 
 
@@ -367,7 +368,7 @@ class Booster:
                 if isinstance(init_model, Booster):
                     self._loaded = model_from_string(init_model.model_to_string())
                 else:
-                    with open(init_model) as fh:
+                    with fileio.open_file(init_model) as fh:
                         self._loaded = model_from_string(fh.read())
                 if self._loaded.average_output:
                     log_fatal("Continued training from an RF (average_output)"
@@ -383,7 +384,7 @@ class Booster:
             self._gbdt = create_boosting(self.config, train_set._binned,
                                          init_raw_scores=init_raw)
         elif model_file is not None:
-            with open(model_file) as fh:
+            with fileio.open_file(model_file) as fh:
                 self._init_from_string(fh.read())
         elif model_str is not None:
             self._init_from_string(model_str)
@@ -564,6 +565,20 @@ class Booster:
                 X = X[:, 1:]
         else:
             X = _to_2d_numpy(data)
+        if X.shape[1] != self.num_feature():
+            # reference predictor.hpp:170-174 / c_api predict shape guard
+            disable = bool(kwargs.get(
+                "predict_disable_shape_check",
+                self.params.get("predict_disable_shape_check", False)))
+            if not disable:
+                from .utils.log import log_fatal
+
+                log_fatal(
+                    f"The number of features in data ({X.shape[1]}) is not "
+                    f"the same as it was in training data "
+                    f"({self.num_feature()}).\nYou can set "
+                    f"``predict_disable_shape_check=true`` to discard this "
+                    f"error, but please be aware what you are doing.")
         trees = self._all_trees()
         K = self.num_model_per_iteration()
         if num_iteration is None or num_iteration < 0:
@@ -755,11 +770,16 @@ class Booster:
             feature_infos=feature_infos,
             average_output=average_output,
             parameters=params,
+            # reference: saved_feature_importance_type selects split counts
+            # (0) or total gains (1) in the model's importance block
+            # (application.cpp:204, gbdt.cpp:779-800)
+            importance_type=(self.config.saved_feature_importance_type
+                             if self._gbdt is not None else 0),
         )
 
     def save_model(self, filename, num_iteration: Optional[int] = None,
                    start_iteration: int = 0) -> "Booster":
-        with open(filename, "w") as fh:
+        with fileio.open_file(filename, "w") as fh:
             fh.write(self.model_to_string(num_iteration, start_iteration))
         return self
 

@@ -131,26 +131,41 @@ def run_train(config: Config) -> Booster:
 
     n_iter = max(config.num_iterations - done_iters, 0)
     t0 = time.time()
-    for i in range(n_iter):
-        finished = booster.update()
-        if config.metric_freq > 0 and (i + 1) % config.metric_freq == 0:
-            # reference: OutputMetric prints the training metric only under
-            # is_provide_training_metric (gbdt.cpp:413-434)
-            if config.is_provide_training_metric:
-                for data_name, metric, value, _ in booster.eval_train():
-                    log_info(f"Iteration:{i + 1}, {data_name} {metric} : {value:g}")
-            for data_name, metric, value, _ in booster.eval_valid():
-                log_info(f"Iteration:{i + 1}, {data_name} {metric} : {value:g}")
-        log_info(f"{time.time() - t0:.6f} seconds elapsed, "
-                 f"finished iteration {i + 1}")
-        # snapshots (reference: GBDT::Train, gbdt.cpp:258-262)
-        total_i = done_iters + i + 1
-        if config.snapshot_freq > 0 and total_i % config.snapshot_freq == 0:
-            snap = f"{config.output_model}.snapshot_iter_{total_i}"
-            booster.save_model(snap)
-            log_info(f"Saved snapshot to {snap}")
-        if finished:
-            break
+    profiling = False
+    if config.profile_dir:
+        import jax
+
+        jax.profiler.start_trace(config.profile_dir)
+        profiling = True
+    try:
+        for i in range(n_iter):
+            finished = booster.update()
+            if config.metric_freq > 0 and (i + 1) % config.metric_freq == 0:
+                # reference: OutputMetric prints the training metric only
+                # under is_provide_training_metric (gbdt.cpp:413-434)
+                if config.is_provide_training_metric:
+                    for data_name, metric, value, _ in booster.eval_train():
+                        log_info(f"Iteration:{i + 1}, {data_name} {metric} "
+                                 f": {value:g}")
+                for data_name, metric, value, _ in booster.eval_valid():
+                    log_info(f"Iteration:{i + 1}, {data_name} {metric} "
+                             f": {value:g}")
+            log_info(f"{time.time() - t0:.6f} seconds elapsed, "
+                     f"finished iteration {i + 1}")
+            # snapshots (reference: GBDT::Train, gbdt.cpp:258-262)
+            total_i = done_iters + i + 1
+            if config.snapshot_freq > 0 and total_i % config.snapshot_freq == 0:
+                snap = f"{config.output_model}.snapshot_iter_{total_i}"
+                booster.save_model(snap)
+                log_info(f"Saved snapshot to {snap}")
+            if finished:
+                break
+    finally:
+        if profiling:
+            import jax
+
+            jax.profiler.stop_trace()
+            log_info(f"Wrote device trace to {config.profile_dir}")
     if config.output_model:
         booster.save_model(config.output_model)
     log_info("Finished training")
@@ -184,11 +199,13 @@ def run_predict(config: Config) -> None:
         raw_score=config.predict_raw_score,
         pred_leaf=config.predict_leaf_index,
         pred_contrib=config.predict_contrib,
+        start_iteration=config.start_iteration_predict,
         num_iteration=(config.num_iteration_predict
                        if config.num_iteration_predict > 0 else None),
         pred_early_stop=config.pred_early_stop,
         pred_early_stop_freq=config.pred_early_stop_freq,
         pred_early_stop_margin=config.pred_early_stop_margin,
+        predict_disable_shape_check=config.predict_disable_shape_check,
     )
     out = np.asarray(out)
     if out.ndim == 1:
@@ -226,7 +243,9 @@ def run_convert_model(config: Config) -> None:
     booster = Booster(model_file=config.input_model)
     code = model_to_cpp(booster._loaded)
     out = config.convert_model or "gbdt_prediction.cpp"
-    with open(out, "w") as fh:
+    from .utils import fileio
+
+    with fileio.open_file(out, "w") as fh:
         fh.write(code)
     log_info(f"Converted model to C++ code at {out}")
 

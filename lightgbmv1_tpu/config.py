@@ -223,6 +223,8 @@ class Config:
     """Parameters. Names/defaults mirror reference ``include/LightGBM/config.h``."""
 
     # -- core ---------------------------------------------------------------
+    config: str = ""   # config-file path; consumed by from_cli before
+                       # parameter resolution (reference application.cpp:49-82)
     task: str = "train"
     objective: str = "regression"
     boosting: str = "gbdt"
@@ -287,6 +289,19 @@ class Config:
                                         # config.h:517)
     verbosity: int = 1
 
+    # reference config.h:134-160: force col-wise / row-wise histogram
+    # building.  Mapped onto hist_method in __post_init__ (the TPU analogs:
+    # col-wise CPU gather == "scatter", row-wise multi-val == the Pallas
+    # row-tile kernel / "onehot" MXU path).
+    force_col_wise: bool = False
+    force_row_wise: bool = False
+    # reference config.h:548 histogram_pool_size (MB): caps the sequential
+    # grower's per-leaf histogram cache (models/grower.py).  <0 = auto:
+    # pooled up to 512 MB of HBM, then pool-free growth (both children
+    # rebuilt per split).  The reference's unlimited-cache behavior =
+    # any explicit value large enough for num_leaves histograms.
+    histogram_pool_size: float = -1.0
+
     # -- TPU-specific (new; no reference equivalent) ------------------------
     tree_growth: str = "leafwise"  # leafwise (best-first policy, wave-batched
                                    # schedule) | leafwise_serial (one split
@@ -302,12 +317,23 @@ class Config:
     hist_method: str = "auto"      # auto | scatter | onehot | pallas
     hist_dtype: str = "bf16x2"     # bf16 | bf16x2 | f32 | int8 (quantized) precision
     num_shards: int = 0            # devices for data-parallel (0 = all available)
+    profile_dir: str = ""          # write a jax.profiler device trace of
+                                   # training here; hist/split/partition
+                                   # phases carry lgbm.* named scopes (the
+                                   # USE_TIMETAG analog, utils/common.h)
 
     # -- IO -----------------------------------------------------------------
     max_bin: int = 255
     max_bin_by_feature: List[int] = field(default_factory=list)
     min_data_in_bin: int = 3
     bin_construct_sample_cnt: int = 200000
+    # reference config.h:592: pre-filter features that cannot satisfy
+    # min_data_in_leaf on any split (BinMapper marks them trivial)
+    feature_pre_filter: bool = True
+    # reference config.h:620 is_enable_sparse: SparseBin storage toggle.
+    # EXPLICIT no-op here: there is no sparse bin storage to toggle — wide
+    # sparse inputs are handled by EFB bundles + from_csr (io/bundle.py)
+    is_enable_sparse: bool = True
     data_random_seed: int = 1
     output_model: str = "LightGBM_model.txt"
     snapshot_freq: int = -1
@@ -333,7 +359,12 @@ class Config:
     predict_raw_score: bool = False
     predict_leaf_index: bool = False
     predict_contrib: bool = False
+    start_iteration_predict: int = 0
     num_iteration_predict: int = -1
+    predict_disable_shape_check: bool = False
+    # reference config.h:886: importance type written into the model file
+    # (0 = split counts, 1 = total gains)
+    saved_feature_importance_type: int = 0
     pred_early_stop: bool = False
     pred_early_stop_freq: int = 10
     pred_early_stop_margin: float = 10.0
@@ -354,6 +385,9 @@ class Config:
     lambdarank_truncation_level: int = 20
     lambdarank_norm: bool = True
     label_gain: List[float] = field(default_factory=list)
+    # reference config.h:797 (rank_xendcg sampling seed; config.cpp:198-201
+    # re-draws it from `seed` unless set explicitly)
+    objective_seed: int = 5
 
     # -- metric -------------------------------------------------------------
     metric: List[str] = field(default_factory=list)
@@ -371,6 +405,16 @@ class Config:
     time_out: int = 120
     machine_list_filename: str = ""
 
+    # -- GPU (reference config.h:976-1005) ----------------------------------
+    # gpu_platform_id / gpu_device_id select an OpenCL device; EXPLICIT
+    # no-ops here — device selection is JAX's (jax.devices()/JAX_PLATFORMS).
+    gpu_platform_id: int = -1
+    gpu_device_id: int = -1
+    # gpu_use_dp = double-precision GPU histograms; mapped onto
+    # hist_dtype="f32" in __post_init__ (f32 is this framework's highest
+    # histogram precision; fp64 is not MXU-native)
+    gpu_use_dp: bool = False
+
     # ------------------------------------------------------------------
     def __post_init__(self):
         from .utils.log import set_verbosity
@@ -379,6 +423,23 @@ class Config:
         self.objective = canonical_objective(self.objective)
         if self.objective in ("multiclass", "multiclassova") and self.num_class <= 1:
             raise ValueError("num_class must be >1 for multiclass objectives")
+        if self.force_col_wise and self.force_row_wise:
+            # reference config.cpp CheckParamConflict fatals on both
+            raise ValueError(
+                "Cannot set both force_col_wise and force_row_wise")
+        if self.hist_method == "auto":
+            # reference force_*_wise picks the histogram build strategy
+            # (dataset.cpp:590-684 auto-benchmark override); TPU analogs:
+            # col-wise per-feature gather = "scatter", row-wise multi-feature
+            # tiles = the "onehot" MXU path
+            if self.force_col_wise:
+                self.hist_method = "scatter"
+            elif self.force_row_wise:
+                self.hist_method = "onehot"
+        if self.gpu_use_dp and self.hist_dtype in ("bf16", "bf16x2", "int8"):
+            # gpu_use_dp = highest-precision device histograms
+            # (reference gpu_tree_learner.h:79 hist_t selection)
+            self.hist_dtype = "f32"
 
     # ------------------------------------------------------------------
     @property
@@ -450,7 +511,9 @@ class Config:
         config_file = kv.get("config", kv.get("config_file", ""))
         file_kv: Dict[str, str] = {}
         if config_file:
-            with open(config_file) as fh:
+            from .utils.fileio import open_file
+
+            with open_file(config_file) as fh:
                 file_kv = cls.kv2map(fh.read().splitlines())
         # CLI args override config-file values (reference: application.cpp:49-82)
         file_kv.update(kv)

@@ -12,9 +12,11 @@ Differences from the reference, by design (SURVEY.md §7 "Hard parts"):
   ``FixHistogram`` (dataset.cpp:1410).  On TPU the histogram for every bin is
   free (dense MXU matmul), so we store every bin explicitly and never need
   FixHistogram.  This also removes the per-group ``bin_offsets`` bookkeeping.
-* **No exclusive feature bundling (EFB).**  EFB (dataset.cpp:97-235) is a
-  sparsity compression; the TPU layout is a dense ``(num_features, num_data)``
-  integer matrix, so bundling would only complicate addressing.
+* **Exclusive feature bundling (EFB) lives one layer up.**  The binned
+  layout is a dense ``(num_features, num_data)`` integer matrix; when EFB is
+  enabled (``enable_bundle``), ``io/bundle.py`` merges mutually-exclusive
+  sparse features into shared columns of that matrix AFTER binning
+  (reference: dataset.cpp:97-235), so this module stays bundling-agnostic.
 
 Semantics preserved: greedy equal-count bin boundary search on a sample,
 zero-straddling bins, missing handling (None/Zero/NaN with a trailing NaN
@@ -40,6 +42,39 @@ BIN_NUMERICAL = 0
 BIN_CATEGORICAL = 1
 
 
+def _need_filter(cnt_in_bin: np.ndarray, total_cnt: int, filter_cnt: int,
+                 bin_type: int) -> bool:
+    """feature_pre_filter test (behavioral port of NeedFilter, reference
+    src/io/bin.cpp:54-76): True when NO split point of this feature can put
+    >= filter_cnt samples on both sides — such a feature can never satisfy
+    min_data_in_leaf and is marked trivial up front."""
+    cnt = np.asarray(cnt_in_bin, dtype=np.int64)
+    if len(cnt) < 2:
+        return True
+    if bin_type == BIN_NUMERICAL:
+        left = np.cumsum(cnt[:-1])
+        return not bool(np.any((left >= filter_cnt)
+                               & (total_cnt - left >= filter_cnt)))
+    # categorical: the reference only filters 2-bin features (one-vs-rest
+    # splits on >2 bins are not prefix sums, bin.cpp:63-73)
+    if len(cnt) > 2:
+        return False
+    left = cnt[:-1]
+    return not bool(np.any((left >= filter_cnt)
+                           & (total_cnt - left >= filter_cnt)))
+
+
+def _upper_bound_1ulp(a: float) -> float:
+    """Common::GetDoubleUpperBound (reference utils/common.h:931)."""
+    return float(np.nextafter(a, np.inf))
+
+
+def _eq_ordered(a: float, b: float) -> bool:
+    """Common::CheckDoubleEqualOrdered for sorted a <= b
+    (reference utils/common.h:926): b within one ulp above a."""
+    return b <= np.nextafter(a, np.inf)
+
+
 def _greedy_find_bin(
     distinct_values: np.ndarray,
     counts: np.ndarray,
@@ -47,93 +82,156 @@ def _greedy_find_bin(
     total_cnt: int,
     min_data_in_bin: int,
 ) -> List[float]:
-    """Greedy equal-count boundary search (behavioral port of GreedyFindBin,
-    reference src/io/bin.cpp:78-254). Returns list of bin upper bounds, the
-    last being +inf."""
+    """Greedy equal-count boundary search — exact behavioral port of
+    GreedyFindBin (reference src/io/bin.cpp:78-156), including the
+    adaptive mean-bin-size recomputation, the big-count-value lookahead,
+    and the one-ulp boundary dedupe, so bin boundaries agree with the
+    reference bit-for-bit on the same sample."""
     bounds: List[float] = []
-    num_distinct = len(distinct_values)
-    if num_distinct == 0:
+    nd = len(distinct_values)
+    if nd == 0:
         return [math.inf]
-    if num_distinct <= max_bin:
-        # each distinct value its own bin, merging tiny bins forward
-        acc = 0
-        for i in range(num_distinct - 1):
-            acc += int(counts[i])
-            if acc >= min_data_in_bin:
-                bounds.append((distinct_values[i] + distinct_values[i + 1]) / 2.0)
-                acc = 0
+    if nd <= max_bin:
+        cur = 0
+        for i in range(nd - 1):
+            cur += int(counts[i])
+            if cur >= min_data_in_bin:
+                val = _upper_bound_1ulp(
+                    (distinct_values[i] + distinct_values[i + 1]) / 2.0)
+                if not bounds or not _eq_ordered(bounds[-1], val):
+                    bounds.append(val)
+                    cur = 0
         bounds.append(math.inf)
         return bounds
-    # more distinct values than bins: aim for equal-count bins, giving
-    # heavy values ("big" counts) their own bin first
-    max_bin = max(1, max_bin)
-    mean_size = total_cnt / max_bin
-    is_big = counts >= mean_size * 4.0
-    rest_cnt = total_cnt - int(counts[is_big].sum())
-    rest_bins = max_bin - int(is_big.sum())
-    rest_mean = rest_cnt / max(rest_bins, 1)
-    acc = 0.0
-    for i in range(num_distinct - 1):
-        if is_big[i]:
-            # close current bin before and after a big value
-            if acc > 0:
-                bounds.append((distinct_values[i - 1] + distinct_values[i]) / 2.0
-                              if i > 0 else distinct_values[i] - 1.0)
-            bounds.append((distinct_values[i] + distinct_values[i + 1]) / 2.0)
-            acc = 0.0
-            continue
-        acc += float(counts[i])
-        if acc >= rest_mean and len(bounds) < max_bin - 1:
-            bounds.append((distinct_values[i] + distinct_values[i + 1]) / 2.0)
-            acc = 0.0
-    # dedupe and sort
-    bounds = sorted(set(b for b in bounds if math.isfinite(b)))
-    if len(bounds) > max_bin - 1:
-        idx = np.linspace(0, len(bounds) - 1, max_bin - 1).round().astype(int)
-        bounds = [bounds[i] for i in idx]
+
+    if min_data_in_bin > 0:
+        max_bin = max(1, min(max_bin, int(total_cnt) // min_data_in_bin))
+    mean_bin_size = total_cnt / max_bin
+
+    rest_bin_cnt = max_bin
+    rest_sample_cnt = int(total_cnt)
+    is_big = np.asarray(counts, np.int64) >= mean_bin_size
+    rest_bin_cnt -= int(is_big.sum())
+    rest_sample_cnt -= int(counts[is_big].sum())
+
+    def _mean(cnt, bins):
+        if bins != 0:
+            return cnt / bins
+        return math.inf if cnt > 0 else math.nan
+
+    mean_bin_size = _mean(rest_sample_cnt, rest_bin_cnt)
+    upper = [math.inf] * max_bin
+    lower = [math.inf] * max_bin
+    bin_cnt = 0
+    lower[0] = float(distinct_values[0])
+    cur = 0
+    for i in range(nd - 1):
+        if not is_big[i]:
+            rest_sample_cnt -= int(counts[i])
+        cur += int(counts[i])
+        if (is_big[i] or cur >= mean_bin_size
+                or (is_big[i + 1] and cur >= max(1.0, mean_bin_size * 0.5))):
+            upper[bin_cnt] = float(distinct_values[i])
+            bin_cnt += 1
+            lower[bin_cnt] = float(distinct_values[i + 1])
+            if bin_cnt >= max_bin - 1:
+                break
+            cur = 0
+            if not is_big[i]:
+                rest_bin_cnt -= 1
+                mean_bin_size = _mean(rest_sample_cnt, rest_bin_cnt)
+    bin_cnt += 1
+    for i in range(bin_cnt - 1):
+        val = _upper_bound_1ulp((upper[i] + lower[i + 1]) / 2.0)
+        if not bounds or not _eq_ordered(bounds[-1], val):
+            bounds.append(val)
     bounds.append(math.inf)
     return bounds
 
 
 def _find_bin_with_zero_as_one_bin(
-    values: np.ndarray,
+    distinct_values: np.ndarray,
     counts: np.ndarray,
     max_bin: int,
     total_sample_cnt: int,
     min_data_in_bin: int,
 ) -> List[float]:
-    """Ensure one bin straddles zero (behavioral port of
-    FindBinWithZeroAsOneBin, reference src/io/bin.cpp:256-323)."""
-    left_mask = values < -K_ZERO_THRESHOLD
-    right_mask = values > K_ZERO_THRESHOLD
-    left_cnt = int(counts[left_mask].sum())
-    right_cnt = int(counts[right_mask].sum())
-    zero_cnt = total_sample_cnt - left_cnt - right_cnt
-    if left_cnt == 0 and right_cnt == 0:
-        return [math.inf]
+    """Ensure one bin straddles zero — exact behavioral port of
+    FindBinWithZeroAsOneBin (reference src/io/bin.cpp:256-312): the
+    negative range gets a count-proportional share of ``max_bin - 1`` bins
+    (denominator excludes the zero count), the zero bin closes at
+    ``kZeroThreshold``, and the positive range takes the remainder."""
+    dv = np.asarray(distinct_values, np.float64)
+    left_cnt_data = int(counts[dv <= -K_ZERO_THRESHOLD].sum())
+    right_cnt_data = int(counts[dv > K_ZERO_THRESHOLD].sum())
+    cnt_zero = int(total_sample_cnt) - left_cnt_data - right_cnt_data
+
+    left_cnt = int(np.argmax(dv > -K_ZERO_THRESHOLD)) \
+        if bool((dv > -K_ZERO_THRESHOLD).any()) else len(dv)
+
     bounds: List[float] = []
-    left_max_bin = 0
-    if left_cnt > 0:
-        left_max_bin = max(
-            1, int((left_cnt / max(total_sample_cnt, 1)) * (max_bin - 1))
-        )
-        lb = _greedy_find_bin(
-            values[left_mask], counts[left_mask], left_max_bin, left_cnt, min_data_in_bin
-        )
-        lb[-1] = -K_ZERO_THRESHOLD  # close the negative range at ~zero
-        bounds.extend(lb)
-    if right_cnt > 0:
-        bounds.append(K_ZERO_THRESHOLD)  # the zero bin's upper bound
-        right_max_bin = max_bin - 1 - len([b for b in bounds if b < K_ZERO_THRESHOLD])
-        right_max_bin = max(1, right_max_bin)
-        rb = _greedy_find_bin(
-            values[right_mask], counts[right_mask], right_max_bin, right_cnt, min_data_in_bin
-        )
+    if left_cnt > 0 and max_bin > 1:
+        denom = total_sample_cnt - cnt_zero
+        left_max_bin = int(left_cnt_data / max(denom, 1) * (max_bin - 1))
+        left_max_bin = max(1, left_max_bin)
+        bounds = _greedy_find_bin(dv[:left_cnt], counts[:left_cnt],
+                                  left_max_bin, left_cnt_data,
+                                  min_data_in_bin)
+        if bounds:
+            bounds[-1] = -K_ZERO_THRESHOLD
+
+    right_pos = np.nonzero(dv[left_cnt:] > K_ZERO_THRESHOLD)[0]
+    right_start = left_cnt + int(right_pos[0]) if len(right_pos) else -1
+
+    right_max_bin = max_bin - 1 - len(bounds)
+    if right_start >= 0 and right_max_bin > 0:
+        rb = _greedy_find_bin(dv[right_start:], counts[right_start:],
+                              right_max_bin, right_cnt_data, min_data_in_bin)
+        bounds.append(K_ZERO_THRESHOLD)
         bounds.extend(rb)
     else:
         bounds.append(math.inf)
-    bounds = sorted(set(bounds))
     return bounds
+
+
+def _distinct_with_zero(values_sorted: np.ndarray, zero_cnt: int):
+    """Distinct values + counts from a SORTED non-NaN sample — behavioral
+    port of the reference's construction (src/io/bin.cpp:352-390):
+    neighbouring values within one ulp merge (keeping the larger value),
+    and the implicit-zero count is spliced in where zero sorts (front /
+    between the sign change / back)."""
+    n = len(values_sorted)
+    if n == 0:
+        return np.array([0.0]), np.array([zero_cnt], np.int64)
+    v = values_sorted
+    # group boundaries: value i starts a new group when NOT within one ulp
+    # of value i-1 (CheckDoubleEqualOrdered on consecutive sample values)
+    new_grp = np.empty(n, bool)
+    new_grp[0] = True
+    new_grp[1:] = v[1:] > np.nextafter(v[:-1], np.inf)
+    gid = np.cumsum(new_grp) - 1
+    counts = np.bincount(gid).astype(np.int64)
+    ends = np.cumsum(counts) - 1
+    distinct = v[ends]                 # reference keeps the LARGE value
+    starts = ends - counts + 1
+
+    out_v: List[float] = []
+    out_c: List[int] = []
+    if v[0] > 0.0 and zero_cnt > 0:
+        out_v.append(0.0)
+        out_c.append(zero_cnt)
+    for g in range(len(distinct)):
+        if g > 0 and v[starts[g] - 1] < 0.0 and v[starts[g]] > 0.0:
+            # sign change between consecutive sample values: splice zero
+            # (reference pushes it with zero_cnt even when that is 0)
+            out_v.append(0.0)
+            out_c.append(zero_cnt)
+        out_v.append(float(distinct[g]))
+        out_c.append(int(counts[g]))
+    if v[-1] < 0.0 and zero_cnt > 0:
+        out_v.append(0.0)
+        out_c.append(zero_cnt)
+    return np.asarray(out_v, np.float64), np.asarray(out_c, np.int64)
 
 
 def _find_bin_with_predefined(
@@ -219,8 +317,10 @@ def get_forced_bins(path: str, num_total_features: int,
     if not path:
         return forced
     categorical = set(categorical_features or [])
+    from ..utils.fileio import open_file
+
     try:
-        with open(path) as fh:
+        with open_file(path) as fh:
             spec = json.load(fh)
     except OSError:
         log_warning(f"Could not open {path}. Will ignore.")
@@ -300,6 +400,8 @@ class BinMapper:
         use_missing: bool = True,
         zero_as_missing: bool = False,
         forced_bounds: Optional[Sequence[float]] = None,
+        pre_filter: bool = False,
+        filter_cnt: int = 0,
     ) -> "BinMapper":
         """Behavioral port of BinMapper::FindBin (reference src/io/bin.cpp:325-...).
 
@@ -316,8 +418,14 @@ class BinMapper:
         implicit_zero_cnt = total_sample_cnt - len(vals) - na_cnt
 
         if bin_type == BIN_CATEGORICAL:
-            return cls._find_bin_categorical(m, vals, implicit_zero_cnt, max_bin,
-                                             min_data_in_bin, use_missing, na_cnt)
+            m = cls._find_bin_categorical(m, vals, implicit_zero_cnt, max_bin,
+                                          min_data_in_bin, use_missing, na_cnt)
+            if not m.is_trivial and pre_filter:
+                cnt_in_bin = np.asarray(m._cat_cnt_in_bin, dtype=np.int64)
+                if _need_filter(cnt_in_bin, total_sample_cnt, filter_cnt,
+                                BIN_CATEGORICAL):
+                    m.is_trivial = True
+            return m
 
         # resolve missing type (reference bin.cpp:351-380)
         if not use_missing:
@@ -329,9 +437,6 @@ class BinMapper:
         else:
             m.missing_type = MISSING_NONE
 
-        budget = max_bin - 1 if m.missing_type == MISSING_NAN else max_bin
-        budget = max(budget, 2)
-
         if len(vals) == 0 and implicit_zero_cnt == 0:
             # all NaN
             m.bin_upper_bound = np.array([np.inf])
@@ -339,29 +444,52 @@ class BinMapper:
             m.is_trivial = m.num_bin <= 1
             return m
 
-        if implicit_zero_cnt > 0:
-            vals = np.concatenate([vals, np.zeros(implicit_zero_cnt)])
-        m.min_value = float(vals.min()) if len(vals) else 0.0
-        m.max_value = float(vals.max()) if len(vals) else 0.0
+        # distinct values with the implicit-zero splice, one-ulp merge
+        # (reference bin.cpp:352-390)
+        vals_sorted = np.sort(vals, kind="stable")
+        distinct, counts = _distinct_with_zero(vals_sorted, implicit_zero_cnt)
+        m.min_value = float(distinct[0])
+        m.max_value = float(distinct[-1])
 
-        distinct, counts = np.unique(vals, return_counts=True)
+        # reference bin.cpp:395-408: the NaN missing type reserves one bin
+        # and excludes the NaN count from the sample total
+        if m.missing_type == MISSING_NAN:
+            budget, total_eff = max_bin - 1, total_sample_cnt - na_cnt
+        else:
+            budget, total_eff = max_bin, total_sample_cnt
+        budget = max(budget, 2)
         if forced_bounds:
             # reference bin.cpp:316-322: forced bounds switch the boundary
             # search to FindBinWithPredefinedBin
             bounds = _find_bin_with_predefined(
-                distinct, counts, budget, len(vals), min_data_in_bin,
+                distinct, counts, budget, total_eff, min_data_in_bin,
                 forced_bounds)
         else:
             bounds = _find_bin_with_zero_as_one_bin(
-                distinct, counts, budget, len(vals), min_data_in_bin
+                distinct, counts, budget, total_eff, min_data_in_bin
             )
+        if m.missing_type == MISSING_ZERO and len(bounds) == 2:
+            # reference bin.cpp:399-402: a 2-bin zero-as-missing feature
+            # degenerates to no missing handling
+            m.missing_type = MISSING_NONE
         m.bin_upper_bound = np.asarray(bounds, dtype=np.float64)
         m.num_bin = len(bounds)
         if m.missing_type == MISSING_NAN:
             m.num_bin += 1  # trailing NaN bin
         zero_total = int(counts[np.abs(distinct) <= K_ZERO_THRESHOLD].sum())
-        m.sparse_rate = zero_total / max(len(vals), 1)
-        m.is_trivial = m.num_bin <= 1 or (len(distinct) <= 1 and na_cnt == 0)
+        m.sparse_rate = zero_total / max(len(vals) + implicit_zero_cnt, 1)
+        m.is_trivial = m.num_bin <= 1
+        if not m.is_trivial and pre_filter:
+            # per-bin sample counts incl. the trailing NaN bin
+            bin_of = np.searchsorted(m.bin_upper_bound, distinct, side="left")
+            np.clip(bin_of, 0, len(m.bin_upper_bound) - 1, out=bin_of)
+            cnt_in_bin = np.bincount(bin_of, weights=counts,
+                                     minlength=m.num_bin).astype(np.int64)
+            if m.missing_type == MISSING_NAN:
+                cnt_in_bin[m.num_bin - 1] = na_cnt
+            if _need_filter(cnt_in_bin, total_sample_cnt, filter_cnt,
+                            BIN_NUMERICAL):
+                m.is_trivial = True
         return m
 
     @staticmethod
@@ -385,6 +513,8 @@ class BinMapper:
         m.bin_2_categorical = [int(c) for c in distinct[:keep]]
         m.categorical_2_bin = {int(c): i for i, c in enumerate(m.bin_2_categorical)}
         m.num_bin = keep + 1  # + other/unseen/NaN bin
+        m._cat_cnt_in_bin = [int(c) for c in counts[:keep]] + [
+            int(counts[keep:].sum()) + na_cnt]
         m.missing_type = MISSING_NAN if (use_missing and na_cnt > 0) else MISSING_NONE
         m.is_trivial = keep <= 1
         m.min_value = float(distinct.min()) if len(distinct) else 0.0

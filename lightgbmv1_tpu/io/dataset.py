@@ -200,7 +200,8 @@ class BinnedDataset:
                 log_fatal("max_bin_by_feature length must equal number of features")
             samples = [np.asarray(X[sample_idx, j], dtype=np.float64) for j in range(num_features)]
             if bin_finder is not None:
-                mappers = bin_finder(samples, sample_cnt, max_bins, categorical, config)
+                mappers = bin_finder(samples, sample_cnt, max_bins, categorical,
+                                     config, num_data)
             else:
                 from .binning import get_forced_bins
 
@@ -216,6 +217,9 @@ class BinnedDataset:
                         use_missing=config.use_missing,
                         zero_as_missing=config.zero_as_missing,
                         forced_bounds=forced[j],
+                        pre_filter=config.feature_pre_filter,
+                        filter_cnt=int(config.min_data_in_leaf * sample_cnt
+                                       / max(num_data, 1)),
                     )
                     for j in range(num_features)
                 ]
@@ -316,6 +320,9 @@ class BinnedDataset:
                     use_missing=config.use_missing,
                     zero_as_missing=config.zero_as_missing,
                     forced_bounds=forced[j],
+                    pre_filter=config.feature_pre_filter,
+                    filter_cnt=int(config.min_data_in_leaf * sample_cnt
+                                   / max(num_data, 1)),
                 )
                 for j in range(num_features)
             ]
@@ -418,8 +425,10 @@ class BinnedDataset:
             [[m.sparse_rate, m.min_value, m.max_value]
              for m in self.bin_mappers], dtype=np.float64)
         meta = self.metadata
-        fh = open(path, "wb")   # keep the exact filename (savez appends .npz
-                                # to bare string paths)
+        from ..utils.fileio import open_file
+
+        fh = open_file(path, "wb")  # keep the exact filename (savez appends
+                                    # .npz to bare string paths)
         bl = self.bundle_layout
         np.savez_compressed(
             fh,
@@ -464,18 +473,27 @@ class BinnedDataset:
     def is_binary_file(cls, path: str) -> bool:
         import zipfile
 
-        if not zipfile.is_zipfile(path):
+        from ..utils.fileio import exists, open_file
+
+        if not exists(path):
             return False
         try:
-            with np.load(path, allow_pickle=False) as z:
-                return ("magic" in z and
-                        bytes(z["magic"]).decode() == cls.BINARY_MAGIC)
+            with open_file(path, "rb") as fh:
+                if not zipfile.is_zipfile(fh):
+                    return False
+                fh.seek(0)
+                with np.load(fh, allow_pickle=False) as z:
+                    return ("magic" in z and
+                            bytes(z["magic"]).decode() == cls.BINARY_MAGIC)
         except Exception:
             return False
 
     @classmethod
     def load_binary(cls, path: str) -> "BinnedDataset":
-        with np.load(path, allow_pickle=False) as z:
+        from ..utils.fileio import open_file
+
+        with open_file(path, "rb") as fh, \
+                np.load(fh, allow_pickle=False) as z:
             if bytes(z["magic"]).decode() != cls.BINARY_MAGIC:
                 log_fatal(f"{path} is not a lightgbmv1_tpu binary dataset")
             scalars = z["mapper_scalars"]

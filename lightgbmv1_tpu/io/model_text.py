@@ -215,6 +215,7 @@ def model_to_string(
     label_index: int = 0,
     average_output: bool = False,
     parameters: Optional[Dict[str, Any]] = None,
+    importance_type: int = 0,
 ) -> str:
     """reference: GBDT::SaveModelToString, gbdt_model_text.cpp:306-397."""
     out: List[str] = []
@@ -239,17 +240,20 @@ def model_to_string(
     out.append("end of trees")
     out.append("")
 
-    # feature importances (split counts, descending — reference
-    # gbdt_model_text.cpp FeatureImportance section)
-    counts = np.zeros(len(feature_names), dtype=np.int64)
+    # feature importances, descending (reference gbdt_model_text.cpp
+    # FeatureImportance section; saved_feature_importance_type selects
+    # split counts (0) or total gains (1) — gbdt.cpp:779-800)
+    counts = np.zeros(len(feature_names), dtype=np.float64)
     for t in trees:
-        for f in t.split_feature:
-            counts[f] += 1
+        for i, f in enumerate(t.split_feature[: t.num_leaves - 1]):
+            counts[f] += t.split_gain[i] if importance_type == 1 else 1.0
     order = np.argsort(-counts, kind="stable")
     out.append("feature_importances:")
     for i in order:
         if counts[i] > 0:
-            out.append(f"{feature_names[i]}={counts[i]}")
+            val = f"{counts[i]:g}" if importance_type == 1 else \
+                str(int(counts[i]))
+            out.append(f"{feature_names[i]}={val}")
     out.append("")
     out.append("parameters:")
     for k, v in (parameters or {}).items():

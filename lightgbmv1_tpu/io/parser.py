@@ -19,6 +19,7 @@ from typing import List, Optional, Tuple
 
 import numpy as np
 
+from ..utils import fileio
 from ..utils.log import log_fatal, log_info, log_warning
 
 
@@ -145,9 +146,9 @@ def load_two_round(path: str, config, categorical_features=None):
         get_forced_bins
     from .dataset import BinnedDataset, Metadata
 
-    if not os.path.exists(path):
+    if not fileio.exists(path):
         log_fatal(f"Data file {path} does not exist")
-    with open(path) as fh:
+    with fileio.open_file(path) as fh:
         head = [fh.readline().rstrip("\n") for _ in range(24)]
     header_names = None
     head_data = list(head)
@@ -189,7 +190,7 @@ def load_two_round(path: str, config, categorical_features=None):
     n_rows = 0
     fval = _fval
 
-    with open(path) as fh:
+    with fileio.open_file(path) as fh:
         if config.header:
             fh.readline()
         for line in fh:
@@ -243,6 +244,9 @@ def load_two_round(path: str, config, categorical_features=None):
             use_missing=config.use_missing,
             zero_as_missing=config.zero_as_missing,
             forced_bounds=forced[j],
+            pre_filter=config.feature_pre_filter,
+            filter_cnt=int(config.min_data_in_leaf * sample_cnt
+                           / max(n_rows, 1)),
         )
         for j in range(num_features)
     ]
@@ -266,7 +270,7 @@ def load_two_round(path: str, config, categorical_features=None):
         lo += len(buf)
         buf.clear()
 
-    with open(path) as fh:
+    with fileio.open_file(path) as fh:
         if config.header:
             fh.readline()
         for line in fh:
@@ -330,12 +334,12 @@ def load_data_file(
     ``rank``/``num_machines``: parse only this rank's contiguous row shard
     (the reference's loader-level pre-partition). Only the owned lines are
     tokenized/parsed; the raw text is still read once to index lines."""
-    if not os.path.exists(path):
+    if not fileio.exists(path):
         log_fatal(f"Data file {path} does not exist")
     # read only a head sample first: format detection + header names need a
     # few lines, and the native fast path reads the file itself (avoiding a
     # second full read + full Python line list on the fast path)
-    with open(path) as fh:
+    with fileio.open_file(path) as fh:
         head = [fh.readline().rstrip("\n") for _ in range(24)]
     header_names = None
     head_data = list(head)
@@ -353,7 +357,7 @@ def load_data_file(
     def all_lines():
         nonlocal lines
         if lines is None:
-            with open(path) as fh:
+            with fileio.open_file(path) as fh:
                 lines = fh.read().splitlines()
             if has_header and lines:
                 lines = lines[1:]
@@ -387,8 +391,8 @@ def load_data_file(
         # (sharded loads parse only the owned lines, Python path)
         from ..native import parse_dense_file
 
-        data = None if sharded else parse_dense_file(path, has_header, sep,
-                                                     num_threads)
+        data = None if (sharded or fileio.is_remote_path(path)) else \
+            parse_dense_file(path, has_header, sep, num_threads)
         if data is None:
             data = _parse_dense(all_lines(), sep)
         label_idx = _resolve_column(label_column, header_names, "label")

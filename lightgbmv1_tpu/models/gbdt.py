@@ -298,7 +298,7 @@ class GBDT:
             # closed-over process-spanning global arrays cannot be baked into
             # the jaxpr on multi-host meshes
             s = train_score[:, 0] if K == 1 else train_score
-            grad, hess = self._objective_grads(s)
+            grad, hess = self._objective_grads(s, iteration)
             if grad.ndim == 1:
                 grad, hess = grad[:, None], hess[:, None]
             bag = self._bag_fraction_mask(None, iteration)
@@ -319,8 +319,8 @@ class GBDT:
                 for vb, vscore in zip(valid_binned, valid_scores):
                     pred = tree_predict_binned(
                         shrunk, vb, self.meta.nan_bin,
-                        self.meta.missing_type, self._bundle, self._packed
-                    )
+                        self.meta.missing_type, self._bundle, self._packed,
+                        zero_bins=self.meta.zero_bin)
                     new_valid.append(vscore.at[:, k].add(pred))
                 valid_scores = tuple(new_valid) if new_valid else valid_scores
                 trees.append(shrunk)
@@ -332,7 +332,9 @@ class GBDT:
         self._step_fn = step
         return jax.jit(step)
 
-    def _objective_grads(self, s):
+    def _objective_grads(self, s, iteration=None):
+        if getattr(self.objective, "is_stochastic", False):
+            return self.objective.get_gradients(s, iteration=iteration)
         return self.objective.get_gradients(s)
 
     # ------------------------------------------------------------------
@@ -523,6 +525,12 @@ class GBDT:
     def _gradients(self) -> Tuple[jax.Array, jax.Array]:
         score = self._train_scores.score
         s = score[:, 0] if self.num_class == 1 else score
+        if getattr(self.objective, "is_stochastic", False):
+            grad, hess = self.objective.get_gradients(
+                s, iteration=int(self.iter))
+            if grad.ndim == 1:
+                grad, hess = grad[:, None], hess[:, None]
+            return grad, hess
         grad, hess = self.objective.get_gradients(s)
         if grad.ndim == 1:
             grad, hess = grad[:, None], hess[:, None]
@@ -630,11 +638,21 @@ class GBDT:
         bias = self._tree_bias(k)
 
         if self._needs_host_tree:
-            host_tree = HostTree(jax.device_get(tree_dev))
-            self._fill_real_thresholds(host_tree)
             q = self.objective.renew_percentile if self.objective else None
+            if q is not None:
+                # ONE batched transfer for everything the renewal reads
+                # (tree arrays + per-row leaf ids + this class's scores)
+                # instead of three round-trips — at tunnel latency the
+                # transfer count dominates the renewal cost
+                arrays, lid_np, score_np = jax.device_get(
+                    (tree_dev, leaf_id, self._train_scores.score[:, k]))
+                host_tree = HostTree(arrays)
+            else:
+                host_tree = HostTree(jax.device_get(tree_dev))
+            self._fill_real_thresholds(host_tree)
             if q is not None and host_tree.num_leaves > 1:
-                new_vals = self._renew_leaf_values(host_tree, leaf_id, k, q)
+                new_vals = self._renew_leaf_values(host_tree, lid_np, k, q,
+                                                   score_np)
                 host_tree.set_leaf_values(new_vals)
                 tree_dev = tree_dev._replace(
                     leaf_value=tree_dev.leaf_value.at[: host_tree.num_leaves].set(
@@ -656,7 +674,7 @@ class GBDT:
         for vb, vs in zip(self._valid_binned, self._valid_scores):
             pred = tree_predict_binned(
                 shrunk, vb, self.meta.nan_bin, self.meta.missing_type,
-                self._bundle, self._packed
+                self._bundle, self._packed, zero_bins=self.meta.zero_bin
             )
             vs.add_pred(pred, k)
 
@@ -700,11 +718,16 @@ class GBDT:
             else:
                 tree.threshold[i] = m.bin_to_threshold(tree.threshold_bin[i])
 
-    def _renew_leaf_values(self, tree: HostTree, leaf_id: jax.Array, k: int, q: float):
+    def _renew_leaf_values(self, tree: HostTree, leaf_id, k: int, q: float,
+                           score=None):
         """reference: RenewTreeOutput (objective-specific, e.g. L1 median —
-        regression_objective.hpp RenewTreeOutput + percentile helpers)."""
+        regression_objective.hpp RenewTreeOutput + percentile helpers).
+        ``leaf_id``/``score`` arrive as host arrays from the caller's single
+        batched device_get."""
         label = np.asarray(self.objective._np_label)
-        score = np.asarray(self._train_scores.score[:, k], dtype=np.float64)
+        if score is None:
+            score = self._train_scores.score[:, k]
+        score = np.asarray(score, dtype=np.float64)
         resid = label - score
         lid = np.asarray(leaf_id)
         w = self.objective.renew_weights()
@@ -866,20 +889,22 @@ class DART(GBDT):
     def __init__(self, *args, **kwargs):
         super().__init__(*args, **kwargs)
         self._drop_rng = np.random.RandomState(self.config.drop_seed)
-        self._needs_host_tree = True  # drop normalization rescales host trees
         # per-tree weights driving the weighted (non-uniform) drop
         # (reference: dart.hpp tree_weight_/sum_weight_, :67-68,103-115)
         self._tree_weight: List[float] = []
         self._sum_weight = 0.0
+        self._dart_steps: dict = {}    # padded-slot-count -> compiled step
 
-    def train_one_iter(self, custom_grad=None, custom_hess=None,
-                       check_stop: bool = True) -> bool:
+    def _supports_fused_step(self) -> bool:
+        # the scanned multi-iteration path cannot host the per-iteration
+        # drop selection; DART fuses WITHIN an iteration instead
+        return False
+
+    def _select_drops(self) -> List[int]:
+        """Host-side drop selection (reference: dart.hpp DroppingTrees
+        :96-137 — uniform_drop drops at drop_rate; otherwise each tree's
+        probability is weighted by its current normalized weight)."""
         cfg = self.config
-        self._save_rollback_state()
-        self._prev_weights = (list(self._tree_weight), self._sum_weight)
-        # select trees to drop (reference: dart.hpp DroppingTrees :96-137 —
-        # uniform_drop=false weights each tree's drop probability by its
-        # current normalized weight; true drops uniformly at drop_rate)
         n_trees = len(self.models) // self.num_class
         drop_iters: List[int] = []
         if n_trees > 0 and self._drop_rng.rand() >= cfg.skip_drop:
@@ -901,6 +926,226 @@ class DART(GBDT):
                         drop_iters.append(i)
                         if cfg.max_drop > 0 and len(drop_iters) >= cfg.max_drop:
                             break
+        return drop_iters
+
+    def _normalization(self, k_drop: int):
+        """(shrink_new, old_factor, w_dec) — reference dart.hpp Normalize
+        :158-196 and shrinkage_rate_ :138-146."""
+        lr = self.config.learning_rate
+        if self.config.xgboost_dart_mode:
+            shrink_new = lr if k_drop == 0 else lr / (lr + k_drop)
+            return shrink_new, k_drop / (k_drop + lr), 1.0 / (k_drop + lr)
+        return (lr / (k_drop + 1.0), k_drop / (k_drop + 1.0),
+                1.0 / (k_drop + 1.0))
+
+    def _snapshot_dropped(self, drop_iters: List[int]) -> None:
+        """Extend the rollback snapshot with the dropped trees' state (the
+        permanent old_factor rescale must be undoable)."""
+        self._prev_state = self._prev_state + (
+            {
+                it * self.num_class + kk: (
+                    None if self.models[it * self.num_class + kk] is None
+                    else (
+                        self.models[it * self.num_class + kk].leaf_value.copy(),
+                        self.models[it * self.num_class + kk].internal_value.copy(),
+                        self.models[it * self.num_class + kk].shrinkage,
+                    ),
+                    self._device_trees[it * self.num_class + kk].leaf_value,
+                    self._model_shrink[it * self.num_class + kk],
+                    self._model_bias[it * self.num_class + kk],
+                )
+                for it in drop_iters
+                for kk in range(self.num_class)
+            },
+        )
+
+    def _rescale_dropped(self, drop_iters: List[int], old_factor: float,
+                         w_dec: float) -> None:
+        """Permanent rescale of the dropped trees (reference Normalize
+        :158-196).  Works for lazily-materialized trees: the device leaf
+        values carry the rescale; _model_shrink/_model_bias metadata scale
+        with them."""
+        for it in drop_iters:
+            for k in range(self.num_class):
+                idx = it * self.num_class + k
+                if self.models[idx] is not None:
+                    self.models[idx].apply_shrinkage(old_factor)
+                self._device_trees[idx] = self._device_trees[idx]._replace(
+                    leaf_value=self._device_trees[idx].leaf_value * old_factor
+                )
+                self._model_shrink[idx] *= old_factor
+                self._model_bias[idx] *= old_factor
+            if not self.config.uniform_drop:
+                self._sum_weight -= self._tree_weight[it] * w_dec
+                self._tree_weight[it] *= old_factor
+
+    # ------------------------------------------------------------------
+    # fused DART iteration: drop removal, gradients, K class trees, drop
+    # restore, and every score update in ONE device dispatch (the host
+    # keeps only drop selection and bookkeeping).  Semantics identical to
+    # the host-loop path below (reference dart.hpp:23-170).
+    # ------------------------------------------------------------------
+    def _build_dart_step(self, P: int):
+        K = self.num_class
+
+        def pred_with(tree, b):
+            return tree_predict_binned(tree, b, self.meta.nan_bin,
+                                       self.meta.missing_type,
+                                       self._bundle, self._packed,
+                                       zero_bins=self.meta.zero_bin)
+
+        def step(binned, valid_binned, train_score, valid_scores, iteration,
+                 feat_masks, cegb_used, drop_stack, drop_weight, shrink_new):
+            # drop_stack: TreeArrays stacked over P slots, leaf values
+            # bias-carrying; drop_weight: (P, K) f32 one-hot rows scaled by
+            # the slot's validity (0 rows = padding)
+            preds = jax.vmap(lambda t: pred_with(t, binned))(drop_stack)
+            drop_delta = preds.T @ drop_weight                   # (N, K)
+            s_drop = train_score - drop_delta
+            v_drops, v_deltas = [], []
+            for vb, vscore in zip(valid_binned, valid_scores):
+                vp = jax.vmap(lambda t: pred_with(t, vb))(drop_stack)
+                vd = vp.T @ drop_weight
+                v_deltas.append(vd)
+                v_drops.append(vscore - vd)
+
+            s = s_drop[:, 0] if K == 1 else s_drop
+            grad, hess = self._objective_grads(s, iteration)
+            if grad.ndim == 1:
+                grad, hess = grad[:, None], hess[:, None]
+            bag = self._bag_fraction_mask(None, iteration)
+
+            trees, leaf_ids = [], []
+            for k in range(K):
+                g3 = self._sample_g3(grad[:, k], hess[:, k], bag, iteration)
+                key = jax.random.fold_in(self._rng_key, iteration * K + k)
+                tree_dev, leaf_id, _ = self._grow(binned, g3, feat_masks[k],
+                                                  key, cegb_used)
+                if self._cegb_enabled:
+                    cegb_used = self._update_cegb_state(cegb_used, tree_dev,
+                                                        leaf_id)
+                shrunk = tree_dev._replace(
+                    leaf_value=tree_dev.leaf_value * shrink_new)
+                trees.append(shrunk)
+                leaf_ids.append(leaf_id)
+            return (s_drop, tuple(v_drops), drop_delta, tuple(v_deltas),
+                    jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *trees),
+                    jnp.stack(leaf_ids), cegb_used)
+
+        def full(binned, valid_binned, train_score, valid_scores, iteration,
+                 feat_masks, cegb_used, drop_stack, drop_weight, shrink_new,
+                 old_factor):
+            (s_drop, v_drops, d_delta, v_deltas, stacked, leaf_ids,
+             cegb_used) = step(binned, valid_binned, train_score,
+                               valid_scores, iteration, feat_masks,
+                               cegb_used, drop_stack, drop_weight,
+                               shrink_new)
+            new_train = s_drop + old_factor * d_delta
+            new_valids = [vs + old_factor * vd
+                          for vs, vd in zip(v_drops, v_deltas)]
+            for k in range(K):
+                tree_k = jax.tree_util.tree_map(lambda a: a[k], stacked)
+                new_train = new_train.at[:, k].add(
+                    tree_k.leaf_value[leaf_ids[k]])
+                new_valids = [
+                    nv.at[:, k].add(pred_with(tree_k, vb))
+                    for nv, vb in zip(new_valids, valid_binned)
+                ]
+            return (new_train, tuple(new_valids), stacked, leaf_ids,
+                    cegb_used)
+
+        return jax.jit(full)
+
+    def _fused_dart_iter(self, drop_iters: List[int]) -> None:
+        cfg = self.config
+        K = self.num_class
+        k_drop = len(drop_iters)
+        shrink_new, old_factor, w_dec = self._normalization(k_drop)
+        self._snapshot_dropped(drop_iters)
+
+        # padded drop stack: P = next power of two covering k_drop*K slots
+        n_real = k_drop * K
+        P = 1
+        while P < n_real:
+            P *= 2
+        entries, weights = [], np.zeros((P, K), np.float32)
+        for j, it in enumerate(drop_iters):
+            for k in range(K):
+                idx = it * K + k
+                t = self._device_trees[idx]
+                b = self._model_bias[idx]
+                if b:
+                    t = t._replace(leaf_value=t.leaf_value + b)
+                entries.append(t)
+                weights[j * K + k, k] = 1.0
+        while len(entries) < P:
+            entries.append(entries[0])        # padding; weight row is 0
+        drop_stack = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs),
+                                            *entries)
+
+        if P not in self._dart_steps:
+            self._dart_steps[P] = self._build_dart_step(P)
+        feat_masks = jnp.asarray(
+            np.stack([self._tree_feature_mask() for _ in range(K)]))
+        vscores = tuple(vs.score for vs in self._valid_scores)
+        with global_timer.section("DART::TrainOneIter(dispatch)"):
+            (new_train, new_valid, stacked, leaf_ids,
+             self._cegb_used) = self._dart_steps[P](
+                self._grow_binned, tuple(self._valid_binned),
+                self._train_scores.score, vscores,
+                jnp.asarray(self.iter, jnp.int32), feat_masks,
+                self._cegb_used, drop_stack, jnp.asarray(weights),
+                jnp.float32(shrink_new), jnp.float32(old_factor),
+            )
+        self._train_scores.score = new_train
+        for vs, s in zip(self._valid_scores, new_valid):
+            vs.score = s
+        for k in range(K):
+            self._device_trees.append(
+                jax.tree_util.tree_map(lambda a: a[k], stacked))
+            self.models.append(None)
+            self._model_shrink.append(shrink_new)
+            self._model_bias.append(self._tree_bias(k))
+
+        self._rescale_dropped(drop_iters, old_factor, w_dec)
+        if not cfg.uniform_drop:
+            self._tree_weight.append(shrink_new)
+            self._sum_weight += shrink_new
+
+    def train_one_iter(self, custom_grad=None, custom_hess=None,
+                       check_stop: bool = True) -> bool:
+        cfg = self.config
+        fused_ok = (custom_grad is None and self.objective is not None
+                    and self.objective.renew_percentile is None
+                    and not self._needs_host_tree)
+        if fused_ok:
+            self._save_rollback_state()
+            self._prev_weights = (list(self._tree_weight), self._sum_weight)
+            drop_iters = self._select_drops()
+            if not drop_iters:
+                # no drop: exactly a plain GBDT iteration at rate lr
+                self._fused_train_one_iter()
+                if not cfg.uniform_drop:
+                    lr = cfg.learning_rate
+                    self._tree_weight.append(lr)
+                    self._sum_weight += lr
+            else:
+                self._fused_dart_iter(drop_iters)
+            self.iter += 1
+            if check_stop:
+                new = self._device_trees[-self.num_class:]
+                stopped = all(int(t.num_leaves) <= 1 for t in new)
+                return stopped
+            return False
+        return self._host_train_one_iter(custom_grad, custom_hess,
+                                         check_stop)
+
+    def _host_train_one_iter(self, custom_grad=None, custom_hess=None,
+                             check_stop: bool = True) -> bool:
+        cfg = self.config
+        self._save_rollback_state()
+        self._prev_weights = (list(self._tree_weight), self._sum_weight)
+        drop_iters = self._select_drops()
         k_drop = len(drop_iters)
 
         # remove dropped trees' contribution from scores, caching each
@@ -909,23 +1154,7 @@ class DART(GBDT):
         if k_drop:
             # rollback must be able to undo the permanent rescaling of
             # dropped trees, so snapshot their values
-            self._prev_state = self._prev_state + (
-                {
-                    it * self.num_class + kk: (
-                        None if self.models[it * self.num_class + kk] is None
-                        else (
-                            self.models[it * self.num_class + kk].leaf_value.copy(),
-                            self.models[it * self.num_class + kk].internal_value.copy(),
-                            self.models[it * self.num_class + kk].shrinkage,
-                        ),
-                        self._device_trees[it * self.num_class + kk].leaf_value,
-                        self._model_shrink[it * self.num_class + kk],
-                        self._model_bias[it * self.num_class + kk],
-                    )
-                    for it in drop_iters
-                    for kk in range(self.num_class)
-                },
-            )
+            self._snapshot_dropped(drop_iters)
             dropped_preds = self._remove_dropped(drop_iters)
 
         if custom_grad is not None:
@@ -935,17 +1164,7 @@ class DART(GBDT):
             grad, hess = self._gradients()
         bag = self._bagging_mask(self.iter)
 
-        # normalization factors (reference: dart.hpp Normalize :158-196 and
-        # shrinkage_rate_ :138-146)
-        lr = cfg.learning_rate
-        if cfg.xgboost_dart_mode:
-            shrink_new = lr if k_drop == 0 else lr / (lr + k_drop)
-            old_factor = k_drop / (k_drop + lr)
-            w_dec = 1.0 / (k_drop + lr)       # reference dart.hpp:192-193
-        else:
-            shrink_new = lr / (k_drop + 1.0)
-            old_factor = k_drop / (k_drop + 1.0)
-            w_dec = 1.0 / (k_drop + 1.0)      # reference dart.hpp:173-174
+        shrink_new, old_factor, w_dec = self._normalization(k_drop)
 
         new_trees = []
         for k in range(self.num_class):
@@ -973,7 +1192,9 @@ class DART(GBDT):
                     self._device_trees[idx] = self._device_trees[idx]._replace(
                         leaf_value=self._device_trees[idx].leaf_value * old_factor
                     )
-                    # the embedded init score scales with the tree
+                    # metadata scales with the tree (shrinkage for lazy
+                    # materialization, the embedded init score always)
+                    self._model_shrink[idx] *= old_factor
                     self._model_bias[idx] *= old_factor
                     pred, vpreds = dropped_preds[idx]
                     self._train_scores.add_pred(old_factor * pred, k)
@@ -1009,15 +1230,15 @@ class DART(GBDT):
                     tree = tree._replace(leaf_value=tree.leaf_value + b)
                 pred = tree_predict_binned(
                     tree, self.binned, self.meta.nan_bin,
-                    self.meta.missing_type, self._bundle, self._packed
-                )
+                    self.meta.missing_type, self._bundle, self._packed,
+                    zero_bins=self.meta.zero_bin)
                 self._train_scores.add_pred(-pred, k)
                 vpreds = []
                 for vb, vs in zip(self._valid_binned, self._valid_scores):
                     vp = tree_predict_binned(
                         tree, vb, self.meta.nan_bin,
-                        self.meta.missing_type, self._bundle, self._packed
-                    )
+                        self.meta.missing_type, self._bundle, self._packed,
+                        zero_bins=self.meta.zero_bin)
                     vs.add_pred(-vp, k)
                     vpreds.append(vp)
                 preds[idx] = (pred, vpreds)
@@ -1084,11 +1305,13 @@ class RF(GBDT):
             self._cached_grads = (grad, hess)
         return self._cached_grads
 
-    def _objective_grads(self, s):
+    def _objective_grads(self, s, iteration=None):
         # gradients always evaluated at the constant init score
         init = jnp.asarray(self._init_scores, jnp.float32)
         const = jnp.broadcast_to(init[None, :], (self.num_data, self.num_class))
         sc = const[:, 0] if self.num_class == 1 else const
+        if getattr(self.objective, "is_stochastic", False):
+            return self.objective.get_gradients(sc, iteration=iteration)
         return self.objective.get_gradients(sc)
 
     def train_one_iter(self, custom_grad=None, custom_hess=None,

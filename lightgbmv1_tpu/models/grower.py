@@ -37,7 +37,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
-from ..io.binning import MISSING_NAN
+from ..io.binning import MISSING_NAN, MISSING_ZERO
 from ..ops.split import (
     NO_CONSTRAINT,
     FeatureMeta,
@@ -96,10 +96,14 @@ def forced_split_stats(hf, parent_sum, ffeat, fbin, fdl, meta, params):
 
     cumf = jnp.cumsum(hf, axis=0)                    # (B, 3)
     has_nan = meta.missing_type[ffeat] == MISSING_NAN
-    nan_c = hf[jnp.maximum(meta.nan_bin[ffeat], 0)] * jnp.where(
-        has_nan, 1.0, 0.0)
-    in_cum = has_nan & (meta.nan_bin[ffeat] <= fbin)
-    flsum = cumf[fbin] + nan_c * (
+    has_zero = meta.missing_type[ffeat] == MISSING_ZERO
+    # the missing mass (NaN bin or zero-as-missing bin) rides with the
+    # default direction, independent of its position vs the threshold
+    miss_bin = jnp.where(has_nan, jnp.maximum(meta.nan_bin[ffeat], 0),
+                         meta.zero_bin[ffeat])
+    miss_c = hf[miss_bin] * jnp.where(has_nan | has_zero, 1.0, 0.0)
+    in_cum = (has_nan | has_zero) & (miss_bin <= fbin)
+    flsum = cumf[fbin] + miss_c * (
         jnp.asarray(fdl).astype(jnp.float32) - in_cum.astype(jnp.float32))
     frsum = parent_sum - flsum
     fgain = (leaf_gain(flsum[0], flsum[1], params)
@@ -153,6 +157,8 @@ def make_leafwise_grower(
     split_fn: Callable = None,
     sums_fn: Callable = None,
     bins_of_fn: Callable = None,
+    num_features: int = 0,
+    hist_pool_mb: float = -1.0,
 ):
     """Build the jittable ``grow(binned, g3, base_mask, key)`` function.
 
@@ -245,6 +251,28 @@ def make_leafwise_grower(
         def bins_of_fn(binned, feat):
             return binned[feat]
 
+    # ---- histogram pool sizing (reference: HistogramPool LRU bounded by
+    # histogram_pool_size MB, feature_histogram.hpp:1061-1290).  The pool
+    # holds one (F, B, 3) f32 histogram per leaf to enable the subtraction
+    # trick; when it would exceed the cap (histogram_pool_size > 0) or the
+    # 512 MB auto bound (histogram_pool_size < 0), switch to pool-free mode:
+    # both children's histograms are built directly (2 passes per split,
+    # the reference's no-cache behavior) and HBM stays O(F·B) regardless of
+    # num_leaves.  Forced splits read parent histograms after the fact and
+    # therefore keep the pool.
+    F_pool = num_features if num_features else len(np.asarray(meta.num_bins))
+    pool_bytes = float(L) * F_pool * num_bins * 3 * 4
+    cap_bytes = (hist_pool_mb * (1 << 20) if hist_pool_mb > 0
+                 else 512.0 * (1 << 20))
+    use_pool = S_forced > 0 or pool_bytes <= cap_bytes
+    if not use_pool:
+        from ..utils.log import log_info
+
+        log_info(
+            f"Histogram pool would need {pool_bytes / (1 << 20):.0f} MB "
+            f"(> {cap_bytes / (1 << 20):.0f} MB cap); using pool-free "
+            "growth (children histograms rebuilt per split)")
+
     def clamp_out(sums, constr, parent_out=0.0):
         out = leaf_output(sums[0], sums[1], params)
         if params.path_smooth > 0:
@@ -255,16 +283,19 @@ def make_leafwise_grower(
 
     def apply_decision(binned, leaf_id, leaf, new_leaf, feat, thr, dl,
                        is_cat, bitset):
-        bins_f = bins_of_fn(binned, feat)           # (N,) original bins
-        is_na = (meta.missing_type[feat] == MISSING_NAN) & (
-            bins_f == meta.nan_bin[feat]
-        )
-        go_left = jnp.where(is_na, dl, bins_f <= thr)
-        bi = bins_f.astype(jnp.int32)
-        word = bitset[bi >> 5]
-        in_set = ((word >> (bi.astype(jnp.uint32) & 31)) & 1) == 1
-        go_left = jnp.where(is_cat, in_set, go_left)
-        return jnp.where((leaf_id == leaf) & (~go_left), new_leaf, leaf_id)
+        with jax.named_scope("lgbm.partition"):
+            bins_f = bins_of_fn(binned, feat)       # (N,) original bins
+            is_na = ((meta.missing_type[feat] == MISSING_NAN)
+                     & (bins_f == meta.nan_bin[feat])) | (
+                (meta.missing_type[feat] == MISSING_ZERO)
+                & (bins_f == meta.zero_bin[feat]))
+            go_left = jnp.where(is_na, dl, bins_f <= thr)
+            bi = bins_f.astype(jnp.int32)
+            word = bitset[bi >> 5]
+            in_set = ((word >> (bi.astype(jnp.uint32) & 31)) & 1) == 1
+            go_left = jnp.where(is_cat, in_set, go_left)
+            return jnp.where((leaf_id == leaf) & (~go_left), new_leaf,
+                             leaf_id)
 
     def grow(binned, g3, base_mask, key, cegb_used=None):
         N = binned.shape[1]
@@ -311,8 +342,11 @@ def make_leafwise_grower(
                         bseg = jnp.take(bins_row, seg, mode="fill",
                                         fill_value=0)
                         valid = jnp.arange(CAP) < n_p
-                        is_na = (meta.missing_type[feat] == MISSING_NAN) & (
-                            bseg == meta.nan_bin[feat])
+                        is_na = ((meta.missing_type[feat]
+                                  == MISSING_NAN)
+                                 & (bseg == meta.nan_bin[feat])) | (
+                            (meta.missing_type[feat] == MISSING_ZERO)
+                            & (bseg == meta.zero_bin[feat]))
                         gl = jnp.where(is_na, dl, bseg <= thr)
                         bi = bseg.astype(jnp.int32)
                         word = bitset[bi >> 5]
@@ -335,9 +369,10 @@ def make_leafwise_grower(
                         return order2, n_l
                     return br
 
-                return lax.switch(
-                    bucket_of(n_p), [make_branch(cc) for cc in caps],
-                    (order, s_begin, n_p, thr, dl, iscat, bitset))
+                with jax.named_scope("lgbm.partition"):
+                    return lax.switch(
+                        bucket_of(n_p), [make_branch(cc) for cc in caps],
+                        (order, s_begin, n_p, thr, dl, iscat, bitset))
 
             def hist_compact(order, s_begin, n_s):
                 """Histogram of one COMPACTED segment (the smaller child)
@@ -393,8 +428,9 @@ def make_leafwise_grower(
         W = res0.cat_bitset.shape[0]
         st = GrowerState(
             leaf_id=leaf_id,
-            hist_pool=jnp.zeros((L,) + hist0.shape,
-                                jnp.float32).at[0].set(hist0),
+            hist_pool=(jnp.zeros((L,) + hist0.shape,
+                                 jnp.float32).at[0].set(hist0)
+                       if use_pool else jnp.zeros((1, 1, 1, 3), jnp.float32)),
             leaf_sums=jnp.zeros((L, 3), jnp.float32).at[0].set(root_sum),
             leaf_depth=jnp.zeros(L, jnp.int32),
             best_gain=jnp.full(L, -jnp.inf, jnp.float32).at[0].set(res0.gain),
@@ -526,10 +562,24 @@ def make_leafwise_grower(
                     smaller_is_left = lsum[2] <= rsum[2]
                     smaller = jnp.where(smaller_is_left, leaf, nl)
                     h_small = hist_fn(binned, g3, leaf_id, smaller)
-                h_parent = st.hist_pool[leaf]
-                h_left = jnp.where(smaller_is_left, h_small, h_parent - h_small)
-                h_right = h_parent - h_left
-                pool = st.hist_pool.at[leaf].set(h_left).at[nl].set(h_right)
+                if use_pool:
+                    h_parent = st.hist_pool[leaf]
+                    h_left = jnp.where(smaller_is_left, h_small,
+                                       h_parent - h_small)
+                    h_right = h_parent - h_left
+                    pool = st.hist_pool.at[leaf].set(h_left).at[nl].set(h_right)
+                else:
+                    # pool-free: build the larger child directly too
+                    if partition:
+                        lg_begin = jnp.where(smaller_is_left,
+                                             s_begin + sm_n, s_begin)
+                        h_large = hist_compact(order2, lg_begin, n_p - sm_n)
+                    else:
+                        larger = jnp.where(smaller_is_left, nl, leaf)
+                        h_large = hist_fn(binned, g3, leaf_id, larger)
+                    h_left = jnp.where(smaller_is_left, h_small, h_large)
+                    h_right = jnp.where(smaller_is_left, h_large, h_small)
+                    pool = st.hist_pool
 
                 d = st.leaf_depth[leaf] + 1
                 depth_ok = (max_depth <= 0) | (d < max_depth)
@@ -897,9 +947,10 @@ def make_levelwise_grower(
             f_row = feat_l[lid_c]
             in_split = split_mask[lid_c] & (leaf_id < Ld)
             b_row = bins_of_rows_fn(binned, f_row)
-            is_na = (meta.missing_type[f_row] == MISSING_NAN) & (
-                b_row == meta.nan_bin[f_row]
-            )
+            is_na = ((meta.missing_type[f_row] == MISSING_NAN)
+                     & (b_row == meta.nan_bin[f_row])) | (
+                (meta.missing_type[f_row] == MISSING_ZERO)
+                & (b_row == meta.zero_bin[f_row]))
             go_left = jnp.where(is_na, dl_l[lid_c], b_row <= thr_l[lid_c])
             # categorical rows: bin-space bitset membership
             bi = b_row.astype(jnp.int32)

@@ -54,7 +54,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
-from ..io.binning import MISSING_NAN
+from ..io.binning import MISSING_NAN, MISSING_ZERO
 from ..ops.split import (
     NO_CONSTRAINT,
     FeatureMeta,
@@ -332,25 +332,33 @@ def make_wave_grower(
             # Batched over the wave: (K, N) intermediates stream once
             # instead of K sequential read-modify-write passes over (N,)
             # accumulators (each pass re-reads ~5 N-sized arrays).
-            leaf_id = st.leaf_id
-            bins_k = jax.vmap(lambda f: bins_of_fn(binned, f))(feats)  # (K,N)
-            bins_k = bins_k.astype(jnp.int32)
-            is_na = (meta.missing_type[feats][:, None] == MISSING_NAN) & (
-                bins_k == meta.nan_bin[feats][:, None])
-            gl = jnp.where(is_na, dls[:, None], bins_k <= thrs[:, None])
-            if use_cat:  # categorical bitset membership (bin-space)
-                word = jnp.zeros((K, N), jnp.uint32)
-                for wv in range(W):
-                    word = jnp.where((bins_k >> 5) == wv,
-                                     bitsets[:, wv][:, None], word)
-                in_set = ((word >> (bins_k.astype(jnp.uint32) & 31)) & 1) == 1
-                gl = jnp.where(iscats[:, None], in_set, gl)
-            mine = valid[:, None] & (leaf_id[None, :] == leafs[:, None])
-            go_r = mine & (~gl)                               # (K, N) disjoint
-            leaf_id = leaf_id + jnp.sum(
-                jnp.where(go_r, nls[:, None] - leaf_id[None, :], 0), axis=0)
-            slot = 2 * kiota[:, None] + (~gl).astype(jnp.int32)
-            label = jnp.sum(jnp.where(mine, slot - 2 * K, 0), axis=0) + 2 * K
+            with jax.named_scope("lgbm.partition"):
+                leaf_id = st.leaf_id
+                bins_k = jax.vmap(
+                    lambda f: bins_of_fn(binned, f))(feats)   # (K, N)
+                bins_k = bins_k.astype(jnp.int32)
+                mt_k = meta.missing_type[feats][:, None]
+                is_na = ((mt_k == MISSING_NAN)
+                         & (bins_k == meta.nan_bin[feats][:, None])) | (
+                    (mt_k == MISSING_ZERO)
+                    & (bins_k == meta.zero_bin[feats][:, None]))
+                gl = jnp.where(is_na, dls[:, None], bins_k <= thrs[:, None])
+                if use_cat:  # categorical bitset membership (bin-space)
+                    word = jnp.zeros((K, N), jnp.uint32)
+                    for wv in range(W):
+                        word = jnp.where((bins_k >> 5) == wv,
+                                         bitsets[:, wv][:, None], word)
+                    in_set = ((word >> (bins_k.astype(jnp.uint32) & 31))
+                              & 1) == 1
+                    gl = jnp.where(iscats[:, None], in_set, gl)
+                mine = valid[:, None] & (leaf_id[None, :] == leafs[:, None])
+                go_r = mine & (~gl)                           # (K, N) disjoint
+                leaf_id = leaf_id + jnp.sum(
+                    jnp.where(go_r, nls[:, None] - leaf_id[None, :], 0),
+                    axis=0)
+                slot = 2 * kiota[:, None] + (~gl).astype(jnp.int32)
+                label = jnp.sum(jnp.where(mine, slot - 2 * K, 0),
+                                axis=0) + 2 * K
 
             # ---- one batched histogram pass for all 2K children -----------
             hist = hist_wave_fn(binned, g3, label, 2 * K)     # (2K, F, B, 3)

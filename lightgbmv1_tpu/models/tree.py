@@ -86,6 +86,7 @@ def tree_leaf_index_binned(
     missing_types: jax.Array,  # (F,) int32
     bundle=None,              # io/bundle.py BundleArrays when EFB applied
     packed: bool = False,     # 4-bit packed bins (two features per byte)
+    zero_bins=None,           # (F,) int32 — zero-as-missing routing
 ) -> jax.Array:               # (N,) int32 leaf index per row
     N = binned.shape[1]
 
@@ -111,6 +112,12 @@ def tree_leaf_index_binned(
         t = tree.threshold_bin[nd]
         dl = tree.default_left[nd]
         is_na = (missing_types[f] == MISSING_NAN) & (b == nan_bins[f])
+        if zero_bins is not None:
+            # zero-as-missing rows follow the node's default direction
+            # (reference NumericalDecision MissingType::Zero, tree.h:~430;
+            # training-side the zero mass rides the scan direction)
+            is_na = is_na | ((missing_types[f] == MISSING_ZERO)
+                             & (b == zero_bins[f]))
         go_left = jnp.where(is_na, dl, b <= t)
         # categorical: bitset membership (reference CategoricalDecisionInner,
         # tree.h:322-335); the other/unseen bin is never in the set => right
@@ -163,9 +170,9 @@ def leaf_path_features(tree: TreeArrays, num_features: int) -> jax.Array:
 
 
 def tree_predict_binned(tree, binned, nan_bins, missing_types, bundle=None,
-                        packed: bool = False):
+                        packed: bool = False, zero_bins=None):
     leaf = tree_leaf_index_binned(tree, binned, nan_bins, missing_types,
-                                  bundle, packed)
+                                  bundle, packed, zero_bins)
     return tree.leaf_value[leaf]
 
 

@@ -581,10 +581,17 @@ class LambdarankNDCG(ObjectiveFunction):
 
 
 class RankXENDCG(ObjectiveFunction):
-    """reference: rank_objective.hpp:288 — cross-entropy NDCG surrogate."""
+    """reference: rank_objective.hpp:288 — cross-entropy NDCG surrogate.
+
+    The ground-truth distribution is stochastic: ``Phi(l, g) = 2^l - g``
+    with ``g ~ U(0, 1)`` re-drawn per document per iteration from a stream
+    seeded by ``objective_seed`` (reference rank_objective.hpp:301,327 —
+    ``rands_[query_id].NextFloat()`` with ``seed_ = config.objective_seed``).
+    """
 
     name = "rank_xendcg"
     is_ranking = True
+    is_stochastic = True   # get_gradients wants the iteration index
 
     def init(self, metadata, num_data):
         super().init(metadata, num_data)
@@ -595,13 +602,24 @@ class RankXENDCG(ObjectiveFunction):
         self.q_idx = jnp.asarray(idx)
         self.q_mask = jnp.asarray(mask)
         lbl = self._np_label
-        phi = np.power(2.0, lbl) - 1.0                  # reference Phi(l)
-        self._phi = jnp.asarray(phi, jnp.float32)
+        # reference Phi uses the integer part of the label
+        self._pow2 = jnp.asarray(np.power(2.0, np.trunc(lbl)), jnp.float32)
+        self._seed_key = jax.random.PRNGKey(self.config.objective_seed)
+        self._host_iter = 0
 
-    def get_gradients(self, s):
+    def get_gradients(self, s, iteration=None):
+        if iteration is None:
+            # untraced host path (custom loops); the fused/scanned step
+            # passes the traced iteration index instead
+            iteration = self._host_iter
+            self._host_iter += 1
+        gamma = jax.random.uniform(
+            jax.random.fold_in(self._seed_key, iteration),
+            self._pow2.shape)
+        phi_doc = self._pow2 - gamma
         q_idx, q_mask = self.q_idx, self.q_mask
         scores = jnp.where(q_mask, s[q_idx], -jnp.inf)
-        phi = jnp.where(q_mask, self._phi[q_idx], 0.0)
+        phi = jnp.where(q_mask, phi_doc[q_idx], 0.0)
         rho = jax.nn.softmax(scores, axis=1)            # (Q, M)
         phi_sum = phi.sum(axis=1, keepdims=True)
         l1 = jnp.where(phi_sum > 0, phi / jnp.maximum(phi_sum, 1e-20), 0.0)

@@ -162,20 +162,23 @@ def hist_one_leaf(
     smaller-child pass of the histogram-subtraction trick (reference:
     ``BeforeFindBestSplit`` serial_tree_learner.cpp:274-314 keeps the parent
     histogram with the larger leaf and computes only the smaller)."""
-    mask = (leaf_id == target_leaf).astype(jnp.float32)
-    g3m = g3 * mask[:, None]
-    zeros = jnp.zeros_like(leaf_id)
-    if method == "pallas":
-        from .hist_pallas import hist_leaves_pallas
+    with jax.named_scope("lgbm.hist"):
+        mask = (leaf_id == target_leaf).astype(jnp.float32)
+        g3m = g3 * mask[:, None]
+        zeros = jnp.zeros_like(leaf_id)
+        if method == "pallas":
+            from .hist_pallas import hist_leaves_pallas
 
-        return hist_leaves_pallas(binned, g3m, zeros, 1, num_bins,
-                                  precision=precision, packed=packed,
-                                  num_features=num_features)[0]
-    if packed:
-        raise ValueError("4-bit packed bins require the pallas hist method")
-    if method == "onehot":
-        return hist_leaves_onehot(binned, g3m, zeros, 1, num_bins, precision)[0]
-    return hist_leaves_scatter(binned, g3m, zeros, 1, num_bins)[0]
+            return hist_leaves_pallas(binned, g3m, zeros, 1, num_bins,
+                                      precision=precision, packed=packed,
+                                      num_features=num_features)[0]
+        if packed:
+            raise ValueError(
+                "4-bit packed bins require the pallas hist method")
+        if method == "onehot":
+            return hist_leaves_onehot(binned, g3m, zeros, 1, num_bins,
+                                      precision)[0]
+        return hist_leaves_scatter(binned, g3m, zeros, 1, num_bins)[0]
 
 
 def hist_frontier(
@@ -189,18 +192,26 @@ def hist_frontier(
     packed: bool = False,
     num_features: int = 0,
 ) -> jax.Array:
-    """All-leaves histogram in a single pass (level-wise grower)."""
-    if method == "pallas":
-        from .hist_pallas import hist_leaves_pallas
+    """All-leaves histogram in a single pass (level-wise grower).
 
-        return hist_leaves_pallas(binned, g3, leaf_id, num_leaves, num_bins,
-                                  precision=precision, packed=packed,
-                                  num_features=num_features)
-    if packed:
-        raise ValueError("4-bit packed bins require the pallas hist method")
-    if method == "onehot":
-        return hist_leaves_onehot(binned, g3, leaf_id, num_leaves, num_bins, precision)
-    return hist_leaves_scatter(binned, g3, leaf_id, num_leaves, num_bins)
+    Wrapped in ``jax.named_scope`` so device traces attribute histogram
+    time the way the reference's USE_TIMETAG FunctionTimer tags host time
+    (utils/common.h:1054-1138); capture a trace with ``profile_dir``."""
+    with jax.named_scope("lgbm.hist"):
+        if method == "pallas":
+            from .hist_pallas import hist_leaves_pallas
+
+            return hist_leaves_pallas(binned, g3, leaf_id, num_leaves,
+                                      num_bins, precision=precision,
+                                      packed=packed,
+                                      num_features=num_features)
+        if packed:
+            raise ValueError(
+                "4-bit packed bins require the pallas hist method")
+        if method == "onehot":
+            return hist_leaves_onehot(binned, g3, leaf_id, num_leaves,
+                                      num_bins, precision)
+        return hist_leaves_scatter(binned, g3, leaf_id, num_leaves, num_bins)
 
 
 def hist_wave(

@@ -32,7 +32,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
-from ..io.binning import MISSING_NAN, MISSING_ZERO
+from ..io.binning import MISSING_NAN, MISSING_NONE, MISSING_ZERO
 
 NEG_INF = -jnp.inf
 
@@ -83,10 +83,18 @@ def threshold_l1(s: jax.Array, l1: float) -> jax.Array:
 
 
 def leaf_gain(g: jax.Array, h: jax.Array, p: SplitParams) -> jax.Array:
-    """reference: GetLeafGain (no max_delta_step / path smoothing branch),
-    feature_histogram.hpp:~760."""
-    t = threshold_l1(g, p.lambda_l1)
-    return (t * t) / (h + p.lambda_l2)
+    """reference: GetLeafGain, feature_histogram.hpp:823-839.
+
+    With ``max_delta_step > 0`` (USE_MAX_OUTPUT) the reference evaluates the
+    gain AT the clamped output via GetLeafGainGivenOutput instead of the
+    closed form — the closed form would overstate the gain of leaves whose
+    unconstrained optimum exceeds the clamp (feature_histogram.hpp:833-838).
+    The smoothing counterpart lives in the callers (smooth_output needs the
+    leaf count, which this signature doesn't carry)."""
+    if isinstance(p.max_delta_step, (int, float)) and p.max_delta_step <= 0:
+        t = threshold_l1(g, p.lambda_l1)
+        return (t * t) / (h + p.lambda_l2)
+    return leaf_gain_given_output(g, h, leaf_output(g, h, p), p)
 
 
 def leaf_output(g: jax.Array, h: jax.Array, p: SplitParams) -> jax.Array:
@@ -379,6 +387,16 @@ def find_best_split(
     rand_key: Optional[jax.Array] = None,    # extra_trees threshold sampling
     cegb_penalty: Optional[jax.Array] = None,  # (F,) CEGB gain penalty
 ) -> SplitResult:
+    with jax.named_scope("lgbm.split"):
+        return _find_best_split(hist, parent_sum, meta, feature_mask, params,
+                                constraint, depth, monotone_penalty,
+                                parent_output, rand_key, cegb_penalty)
+
+
+def _find_best_split(
+    hist, parent_sum, meta, feature_mask, params, constraint=None, depth=0,
+    monotone_penalty=0.0, parent_output=0.0, rand_key=None, cegb_penalty=None,
+) -> SplitResult:
     F, B, _ = hist.shape
     total_g, total_h, total_c = parent_sum[0], parent_sum[1], parent_sum[2]
 
@@ -396,12 +414,29 @@ def find_best_split(
         jnp.maximum(meta.nan_bin, 0)[:, None, None].repeat(3, axis=2),
         axis=1,
     )[:, 0, :]                                        # (F, 3)
-    has_nan_dir = (meta.missing_type == MISSING_NAN)[:, None]  # (F, 1)
+    is_nan_f = (meta.missing_type == MISSING_NAN)[:, None]     # (F, 1)
+    is_zero_f = (meta.missing_type == MISSING_ZERO)[:, None]   # (F, 1)
+    has_miss_dir = is_nan_f | is_zero_f
+
+    # MISSING_ZERO: the reference's two scans SKIP the default (zero) bin
+    # while accumulating (FindBestThresholdSequentially SKIP_DEFAULT_BIN,
+    # feature_histogram.hpp:879-882,968-971), so the zero-bin mass rides
+    # with the missing direction — left in the reverse scan, right in the
+    # forward scan — INDEPENDENT of where the threshold falls relative to
+    # the zero bin.
+    zero_contrib = jnp.take_along_axis(
+        hist, meta.zero_bin[:, None, None].repeat(3, axis=2),
+        axis=1)[:, 0, :]                              # (F, 3)
+    zb = meta.zero_bin[:, None]                       # (F, 1)
 
     # direction 0: missing/default right (forward scan)
-    left_a = cum                                       # (F, B, 3)
+    left_a = cum - jnp.where(
+        (is_zero_f & (t_idx >= zb))[..., None], zero_contrib[:, None, :], 0.0)
     # direction 1: missing joins the left side (reverse scan equivalent)
-    left_b = cum + nan_contrib[:, None, :]
+    left_b = cum + jnp.where(
+        is_nan_f[..., None], nan_contrib[:, None, :],
+        jnp.where((is_zero_f & (t_idx < zb))[..., None],
+                  zero_contrib[:, None, :], 0.0))
 
     def eval_direction(left):
         lg, lh, lc = left[..., 0], left[..., 1], left[..., 2]
@@ -448,7 +483,7 @@ def find_best_split(
         base_valid = base_valid & (t_idx == rand_bin[:, None])
     gain_a = jnp.where(base_valid, eval_direction(left_a), NEG_INF)
     gain_b = jnp.where(
-        base_valid & has_nan_dir, eval_direction(left_b), NEG_INF
+        base_valid & has_miss_dir, eval_direction(left_b), NEG_INF
     )
 
     if use_smooth:
@@ -478,13 +513,31 @@ def find_best_split(
         factor = monotone_penalty_factor(jnp.asarray(depth), monotone_penalty)
         mono_f = (meta.monotone_type != 0)[None, :, None]
         gains = jnp.where(finite & mono_f, gains * factor, gains)
-    flat = gains.reshape(-1)
-    best = jnp.argmax(flat)
-    best_gain = flat[best]
 
-    direction = (best // (F * B)).astype(jnp.int32)
-    feature = ((best // B) % F).astype(jnp.int32)
-    threshold = (best % B).astype(jnp.int32)
+    # Tie-breaking (matters when gains plateau, e.g. under max_delta_step
+    # clamping).  The reference evaluates the REVERSE scan first and the
+    # forward scan replaces only on strictly greater gain
+    # (FuncForNumricalL3, feature_histogram.hpp:157-215), and each scan
+    # keeps the FIRST candidate seen (`current_gain > best_gain`,
+    # :928,1002): reverse = highest threshold, forward = lowest.  For
+    # missing-none (or 2-bin) features only the reverse scan runs, so our
+    # direction-0 candidates inherit its highest-threshold preference.
+    # Cross-feature ties pick the smaller feature (SplitInfo::operator>,
+    # split_info.hpp:147-152) — argmax first-occurrence order below.
+    rev_like_a = ((meta.missing_type == MISSING_NONE)
+                  | (meta.num_bins <= 2))[:, None]        # (F, 1)
+    pref_a = jnp.where(rev_like_a, 2 * B + t_idx, B - 1 - t_idx)
+    pref_b = jnp.broadcast_to(2 * B + t_idx, (F, B))
+    gains_f = jnp.concatenate([gains[0], gains[1]], axis=1)   # (F, 2B)
+    pref_f = jnp.concatenate([pref_a, pref_b], axis=1)        # (F, 2B)
+    fbest = gains_f.max(axis=1)                               # (F,)
+    sel_f = jnp.argmax(jnp.where(gains_f == fbest[:, None], pref_f, -1),
+                       axis=1)                                # (F,)
+    feature = jnp.argmax(fbest).astype(jnp.int32)   # first max = min feature
+    best_gain = fbest[feature]
+    sel = sel_f[feature]
+    direction = (sel // B).astype(jnp.int32)
+    threshold = (sel % B).astype(jnp.int32)
 
     left = jnp.where(direction == 0, left_a[feature, threshold],
                      left_b[feature, threshold])
@@ -512,13 +565,12 @@ def find_best_split(
 
     right = parent_sum - left
 
-    # default direction for missing values at prediction time
+    # default direction for missing values at prediction time: the side the
+    # missing mass (NaN bin / zero bin) was accumulated on
     mtype = meta.missing_type[feature]
     default_left = jnp.where(
-        mtype == MISSING_NAN,
-        direction == 1,
-        jnp.where(mtype == MISSING_ZERO, meta.zero_bin[feature] <= threshold, False),
-    )
+        (mtype == MISSING_NAN) | (mtype == MISSING_ZERO),
+        direction == 1, False)
     default_left = default_left & (~is_cat)
 
     # best_gain is already relative (shift subtracted before the argmax)
@@ -552,10 +604,15 @@ def per_feature_best_gain(
     cum = jnp.cumsum(hist, axis=1)
     t_idx = lax.broadcasted_iota(jnp.int32, (F, B), 1)
     nb = meta.num_bins[:, None]
+    is_nan_f = (meta.missing_type == MISSING_NAN)[:, None]
+    is_zero_f = (meta.missing_type == MISSING_ZERO)[:, None]
     nan_contrib = jnp.take_along_axis(
         hist, jnp.maximum(meta.nan_bin, 0)[:, None, None].repeat(3, axis=2),
         axis=1)[:, 0, :]
-    has_nan_dir = (meta.missing_type == MISSING_NAN)[:, None]
+    zero_contrib = jnp.take_along_axis(
+        hist, meta.zero_bin[:, None, None].repeat(3, axis=2),
+        axis=1)[:, 0, :]
+    zb = meta.zero_bin[:, None]
 
     def gains_for(left):
         lg, lh, lc = left[..., 0], left[..., 1], left[..., 2]
@@ -569,9 +626,17 @@ def per_feature_best_gain(
 
     valid = (t_idx <= nb - 2) & feature_mask[:, None] & meta.usable[:, None] \
         & (~meta.is_categorical[:, None])
-    ga = jnp.where(valid, gains_for(cum), NEG_INF)
-    gb = jnp.where(valid & has_nan_dir,
-                   gains_for(cum + nan_contrib[:, None, :]), NEG_INF)
+    # missing-direction accounting mirrors find_best_split (zero-as-missing
+    # mass rides the scan direction, SKIP_DEFAULT_BIN semantics)
+    left_a = cum - jnp.where(
+        (is_zero_f & (t_idx >= zb))[..., None], zero_contrib[:, None, :], 0.0)
+    left_b = cum + jnp.where(
+        is_nan_f[..., None], nan_contrib[:, None, :],
+        jnp.where((is_zero_f & (t_idx < zb))[..., None],
+                  zero_contrib[:, None, :], 0.0))
+    ga = jnp.where(valid, gains_for(left_a), NEG_INF)
+    gb = jnp.where(valid & (is_nan_f | is_zero_f),
+                   gains_for(left_b), NEG_INF)
     best = jnp.maximum(ga.max(axis=1), gb.max(axis=1))
     # votes rank RELATIVE gains with the feature_contri penalty applied,
     # like the full search (the constant shift is rank-neutral without

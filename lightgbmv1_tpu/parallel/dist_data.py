@@ -19,13 +19,12 @@ Use after ``cluster.init_cluster``::
     init_cluster(...)
     ds = load_distributed(path, config)     # local row shard, global bins
 
-Current trainer contract: the data/feature/voting learners consume a
-host-replicated dataset (every process passes the same full array and
-contributes its addressable device shards).  ``load_distributed`` provides
-the loader-level rank pre-partition and the cross-process bin agreement;
-feeding process-local shards straight into the trainer (global arrays via
-``jax.make_array_from_process_local_data`` for scores/labels as well) is
-the designed next step and the shapes here are already consistent with it.
+Trainer contract: ``load_distributed`` provides the loader-level rank
+pre-partition and the cross-process bin agreement, and
+``make_process_sharded`` (below) converts the local shard into the
+process-sharded storage the data-parallel trainer consumes directly
+(``parallel/trainer.py row_sharded``) — each process keeps only its own
+binned rows, with labels/weights allgathered for objectives/metrics.
 """
 
 from __future__ import annotations
@@ -42,8 +41,8 @@ from ..utils.log import log_info
 
 
 def find_bins_distributed(local_samples: List[np.ndarray], sample_cnt: int,
-                          max_bins, categorical, config: Config
-                          ) -> List[BinMapper]:
+                          max_bins, categorical, config: Config,
+                          num_data: int = 0) -> List[BinMapper]:
     """Bin-finding with cross-process sample allgather (the analog of the
     reference's serialized-mapper Allgather, dataset_loader.cpp:913-996).
 
@@ -81,9 +80,12 @@ def find_bins_distributed(local_samples: List[np.ndarray], sample_cnt: int,
                 [vals, np.full(int(na_all[j]), np.nan)]))
         total_cnt = int(multihost_utils.process_allgather(
             np.asarray(sample_cnt)).sum())
+        total_rows = int(multihost_utils.process_allgather(
+            np.asarray(num_data)).sum())
     else:
         samples = local_samples
         total_cnt = sample_cnt
+        total_rows = num_data
 
     from ..io.binning import get_forced_bins
 
@@ -99,6 +101,9 @@ def find_bins_distributed(local_samples: List[np.ndarray], sample_cnt: int,
             use_missing=config.use_missing,
             zero_as_missing=config.zero_as_missing,
             forced_bounds=forced[j],
+            pre_filter=config.feature_pre_filter,
+            filter_cnt=int(config.min_data_in_leaf * total_cnt
+                           / max(total_rows, total_cnt, 1)),
         )
         for j in range(len(samples))
     ]

@@ -159,7 +159,9 @@ def parse_forced_splits(filename: str, bin_mappers, num_leaves: int):
 
     if not filename:
         return None
-    with open(filename) as fh:
+    from ..utils.fileio import open_file
+
+    with open_file(filename) as fh:
         spec = json.load(fh)
     if not spec:
         return None
@@ -339,6 +341,9 @@ def build_trainer(
     wave_common = {k: v for k, v in common.items() if k != "cegb_coupled"}
     wave_common["wave_size"] = wave_size
     wave_common["monotone_mode"] = mono_mode
+    # sequential-grower histogram pool cap (reference histogram_pool_size;
+    # the wave/level growers use frontier-sized buffers and need no cap)
+    lw_pool = dict(hist_pool_mb=config.histogram_pool_size, num_features=F)
     forced = None
     if config.forcedsplits_filename:
         if bin_mappers is None:
@@ -370,7 +375,7 @@ def build_trainer(
                 cegb_lazy=cegb_lazy,
                 partition=(config.tree_growth != "leafwise_masked"
                            and cegb_lazy is None),
-                **common)
+                **lw_pool, **common)
         return jax.jit(grow), jnp.asarray(binned_np), N
 
     if learner == "voting" and levelwise:
@@ -455,7 +460,7 @@ def build_trainer(
         else:
             grow = make_leafwise_grower(
                 hist_fn=hist_fn, split_fn=split_fn, sums_fn=sums_fn,
-                bins_of_fn=bins_feat_fn, **common)
+                bins_of_fn=bins_feat_fn, **lw_pool, **common)
         sharded = shard_map(
             grow,
             mesh=mesh,
@@ -540,7 +545,8 @@ def build_trainer(
             grow = make_leafwise_grower(hist_fn=hist_fn, sums_fn=sums_fn,
                                         split_fn=split_local,
                                         bins_of_fn=bins_feat_fn,
-                                        forced_splits=forced, **common)
+                                        forced_splits=forced,
+                                        **lw_pool, **common)
         sharded = shard_map(
             grow,
             mesh=mesh,
@@ -668,7 +674,8 @@ def build_trainer(
         else:
             grow = make_leafwise_grower(
                 hist_fn=hist_fn, split_fn=split_fn, cegb_coupled=coupled_fp,
-                **fp_kwargs)
+                hist_pool_mb=config.histogram_pool_size,
+                num_features=F_pad, **fp_kwargs)
         sharded = shard_map(
             grow,
             mesh=mesh,

@@ -72,8 +72,18 @@ def test_zero_bin_straddle(rng):
 
 
 def test_trivial_feature():
-    m = BinMapper.find_bin(np.full(100, 7.0), 100, max_bin=32)
+    # a constant nonzero feature has 2 formal bins (zero bin + value bin)
+    # and is only marked trivial by the feature_pre_filter pass — exact
+    # reference semantics (bin.cpp:493-502: is_trivial_ = num_bin_ <= 1,
+    # then NeedFilter with pre_filter)
+    m = BinMapper.find_bin(np.full(100, 7.0), 100, max_bin=32,
+                           pre_filter=True, filter_cnt=20)
     assert m.is_trivial
+    m2 = BinMapper.find_bin(np.full(100, 7.0), 100, max_bin=32)
+    assert m2.num_bin == 2 and not m2.is_trivial
+    # all-zero is trivial unconditionally (num_bin == 1)
+    m3 = BinMapper.find_bin(np.zeros(0), 100, max_bin=32)
+    assert m3.is_trivial
 
 
 def test_sparse_implicit_zeros():

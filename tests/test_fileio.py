@@ -1,0 +1,72 @@
+"""Remote storage IO (fsspec-backed paths) — the reference's
+VirtualFileReader/Writer + HDFS role (src/io/file_io.cpp:14-190).  Uses
+fsspec's ``memory://`` filesystem as the mock remote store: everything that
+works here works unchanged on gs:// from a TPU pod."""
+
+import numpy as np
+import pytest
+
+import lightgbmv1_tpu as lgb
+from lightgbmv1_tpu.utils import fileio
+from tests.conftest import make_binary_problem
+
+fsspec = pytest.importorskip("fsspec")
+
+
+def test_is_remote_path():
+    assert fileio.is_remote_path("gs://bucket/x.txt")
+    assert fileio.is_remote_path("memory://y.bin")
+    assert not fileio.is_remote_path("/tmp/x.txt")
+    assert not fileio.is_remote_path("rel/path.csv")
+    assert not fileio.is_remote_path("C:_not_a_scheme")
+
+
+def test_model_save_load_roundtrip_remote():
+    X, y = make_binary_problem(n=600, f=5)
+    bst = lgb.train({"objective": "binary", "num_leaves": 7,
+                     "verbosity": -1}, lgb.Dataset(X, label=y),
+                    num_boost_round=3)
+    uri = "memory://models/m1.txt"
+    bst.save_model(uri)
+    again = lgb.Booster(model_file=uri)
+    np.testing.assert_allclose(again.predict(X), bst.predict(X),
+                               rtol=1e-9, atol=1e-12)
+
+
+def test_binary_dataset_cache_roundtrip_remote():
+    from lightgbmv1_tpu.config import Config
+    from lightgbmv1_tpu.io.dataset import BinnedDataset
+
+    X, y = make_binary_problem(n=500, f=5)
+    cfg = Config.from_dict({"verbosity": -1})
+    ds = BinnedDataset.from_numpy(X, label=y, config=cfg)
+    uri = "memory://cache/train.bin"
+    ds.save_binary(uri)
+    assert BinnedDataset.is_binary_file(uri)
+    ds2 = BinnedDataset.load_binary(uri)
+    np.testing.assert_array_equal(np.asarray(ds2.binned),
+                                  np.asarray(ds.binned))
+    np.testing.assert_allclose(ds2.metadata.label, ds.metadata.label)
+    # and the Python API picks the cache up transparently
+    d = lgb.Dataset(uri, params={"verbosity": -1}).construct()
+    assert d._binned.num_data == 500
+
+
+def test_data_file_and_config_remote(tmp_path):
+    X, y = make_binary_problem(n=400, f=5)
+    rows = "\n".join(
+        "\t".join([f"{y[i]:g}"] + [f"{v:.6f}" for v in X[i]])
+        for i in range(len(y)))
+    with fileio.open_file("memory://data/train.tsv", "w") as fh:
+        fh.write(rows + "\n")
+    d = lgb.Dataset("memory://data/train.tsv", params={"verbosity": -1})
+    bst = lgb.train({"objective": "binary", "num_leaves": 7,
+                     "verbosity": -1}, d, num_boost_round=2)
+    assert bst.num_feature() == 5
+    # config files load from remote URIs too (Config.from_cli)
+    with fileio.open_file("memory://conf/train.conf", "w") as fh:
+        fh.write("objective = binary\nnum_leaves = 5\n")
+    from lightgbmv1_tpu.config import Config
+
+    cfg = Config.from_cli(["config=memory://conf/train.conf"])
+    assert cfg.objective == "binary" and cfg.num_leaves == 5

@@ -18,6 +18,64 @@ import sys
 import numpy as np
 import pytest
 
+_PROBE = r"""
+import os, sys
+rank = int(sys.argv[1]); port = sys.argv[2]
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.distributed.initialize(coordinator_address=f"127.0.0.1:{port}",
+                           num_processes=2, process_id=rank)
+print("PROBE OK")
+"""
+
+_grpc_ok_cache = {}
+
+
+def _grpc_coordination_works(tmp_path) -> bool:
+    """One cheap 2-process jax.distributed bootstrap.  If THIS succeeds but
+    the real test later times out, the timeout is a regression and must
+    FAIL; only a genuinely blocked sandbox (probe also times out) skips
+    (VERDICT r3 item 8)."""
+    if "ok" in _grpc_ok_cache:
+        return _grpc_ok_cache["ok"]
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    probe = tmp_path / "probe.py"
+    probe.write_text(_PROBE)
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))) + os.pathsep + env.get("PYTHONPATH", "")
+    env.pop("XLA_FLAGS", None)
+    env.pop("JAX_PLATFORMS", None)
+    procs = [subprocess.Popen(
+        [sys.executable, str(probe), str(r), str(port)], env=env,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+        for r in range(2)]
+    ok = True
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=90)
+            ok = ok and p.returncode == 0 and "PROBE OK" in out
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            ok = False
+    _grpc_ok_cache["ok"] = ok
+    return ok
+
+
+def _skip_or_fail_timeout(tmp_path):
+    if _grpc_coordination_works(tmp_path):
+        pytest.fail("jax.distributed coordination works in this sandbox "
+                    "(probe succeeded) but the training run timed out — "
+                    "a real multihost regression, not an environment skip")
+    pytest.skip("jax.distributed coordination blocked in this sandbox "
+                "(probe also timed out)")
+
+
 _WORKER = r"""
 import os, sys
 rank = int(sys.argv[1]); port = sys.argv[2]; outdir = sys.argv[3]
@@ -71,8 +129,7 @@ def test_two_process_data_parallel(tmp_path):
         except subprocess.TimeoutExpired:
             for q in procs:
                 q.kill()
-            pytest.skip("jax.distributed coordination timed out "
-                        "(gRPC blocked in this sandbox?)")
+            _skip_or_fail_timeout(tmp_path)
         outs.append(out)
     for r, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"rank {r} failed:\n{out[-3000:]}"
@@ -171,8 +228,7 @@ def test_two_process_sharded_storage(tmp_path):
         except subprocess.TimeoutExpired:
             for q in procs:
                 q.kill()
-            pytest.skip("jax.distributed coordination timed out "
-                        "(gRPC blocked in this sandbox?)")
+            _skip_or_fail_timeout(tmp_path)
         outs.append(out)
     for r, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"rank {r} failed:\n{out[-3000:]}"

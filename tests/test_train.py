@@ -234,6 +234,34 @@ def test_custom_gradients():
     assert mse < 0.3 * np.var(y)
 
 
+def test_dart_fused_matches_host_path():
+    """The single-dispatch fused DART iteration (DART._fused_dart_iter)
+    must reproduce the host-loop path (_host_train_one_iter) exactly:
+    same drop selection (same RNG stream), same normalization, same
+    scores — semantics of reference dart.hpp:23-170 either way."""
+    import lightgbmv1_tpu as lgb
+    X, y = make_binary_problem(800)
+    p = {"objective": "binary", "boosting": "dart", "drop_rate": 0.6,
+         "skip_drop": 0.0, "verbosity": -1, "min_data_in_leaf": 5,
+         "num_leaves": 15, "drop_seed": 9}
+    b_fused = lgb.train(p, lgb.Dataset(X, label=y), 10, verbose_eval=False)
+
+    from lightgbmv1_tpu.models.gbdt import DART
+
+    orig = DART.train_one_iter
+    try:
+        DART.train_one_iter = DART._host_train_one_iter
+        b_host = lgb.train(p, lgb.Dataset(X, label=y), 10,
+                           verbose_eval=False)
+    finally:
+        DART.train_one_iter = orig
+    np.testing.assert_allclose(b_fused.predict(X), b_host.predict(X),
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(
+        b_fused._gbdt.raw_train_scores(), b_host._gbdt.raw_train_scores(),
+        rtol=1e-5, atol=1e-6)
+
+
 def test_dart_predict_matches_scores():
     """DART drop-normalization must keep the saved model consistent with the
     cached training scores (incl. the embedded boost-from-average bias)."""
