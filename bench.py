@@ -63,61 +63,76 @@ def measure_hist_and_roofline(ds, N):
 
     from lightgbmv1_tpu.ops.histogram import hist_wave
 
-    SLOTS = 64            # the wave grower's 2K child slots at num_leaves=255
+    SLOTS = 64            # the wave grower's K+1 slots at auto K=64
     B = 64                # padded bin axis for max_bin=63
-    R = 10
-    binned = ds.device_binned()
+    binned = jnp.asarray(ds.train_matrix)
     F = binned.shape[0]
     rng = np.random.RandomState(7)
     g3 = jnp.asarray(rng.randn(N, 3).astype(np.float32))
     label = jnp.asarray(rng.randint(0, SLOTS, size=N).astype(np.int32))
 
-    @jax.jit
-    def hist_reps(binned, g3, label):
-        def body(c, i):
-            g = g3 * (1.0 + 1e-6 * i.astype(jnp.float32))   # defeat CSE
-            h = hist_wave(binned, g, label, SLOTS, B)
-            return c + h.sum(), None
-        s, _ = lax.scan(body, jnp.float32(0), jnp.arange(R))
-        return s
+    from lightgbmv1_tpu.ops.histogram import default_hist_method
 
-    jax.device_get(hist_reps(binned, g3, label))            # compile
-    best = 1e30
-    for _ in range(3):
-        t0 = time.time()
-        jax.device_get(hist_reps(binned, g3, label))
-        best = min(best, (time.time() - t0) / R)
-    hist_ms = best * 1e3
+    method = default_hist_method("auto", binned.dtype)
+
+    def timed_per_rep(make_reps, r1, r2):
+        """Per-rep seconds from a TWO-length-scan differential: wall(r2) -
+        wall(r1) over (r2 - r1) reps cancels dispatch latency and other
+        per-call fixed costs (the ~113 ms tunnel round-trip would otherwise
+        dominate and overstate per-rep time severalfold)."""
+        f1, f2 = make_reps(r1), make_reps(r2)
+        jax.device_get(f1())
+        jax.device_get(f2())
+        best = 1e30
+        for _ in range(3):
+            t0 = time.time()
+            jax.device_get(f1())
+            t1 = time.time()
+            jax.device_get(f2())
+            t2 = time.time()
+            best = min(best, ((t2 - t1) - (t1 - t0)) / (r2 - r1))
+        return max(best, 1e-9)
+
+    def hist_make(r):
+        @jax.jit
+        def reps():
+            def body(c, i):
+                g = g3 * (1.0 + 1e-6 * i.astype(jnp.float32))  # defeat CSE
+                h = hist_wave(binned, g, label, SLOTS, B, method=method)
+                return c + h.sum(), None
+            s, _ = lax.scan(body, jnp.float32(0), jnp.arange(r))
+            return s
+        return reps
+
+    per_pass = timed_per_rep(hist_make, 4, 16)
+    hist_ms = per_pass * 1e3
     # one-hot MXU formulation: (3*(SLOTS+1), rows) @ (rows, B*F) per pass,
     # bf16x2 = 2 passes (ops/hist_pallas.py)
     hist_flops = 2 * 3 * (SLOTS + 1) * N * B * F * 2
-    hist_tfs = hist_flops / best / 1e12
+    hist_tfs = hist_flops / per_pass / 1e12
 
     # device matmul peak, same session, same measurement discipline
     M = 4096
     a = jnp.asarray(rng.randn(M, M).astype(np.float32), jnp.bfloat16)
     b = jnp.asarray(rng.randn(M, M).astype(np.float32), jnp.bfloat16)
 
-    @jax.jit
-    def mm_reps(a, b):
-        def body(c, i):
-            out = jnp.dot(a * (1 + 1e-3 * i.astype(jnp.bfloat16)), b,
-                          preferred_element_type=jnp.float32)
-            return c + out.sum(), None
-        s, _ = lax.scan(body, jnp.float32(0), jnp.arange(R))
-        return s
+    def mm_make(r):
+        @jax.jit
+        def reps():
+            def body(c, i):
+                out = jnp.dot(a * (1 + 1e-3 * i.astype(jnp.bfloat16)), b,
+                              preferred_element_type=jnp.float32)
+                return c + out.sum(), None
+            s, _ = lax.scan(body, jnp.float32(0), jnp.arange(r))
+            return s
+        return reps
 
-    jax.device_get(mm_reps(a, b))
-    mm_best = 1e30
-    for _ in range(3):
-        t0 = time.time()
-        jax.device_get(mm_reps(a, b))
-        mm_best = min(mm_best, (time.time() - t0) / R)
-    peak_tfs = (2 * M ** 3) / mm_best / 1e12
+    peak_tfs = (2 * M ** 3) / timed_per_rep(mm_make, 8, 64) / 1e12
     return {
         "hist_ms_per_pass": round(hist_ms, 2),
-        # a 255-leaf wave tree runs ceil(254/32) = 8 wave rounds per iter
-        "hist_ms_per_iter": round(hist_ms * 8, 2),
+        # a 255-leaf wave tree runs ceil(254/64) = 4 wave rounds per iter
+        # (auto wave K = num_leaves/4, smaller-child subtraction pass)
+        "hist_ms_per_iter": round(hist_ms * 4, 2),
         "hist_achieved_tf_s": round(hist_tfs, 2),
         "device_matmul_peak_tf_s": round(peak_tfs, 2),
         "hist_roofline_frac": round(hist_tfs / peak_tfs, 4),
@@ -178,7 +193,11 @@ def main():
     row_trees_per_s = N * TREES / dt / 1e6
 
     # the reference's own policy: leaf-wise (best-first), wave-batched
-    # schedule (models/grower_wave.py)
+    # schedule with smaller-child subtraction (models/grower_wave.py), at
+    # the default bf16x2 histogram precision.  bf16 single-pass histograms
+    # are ~25% faster at 100-iter AUC parity but measurably lose AUC by
+    # 500 iterations (0.9095 vs 0.9126 measured round 4), so the headline
+    # stays at the precision that BEATS the reference's quality.
     cfg_lw = Config.from_dict({**{k: getattr(cfg, k) for k in (
         "objective", "num_leaves", "max_bin", "learning_rate",
         "min_data_in_leaf", "metric")}, "verbosity": -1,
@@ -240,11 +259,11 @@ def main():
                 "drop_rate": 0.1, "verbosity": -1,
                 "tree_growth": "leafwise"})
             gbd = create_boosting(cfg_dart, ds)
-            for _ in range(3):                       # warm both jit variants
+            for _ in range(8):   # warm the no-drop and P-bucket variants
                 gbd.train_one_iter(check_stop=False)
             sync_d = lambda: jax.device_get(gbd._train_scores.score)
             sync_d()
-            DIT = 12
+            DIT = 15
             t0 = time.time()
             for _ in range(DIT):
                 gbd.train_one_iter(check_stop=False)
@@ -287,28 +306,30 @@ def main():
 
     baseline = 10.5e6 * 500 / 130.094 / 1e6   # reference CPU HIGGS throughput
     print(json.dumps({
-        "metric": f"higgs-shaped binary training throughput ({backend}, "
-                  f"{N} rows, 28 feat, 63 bins, 255 leaves)",
-        "value": round(row_trees_per_s, 3),
+        # headline = leaf-wise (the reference's own growth policy), bf16
+        # device histograms (the reference's GPU-benchmark precision choice)
+        "metric": f"higgs-shaped binary training throughput, leaf-wise "
+                  f"({backend}, {N} rows, 28 feat, 63 bins, 255 leaves)",
+        "value": round(leafwise_mrt, 3),
         "unit": "M row-trees/s",
-        "vs_baseline": round(row_trees_per_s / baseline, 4),
-        "auc": round(auc, 5) if auc is not None else None,
+        "vs_baseline": round(leafwise_mrt / baseline, 4),
+        "auc": (round(leafwise_auc, 5)
+                if leafwise_auc is not None else None),
         "auc_ref_lightgbm_cpp": auc_ref,
-        "auc_iters": int(gbdt.iter),
-        "train_seconds_for_timed_block": round(dt, 3),
-        # the reference C++ CLI measured on THIS host's CPU (the 40.36 M
-        # row-trees/s baseline machine is a 28-core dual-Xeon; see PERF.md)
-        "ref_cpp_same_host_M_row_trees_per_s": ref_same_host_mrt,
-        "vs_ref_same_host": round(row_trees_per_s / ref_same_host_mrt, 4),
-        "leafwise_M_row_trees_per_s": round(leafwise_mrt, 3),
-        "leafwise_auc": (round(leafwise_auc, 5)
-                         if leafwise_auc is not None else None),
         # auc_iters fields record the ACTUAL tree counts behind each auc —
         # with BENCH_TREES overridden high the timed blocks can overshoot
         # AUC_ITERS, making the ref comparison no longer like-for-like
-        "leafwise_auc_iters": int(gb_lw.iter),
-        "leafwise_vs_ref_same_host": round(leafwise_mrt / ref_same_host_mrt,
-                                           4),
+        "auc_iters": int(gb_lw.iter),
+        # the reference C++ CLI measured on THIS host's CPU (the 40.36 M
+        # row-trees/s baseline machine is a 28-core dual-Xeon; see PERF.md)
+        "ref_cpp_same_host_M_row_trees_per_s": ref_same_host_mrt,
+        "vs_ref_same_host": round(leafwise_mrt / ref_same_host_mrt, 4),
+        "levelwise_M_row_trees_per_s": round(row_trees_per_s, 3),
+        "levelwise_auc": round(auc, 5) if auc is not None else None,
+        "levelwise_auc_iters": int(gbdt.iter),
+        "levelwise_vs_ref_same_host": round(
+            row_trees_per_s / ref_same_host_mrt, 4),
+        "train_seconds_for_timed_block": round(lw_dt, 3),
         **extra,
     }))
 

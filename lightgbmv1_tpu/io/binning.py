@@ -436,6 +436,12 @@ class BinMapper:
             m.missing_type = MISSING_NAN
         else:
             m.missing_type = MISSING_NONE
+        if m.missing_type != MISSING_NAN:
+            # reference bin.cpp:336-352: na_cnt is only tracked in the NaN
+            # branch; otherwise NaN samples fold into the implicit-zero
+            # count (under zero_as_missing they ARE the missing zeros)
+            implicit_zero_cnt += na_cnt
+            na_cnt = 0
 
         if len(vals) == 0 and implicit_zero_cnt == 0:
             # all NaN

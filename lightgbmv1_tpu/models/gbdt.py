@@ -525,13 +525,7 @@ class GBDT:
     def _gradients(self) -> Tuple[jax.Array, jax.Array]:
         score = self._train_scores.score
         s = score[:, 0] if self.num_class == 1 else score
-        if getattr(self.objective, "is_stochastic", False):
-            grad, hess = self.objective.get_gradients(
-                s, iteration=int(self.iter))
-            if grad.ndim == 1:
-                grad, hess = grad[:, None], hess[:, None]
-            return grad, hess
-        grad, hess = self.objective.get_gradients(s)
+        grad, hess = self._objective_grads(s, int(self.iter))
         if grad.ndim == 1:
             grad, hess = grad[:, None], hess[:, None]
         return grad, hess
@@ -1063,11 +1057,12 @@ class DART(GBDT):
         shrink_new, old_factor, w_dec = self._normalization(k_drop)
         self._snapshot_dropped(drop_iters)
 
-        # padded drop stack: P = next power of two covering k_drop*K slots
+        # padded drop stack: fixed bucket sizes keep the number of compiled
+        # step variants tiny (each new P is a full recompile of the fused
+        # iteration — the dominant DART cost if P tracked k_drop exactly)
         n_real = k_drop * K
-        P = 1
-        while P < n_real:
-            P *= 2
+        P = next(b for b in (4, 16, 64, 256, 1024) if b >= n_real) \
+            if n_real <= 1024 else n_real
         entries, weights = [], np.zeros((P, K), np.float32)
         for j, it in enumerate(drop_iters):
             for k in range(K):

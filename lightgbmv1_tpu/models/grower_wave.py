@@ -16,22 +16,24 @@ computes the histograms of all ``2K`` children in ONE batched device pass:
 
 * the per-split ``DataPartition::Split`` scatter (data_partition.hpp:101)
   becomes one vectorized decision pass over all rows for all K splits,
-* the smaller-child histogram + parent subtraction
-  (``FeatureHistogram::Subtract``, feature_histogram.hpp:79) is replaced by
-  labeling every row of a split leaf with its child slot and building all
-  child histograms in one masked one-hot-matmul pass (ops/histogram.py) —
-  on the MXU a 2K-slot pass costs the same as a 1-slot pass, so the
-  subtraction trick buys nothing and the histogram pool state disappears,
+* the smaller-child + subtraction trick (``BeforeFindBestSplit``
+  serial_tree_learner.cpp:274-314, ``FeatureHistogram::Subtract``
+  feature_histogram.hpp:79) is kept, batched: rows of the SMALLER child of
+  each of the K splits are labeled with their slot and all K smaller-child
+  histograms are built in one masked one-hot-matmul pass
+  (ops/histogram.py); the larger children come from the per-leaf histogram
+  state by subtraction.  This halves the MXU pass (K+1 slots instead of
+  2K+1) and, in data-parallel mode, the histogram psum volume.  Wide-F
+  configs whose (L, F, B, 3) state would exceed 512 MB fall back to the
+  pool-free 2K-slot pass,
 * split finding for the 2K children is one ``vmap`` of the vectorized scan
   (ops/split.py), the analog of ``FindBestSplitsFromHistograms``' OMP loop
   (serial_tree_learner.cpp:358-425).
 
 At ``K = 1`` the schedule IS the reference's best-first order (one leaf per
 round, ranked by argmax over the frontier) and reproduces the sequential
-grower's trees split-for-split up to fp summation differences — the
-sequential grower derives the larger child histogram by parent subtraction
-while this one computes both children directly, so histogram values can
-differ at the ulp level and flip near-tie splits (tests/test_wave_grower.py).  At ``K > 1`` the tree
+grower's trees split-for-split (both use parent subtraction; fp summation
+noise can still flip exact near-ties, tests/test_wave_grower.py).  At ``K > 1`` the tree
 can deviate from strict best-first only through the budget boundary: a
 round commits its top-K leaves together, so children created inside the
 round cannot displace the round's lower-ranked picks.  Rounds are
@@ -132,6 +134,11 @@ def intermediate_constraints(boxes, outs, num_leaves, mono_feats,
 
 class WaveState(NamedTuple):
     leaf_id: jax.Array        # (N,) int32 — current leaf of every row
+    leaf_hist: jax.Array      # (L, F, B, 3) — per-leaf histograms enabling
+                              # the smaller-child + subtraction trick
+                              # (reference BeforeFindBestSplit +
+                              # FeatureHistogram::Subtract); (1, F, B, 3)
+                              # dummy when the state would exceed the cap
     best_gain: jax.Array      # (L,) — frontier priority queue (−inf = closed)
     best_feat: jax.Array      # (L,) int32
     best_bin: jax.Array       # (L,) int32
@@ -252,6 +259,13 @@ def make_wave_grower(
 
         leaf_id0 = jnp.zeros(N, jnp.int32)
         hist0 = hist_wave_fn(binned, g3, leaf_id0, 1)[0]
+        # smaller-child + subtraction mode: build K child histograms per
+        # round instead of 2K (halves the one-hot MXU pass and, in
+        # data-parallel mode, the psum volume — the reference's
+        # smaller-leaf trick, serial_tree_learner.cpp:274-314), deriving
+        # the larger child from the per-leaf histogram state.  Skipped
+        # when that state would exceed 512 MB (wide-F configs).
+        use_sub = (L * int(np.prod(hist0.shape)) * 4) <= 512 * (1 << 20)
         root_sum = sums_fn(g3)
         mask0 = _node_feature_mask(key, 0, base_mask, feature_fraction_bynode)
         mask0 = mask0 & allowed_features(jnp.zeros(F, bool))
@@ -263,6 +277,10 @@ def make_wave_grower(
 
         st = WaveState(
             leaf_id=leaf_id0,
+            leaf_hist=(jnp.zeros((L,) + hist0.shape,
+                                 jnp.float32).at[0].set(hist0)
+                       if use_sub
+                       else jnp.zeros((1,) + hist0.shape, jnp.float32)),
             best_gain=jnp.full(L, -jnp.inf, jnp.float32).at[0].set(res0.gain),
             best_feat=jnp.zeros(L, jnp.int32).at[0].set(res0.feature),
             best_bin=jnp.zeros(L, jnp.int32).at[0].set(res0.threshold_bin),
@@ -356,12 +374,31 @@ def make_wave_grower(
                 leaf_id = leaf_id + jnp.sum(
                     jnp.where(go_r, nls[:, None] - leaf_id[None, :], 0),
                     axis=0)
-                slot = 2 * kiota[:, None] + (~gl).astype(jnp.int32)
-                label = jnp.sum(jnp.where(mine, slot - 2 * K, 0),
-                                axis=0) + 2 * K
+                if use_sub:
+                    # label only the SMALLER child of each split (known
+                    # up front from the recorded left/right counts)
+                    sm_left = lsums[:, 2] <= rsums[:, 2]      # (K,)
+                    in_small = gl == sm_left[:, None]
+                    label = jnp.sum(
+                        jnp.where(mine & in_small, kiota[:, None] - K, 0),
+                        axis=0) + K
+                else:
+                    slot = 2 * kiota[:, None] + (~gl).astype(jnp.int32)
+                    label = jnp.sum(jnp.where(mine, slot - 2 * K, 0),
+                                    axis=0) + 2 * K
 
-            # ---- one batched histogram pass for all 2K children -----------
-            hist = hist_wave_fn(binned, g3, label, 2 * K)     # (2K, F, B, 3)
+            if use_sub:
+                # ---- K-slot smaller-child pass + subtraction -------------
+                h_small = hist_wave_fn(binned, g3, label, K)  # (K, F, B, 3)
+                h_parent = st.leaf_hist[leafs]
+                smL = sm_left[:, None, None, None]
+                h_left = jnp.where(smL, h_small, h_parent - h_small)
+                h_right = h_parent - h_left
+                hist = jnp.stack([h_left, h_right], axis=1).reshape(
+                    (2 * K,) + h_left.shape[1:])
+            else:
+                # ---- one batched histogram pass for all 2K children ------
+                hist = hist_wave_fn(binned, g3, label, 2 * K)  # (2K, F, B, 3)
 
             # ---- children metadata --------------------------------------
             cleafs = jnp.stack([leafs, nls], axis=1).reshape(2 * K)
@@ -502,6 +539,9 @@ def make_wave_grower(
 
             return WaveState(
                 leaf_id=leaf_id,
+                leaf_hist=(st.leaf_hist.at[lidx].set(h_left, mode="drop")
+                           .at[nlidx].set(h_right, mode="drop")
+                           if use_sub else st.leaf_hist),
                 best_gain=st.best_gain.at[cidx].set(cgain, mode="drop"),
                 best_feat=st.best_feat.at[cidx].set(res.feature, mode="drop"),
                 best_bin=st.best_bin.at[cidx].set(res.threshold_bin,

@@ -291,8 +291,13 @@ def build_trainer(
                 or bool(config.cegb_penalty_feature_lazy))
     cegb_lazy = _cegb_lazy(config, F, learner, levelwise)
     wave_size = config.leafwise_wave_size
-    if wave_size == 0:   # auto: batched for big trees, sequential for small
-        wave_size = max(1, (config.num_leaves + 7) // 8)
+    if wave_size == 0:   # auto: batched for big trees, sequential for small.
+        # num_leaves // 4 (= 63 at 255 leaves): with the smaller-child
+        # subtraction pass the per-round histogram cost halved, moving the
+        # measured optimum from K=32 to ~64 (PERF.md round-4 sweep).
+        # Small trees (num_leaves <= 7) stay at K=1 — the reference's exact
+        # sequential best-first order, which the golden parity fixtures pin.
+        wave_size = max(1, config.num_leaves // 4)
     # cap bounds the unrolled per-round decision loop's compile-time graph
     if wave_size > 64:
         log_warning(f"leafwise_wave_size={wave_size} capped to 64 (the "
