@@ -311,9 +311,10 @@ class Config:
                                    # levelwise (depth-wise batched)
     leafwise_wave_size: int = 0    # frontier leaves split per round in the
                                    # wave-batched leaf-wise schedule; 0 =
-                                   # auto (num_leaves/16 — sequential for
-                                   # small trees); 1 == exact sequential
-                                   # best-first order
+                                   # auto (num_leaves // 4, capped at 64 —
+                                   # K=1 i.e. exact sequential best-first
+                                   # order for trees up to 7 leaves); 1 ==
+                                   # exact sequential best-first order
     hist_method: str = "auto"      # auto | scatter | onehot | pallas
     hist_dtype: str = "bf16x2"     # bf16 | bf16x2 | f32 | int8 (quantized) precision
     num_shards: int = 0            # devices for data-parallel (0 = all available)

@@ -853,9 +853,40 @@ def make_levelwise_grower(
         num_nodes_cur = jnp.asarray(0, jnp.int32)
         forced_leaf = jnp.full((max(S_forced, 1), 2), -1, jnp.int32)
 
+        # smaller-sibling + subtraction across levels (the reference's
+        # smaller-leaf trick): level d rebuilds only the SMALLER child of
+        # each level-(d-1) split; the sibling comes from the parent's stored
+        # histogram by subtraction, and unsplit leaves keep theirs.  Halves
+        # the per-level histogram pass.  Disabled when the carried state
+        # would exceed 512 MB (wide-F configs).
+        prev = None          # (hist, split_mask, new_leaf, sm_left)
         for d in range(levels):
             Ld = min(1 << d, L)
-            hist = hist_frontier_fn(binned, g3, leaf_id, Ld)   # (Ld, F, B, 3)
+            if prev is None:
+                hist = hist_frontier_fn(binned, g3, leaf_id, Ld)  # (Ld,F,B,3)
+                use_sub_lw = (L * int(np.prod(hist.shape[1:])) * 4
+                              ) <= 512 * (1 << 20)
+            else:
+                p_hist, p_mask, p_new, p_sml = prev
+                Lp = p_hist.shape[0]
+                # label rows of each split's smaller child with the PARENT
+                # slot; everything else is dead (slot Lp, sliced away)
+                sm_id = jnp.where(p_sml, jnp.arange(Lp, dtype=jnp.int32),
+                                  p_new)
+                slot_of_leaf = jnp.full(L + 1, Lp, jnp.int32).at[
+                    jnp.where(p_mask, sm_id, L + 1)].set(
+                    jnp.arange(Lp, dtype=jnp.int32), mode="drop")
+                label = slot_of_leaf[jnp.minimum(leaf_id, L)]
+                h_small = hist_frontier_fn(binned, g3, label, Lp + 1)[:Lp]
+                smL = p_sml[:, None, None, None]
+                h_left = jnp.where(smL, h_small, p_hist - h_small)
+                h_right = p_hist - h_left
+                hist = jnp.zeros((Ld,) + h_left.shape[1:], jnp.float32)
+                hist = hist.at[:Lp].set(
+                    jnp.where(p_mask[:, None, None, None], h_left,
+                              p_hist))
+                hist = hist.at[jnp.where(p_mask, p_new, Ld + 1)].set(
+                    h_right, mode="drop")
             if feature_fraction_bynode < 1.0:
                 masks = jnp.stack([
                     _node_feature_mask(key, d * (2 * L) + i, base_mask,
@@ -1043,6 +1074,12 @@ def make_levelwise_grower(
             leaf_active = leaf_active.at[nl].set(True, mode="drop")
             num_leaves_cur = num_leaves_cur + split_mask.sum()
             num_nodes_cur = num_nodes_cur + split_mask.sum()
+            if d + 1 < levels and use_sub_lw:
+                prev = (hist, split_mask,
+                        jnp.where(split_mask, new_leaf, L + 1),
+                        res.left_sum[:, 2] <= res.right_sum[:, 2])
+            else:
+                prev = None
 
         return tree, leaf_id, root_sum
 
