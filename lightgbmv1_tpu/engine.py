@@ -66,6 +66,13 @@ def train(
                 if user_named:
                     train_data_name = name
                 continue
+            # the reference engine sets every valid set's reference to the
+            # train set before construction (engine.py:18 loop:
+            # ``valid_set.set_reference(train_set)``) — without it a valid
+            # set built standalone would be binned with its OWN boundaries
+            # and every evaluation would silently run on misaligned bins
+            if vs.reference is None and vs._binned is None:
+                vs.reference = train_set
             booster.add_valid(vs, name)
     booster._train_data_name = train_data_name
 

@@ -418,6 +418,12 @@ class GBDT:
         self._train_scores.score = new_train
         for vs, s in zip(self._valid_scores, new_valid):
             vs.score = s
+        store = getattr(self, "_maybe_store_lids", None)
+        if store is not None:
+            # DART keeps each tree's training-row leaf assignment so a
+            # later drop re-predicts via a cheap (L,)-table gather instead
+            # of a per-row tree walk (see DART._fused_dart_iter)
+            store(leaf_ids)
         for k in range(self.num_class):
             tree_k = jax.tree_util.tree_map(lambda a: a[k], stacked)
             self._device_trees.append(tree_k)
@@ -887,7 +893,41 @@ class DART(GBDT):
         # (reference: dart.hpp tree_weight_/sum_weight_, :67-68,103-115)
         self._tree_weight: List[float] = []
         self._sum_weight = 0.0
-        self._dart_steps: dict = {}    # padded-slot-count -> compiled step
+        self._dart_steps: dict = {}    # (P, use_lids) -> compiled step
+        # per-iteration (K, N) leaf assignments of the TRAIN rows: a drop's
+        # train-score removal becomes leaf_value[lid] — one small-table
+        # gather — instead of a per-row tree walk (which random-gathers the
+        # (F, N) matrix per node and dominates DART cost on TPU).  Bounded
+        # to ~1 GB of HBM; beyond that drops fall back to tree walks.
+        self._train_leaf_ids: List[jax.Array] = []
+        L = self.config.num_leaves
+        self._lid_dtype = (jnp.uint8 if L <= 256
+                           else jnp.uint16 if L <= 65536 else jnp.int32)
+        # dynamic ~1 GB budget (config.num_iterations is unreliable here:
+        # engine.train moves the round count into num_boost_round); once
+        # exhausted — or once any host-path iteration breaks the
+        # per-iteration alignment — the list is freed and drops fall back
+        # to tree walks for the rest of the run
+        self._lid_per_iter_bytes = (self.num_data * self.num_class
+                                    * jnp.dtype(self._lid_dtype).itemsize)
+        self._lid_budget = 1 << 30
+        self._keep_lids = True
+        self._lids_aligned = True
+
+    def _maybe_store_lids(self, leaf_ids) -> None:
+        if not (self._keep_lids and self._lids_aligned):
+            return
+        if ((len(self._train_leaf_ids) + 1) * self._lid_per_iter_bytes
+                > self._lid_budget):
+            self._keep_lids = False
+            self._train_leaf_ids.clear()
+            return
+        self._train_leaf_ids.append(leaf_ids.astype(self._lid_dtype))
+
+    def _drop_lids_usable(self) -> bool:
+        return (self._keep_lids and self._lids_aligned
+                and len(self._train_leaf_ids)
+                == len(self.models) // self.num_class)
 
     def _supports_fused_step(self) -> bool:
         # the scanned multi-iteration path cannot host the per-iteration
@@ -979,7 +1019,7 @@ class DART(GBDT):
     # keeps only drop selection and bookkeeping).  Semantics identical to
     # the host-loop path below (reference dart.hpp:23-170).
     # ------------------------------------------------------------------
-    def _build_dart_step(self, P: int):
+    def _build_dart_step(self, P: int, use_lids: bool):
         K = self.num_class
 
         def pred_with(tree, b):
@@ -989,11 +1029,22 @@ class DART(GBDT):
                                        zero_bins=self.meta.zero_bin)
 
         def step(binned, valid_binned, train_score, valid_scores, iteration,
-                 feat_masks, cegb_used, drop_stack, drop_weight, shrink_new):
-            # drop_stack: TreeArrays stacked over P slots, leaf values
-            # bias-carrying; drop_weight: (P, K) f32 one-hot rows scaled by
-            # the slot's validity (0 rows = padding)
-            preds = jax.vmap(lambda t: pred_with(t, binned))(drop_stack)
+                 feat_masks, cegb_used, drop_stack, drop_weight, shrink_new,
+                 drop_lv, drop_lids):
+            # drop_weight: (P, K) f32 one-hot rows scaled by the slot's
+            # validity (0 rows = padding).  With use_lids the TRAIN removal
+            # gathers drop_lv (P, L) bias-carrying leaf tables through the
+            # RECORDED leaf assignments drop_lids (P, N) — a small-table
+            # gather instead of a per-row tree walk (the walk random-
+            # gathers the (F, N) matrix per node and dominated DART cost);
+            # drop_stack (full TreeArrays over P slots) is only needed for
+            # valid-set removal, where no assignments were recorded.
+            if use_lids:
+                preds = jax.vmap(
+                    lambda lv, lid: lv[lid.astype(jnp.int32)]
+                )(drop_lv, drop_lids)                            # (P, N)
+            else:
+                preds = jax.vmap(lambda t: pred_with(t, binned))(drop_stack)
             drop_delta = preds.T @ drop_weight                   # (N, K)
             s_drop = train_score - drop_delta
             v_drops, v_deltas = [], []
@@ -1028,12 +1079,12 @@ class DART(GBDT):
 
         def full(binned, valid_binned, train_score, valid_scores, iteration,
                  feat_masks, cegb_used, drop_stack, drop_weight, shrink_new,
-                 old_factor):
+                 old_factor, drop_lv=None, drop_lids=None):
             (s_drop, v_drops, d_delta, v_deltas, stacked, leaf_ids,
              cegb_used) = step(binned, valid_binned, train_score,
                                valid_scores, iteration, feat_masks,
                                cegb_used, drop_stack, drop_weight,
-                               shrink_new)
+                               shrink_new, drop_lv, drop_lids)
             new_train = s_drop + old_factor * d_delta
             new_valids = [vs + old_factor * vd
                           for vs, vd in zip(v_drops, v_deltas)]
@@ -1050,6 +1101,12 @@ class DART(GBDT):
 
         return jax.jit(full)
 
+    def _dart_step_for(self, P: int, use_lids: bool):
+        key = (P, use_lids)
+        if key not in self._dart_steps:
+            self._dart_steps[key] = self._build_dart_step(P, use_lids)
+        return self._dart_steps[key]
+
     def _fused_dart_iter(self, drop_iters: List[int]) -> None:
         cfg = self.config
         K = self.num_class
@@ -1063,7 +1120,13 @@ class DART(GBDT):
         n_real = k_drop * K
         P = next(b for b in (4, 16, 64, 256, 1024) if b >= n_real) \
             if n_real <= 1024 else n_real
+        # leaf-id fast path only while every past iteration recorded its
+        # assignments (a host-path iteration, e.g. custom fobj, breaks the
+        # alignment — then drops fall back to tree walks)
+        use_lids = self._drop_lids_usable()
+        need_stack = (not use_lids) or bool(self._valid_binned)
         entries, weights = [], np.zeros((P, K), np.float32)
+        lv_tables, lid_rows = [], []
         for j, it in enumerate(drop_iters):
             for k in range(K):
                 idx = it * K + k
@@ -1071,30 +1134,43 @@ class DART(GBDT):
                 b = self._model_bias[idx]
                 if b:
                     t = t._replace(leaf_value=t.leaf_value + b)
-                entries.append(t)
+                if need_stack:
+                    entries.append(t)
+                if use_lids:
+                    lv_tables.append(t.leaf_value)
+                    lid_rows.append(self._train_leaf_ids[it][k])
                 weights[j * K + k, k] = 1.0
-        while len(entries) < P:
-            entries.append(entries[0])        # padding; weight row is 0
-        drop_stack = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs),
-                                            *entries)
+        drop_stack = drop_lv = drop_lids = None
+        if need_stack:
+            while len(entries) < P:
+                entries.append(entries[0])    # padding; weight row is 0
+            drop_stack = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs),
+                                                *entries)
+        if use_lids:
+            while len(lv_tables) < P:
+                lv_tables.append(lv_tables[0])
+                lid_rows.append(lid_rows[0])
+            drop_lv = jnp.stack(lv_tables)
+            drop_lids = jnp.stack(lid_rows)
 
-        if P not in self._dart_steps:
-            self._dart_steps[P] = self._build_dart_step(P)
+        step = self._dart_step_for(P, use_lids)
         feat_masks = jnp.asarray(
             np.stack([self._tree_feature_mask() for _ in range(K)]))
         vscores = tuple(vs.score for vs in self._valid_scores)
         with global_timer.section("DART::TrainOneIter(dispatch)"):
             (new_train, new_valid, stacked, leaf_ids,
-             self._cegb_used) = self._dart_steps[P](
+             self._cegb_used) = step(
                 self._grow_binned, tuple(self._valid_binned),
                 self._train_scores.score, vscores,
                 jnp.asarray(self.iter, jnp.int32), feat_masks,
                 self._cegb_used, drop_stack, jnp.asarray(weights),
                 jnp.float32(shrink_new), jnp.float32(old_factor),
+                drop_lv, drop_lids,
             )
         self._train_scores.score = new_train
         for vs, s in zip(self._valid_scores, new_valid):
             vs.score = s
+        self._maybe_store_lids(leaf_ids)
         for k in range(K):
             self._device_trees.append(
                 jax.tree_util.tree_map(lambda a: a[k], stacked))
@@ -1138,6 +1214,10 @@ class DART(GBDT):
     def _host_train_one_iter(self, custom_grad=None, custom_hess=None,
                              check_stop: bool = True) -> bool:
         cfg = self.config
+        # this path records no leaf assignments: the per-iteration list
+        # would misalign, so free it and use tree walks from here on
+        self._lids_aligned = False
+        self._train_leaf_ids.clear()
         self._save_rollback_state()
         self._prev_weights = (list(self._tree_weight), self._sum_weight)
         drop_iters = self._select_drops()
@@ -1258,6 +1338,8 @@ class DART(GBDT):
             self._tree_weight, self._sum_weight = self._prev_weights
             self._prev_weights = None
         super().rollback_one_iter()
+        keep = len(self.models) // self.num_class
+        del self._train_leaf_ids[keep:]
 
 
 # ---------------------------------------------------------------------------

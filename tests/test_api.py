@@ -201,3 +201,29 @@ def test_dump_model_json():
     assert "split_feature" in ts and "left_child" in ts
     import json
     json.dumps(d)  # must be serializable
+
+
+def test_train_auto_references_valid_sets():
+    """engine.train must bin unreferenced valid sets with the TRAIN set's
+    bin mappers (the reference engine calls set_reference(train_set) on
+    every valid set, engine.py:18) — without it every evaluation silently
+    runs on misaligned bins."""
+    import numpy as np
+
+    rng = np.random.RandomState(0)
+    X = rng.randn(1200, 5)
+    y = (X[:, 0] - X[:, 1] > 0).astype(float)
+    Xv = rng.randn(400, 5) + 0.3          # shifted: own bins would differ
+    yv = (Xv[:, 0] - Xv[:, 1] > 0).astype(float)
+    dtrain = lgb.Dataset(X, label=y, params={"verbosity": -1})
+    dvalid = lgb.Dataset(Xv, label=yv, params={"verbosity": -1})  # no ref!
+    evals = {}
+    lgb.train({"objective": "binary", "metric": "auc", "verbosity": -1,
+               "num_leaves": 15}, dtrain, num_boost_round=10,
+              valid_sets=[dvalid], valid_names=["v"],
+              callbacks=[lgb.record_evaluation(evals)])
+    assert dvalid.reference is dtrain
+    np.testing.assert_array_equal(
+        np.asarray(dvalid._binned.bin_mappers[0].bin_upper_bound),
+        np.asarray(dtrain._binned.bin_mappers[0].bin_upper_bound))
+    assert evals["v"]["auc"][-1] > 0.9
