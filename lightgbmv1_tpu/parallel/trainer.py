@@ -299,8 +299,8 @@ def build_trainer(
         # sequential best-first order, which the golden parity fixtures pin.
         wave_size = max(1, config.num_leaves // 4)
     # cap bounds the unrolled per-round decision loop's compile-time graph
-    if wave_size > 64:
-        log_warning(f"leafwise_wave_size={wave_size} capped to 64 (the "
+    if wave_size > 128:
+        log_warning(f"leafwise_wave_size={wave_size} capped to 128 (the "
                     "per-round decision pass unrolls over the wave)")
         wave_size = 64
     mono_mode = config.monotone_constraints_method or "basic"

@@ -170,3 +170,18 @@ def test_cli_snapshot_auto_resume(tmp_path):
         cli_main([a for a in args if not a.startswith("verbosity")]
                  + ["verbosity=1"])
     assert "Resuming from snapshot" not in buf2.getvalue()
+
+
+def test_cli_profile_dir_writes_trace(tmp_path):
+    """profile_dir captures a jax.profiler device trace of training (the
+    USE_TIMETAG analog; VERDICT r3 item 10) — the trace directory must be
+    created and non-empty, and training must succeed with tracing on."""
+    data = _write_data(tmp_path)
+    prof = tmp_path / "trace"
+    model = str(tmp_path / "m.txt")
+    cli_main([f"data={data}", "num_trees=2", "num_leaves=7",
+              f"output_model={model}", f"profile_dir={prof}",
+              "verbosity=-1"])
+    assert os.path.exists(model)
+    files = [os.path.join(r, f) for r, _, fs in os.walk(prof) for f in fs]
+    assert files, "profiler trace directory is empty"
