@@ -624,8 +624,17 @@ class Booster:
                         margin = part[:, K - 1] - part[:, K - 2]
                     active[idx[margin >= es_margin]] = False
         else:
-            for i, t in enumerate(trees):
-                raw[:, i % K] += t.predict(X)
+            native = None
+            if n * len(trees) >= 500_000:
+                # native C++ predictor (the reference Predictor role,
+                # predictor.hpp:29-160): per-row walks over flattened
+                # arrays, threaded; ~10x the vectorized numpy walk
+                native = self._predict_raw_native(X, trees, K)
+            if native is not None:
+                raw = native
+            else:
+                for i, t in enumerate(trees):
+                    raw[:, i % K] += t.predict(X)
         # the boost-from-average constant lives inside tree leaf values
         # (AddBias, reference gbdt.cpp:381-383), so no base term is added
         from .models.gbdt import RF
@@ -641,6 +650,29 @@ class Booster:
             converted = obj.convert_output(raw if K > 1 else raw[:, 0])
             return np.asarray(converted)
         return raw[:, 0] if K == 1 else raw
+
+    def _predict_raw_native(self, X, trees, K):
+        """Native bulk prediction; None -> numpy fallback.  The flattened
+        ensemble pack is cached per (tree count, last-tree identity,
+        iteration) — the iteration term invalidates the cache when DART
+        drop-normalization rescales EXISTING trees in place (every such
+        rescale happens inside an update/rollback that moves ``iter``)."""
+        from .native import build_ensemble_pack, predict_ensemble
+
+        key = (len(trees), id(trees[-1]) if trees else 0,
+               self._gbdt.iter if self._gbdt is not None else -1)
+        cached = getattr(self, "_native_pred_cache", None)
+        if cached is None or cached[0] != key:
+            pack = build_ensemble_pack(trees, K)
+            self._native_pred_cache = (key, pack)
+        else:
+            pack = cached[1]
+        if pack is None or X.shape[1] <= pack["max_feat"]:
+            # narrow X must fail loudly on the numpy path (IndexError),
+            # never read out of bounds natively
+            return None
+        nt = int(self.params.get("num_threads", 0) or 0)
+        return predict_ensemble(X, pack, num_threads=nt)
 
     def refit(self, data, label, decay_rate: float = 0.9) -> "Booster":
         """Refit the existing model's leaf values on new data

@@ -27,26 +27,35 @@ _lib = None
 _lib_failed = False
 
 
-def _build() -> bool:
-    # build into a unique temp file + atomic rename so concurrent
-    # first-use builds from multiple processes can never expose a
-    # half-written shared library
-    tmp = f"{_LIB_PATH}.{os.getpid()}.tmp"
-    cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread",
-           "-o", tmp, _SRC]
+def _compile_and_load(src_path: str, lib_path: str, what: str):
+    """Compile ``src_path`` into ``lib_path`` (if stale) and CDLL it.
+    Builds into a unique temp file + atomic rename so concurrent first-use
+    builds from multiple processes never expose a half-written library.
+    Returns the loaded CDLL or None (no compiler / build error)."""
+    fresh = (os.path.exists(lib_path)
+             and os.path.getmtime(lib_path) >= os.path.getmtime(src_path))
+    if not fresh:
+        tmp = f"{lib_path}.{os.getpid()}.tmp"
+        cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread",
+               "-o", tmp, src_path]
+        try:
+            res = subprocess.run(cmd, capture_output=True, text=True,
+                                 timeout=120)
+        except (OSError, subprocess.TimeoutExpired):
+            return None
+        if res.returncode != 0:
+            log_warning(f"native {what} build failed; using the Python "
+                        f"fallback ({res.stderr.strip().splitlines()[-1:]})")
+            return None
+        try:
+            os.replace(tmp, lib_path)
+        except OSError:
+            if not os.path.exists(lib_path):
+                return None
     try:
-        res = subprocess.run(cmd, capture_output=True, text=True, timeout=120)
-    except (OSError, subprocess.TimeoutExpired):
-        return False
-    if res.returncode != 0:
-        log_warning("native text parser build failed; using the Python "
-                    f"parser ({res.stderr.strip().splitlines()[-1:]})")
-        return False
-    try:
-        os.replace(tmp, _LIB_PATH)
+        return ctypes.CDLL(lib_path)
     except OSError:
-        return os.path.exists(_LIB_PATH)
-    return True
+        return None
 
 
 def _load():
@@ -54,14 +63,8 @@ def _load():
     with _lock:
         if _lib is not None or _lib_failed:
             return _lib
-        fresh = (os.path.exists(_LIB_PATH)
-                 and os.path.getmtime(_LIB_PATH) >= os.path.getmtime(_SRC))
-        if not fresh and not _build():
-            _lib_failed = True
-            return None
-        try:
-            lib = ctypes.CDLL(_LIB_PATH)
-        except OSError:
+        lib = _compile_and_load(_SRC, _LIB_PATH, "text parser")
+        if lib is None:
             _lib_failed = True
             return None
         lib.tp_open.restype = ctypes.c_void_p
@@ -105,3 +108,127 @@ def parse_dense_file(path: str, has_header: bool, sep: Optional[str],
         return out
     finally:
         lib.tp_close(h)
+
+
+# ---------------------------------------------------------------------------
+# Native batch predictor (predictor.cpp) — the reference Predictor role
+# (src/application/predictor.hpp:29-160): per-row tree walks over flattened
+# arrays, row-partitioned across threads.
+# ---------------------------------------------------------------------------
+
+_PRED_SRC = os.path.join(_DIR, "predictor.cpp")
+_PRED_LIB_PATH = os.path.join(_DIR, "_libtpugbdt_pred.so")
+_pred_lib = None
+_pred_failed = False
+
+
+def _pred_load():
+    global _pred_lib, _pred_failed
+    with _lock:
+        if _pred_lib is not None or _pred_failed:
+            return _pred_lib
+        lib = _compile_and_load(_PRED_SRC, _PRED_LIB_PATH, "predictor")
+        if lib is None:
+            _pred_failed = True
+            return None
+        c = ctypes
+        lib.pd_predict.restype = c.c_long
+        lib.pd_predict.argtypes = [
+            c.POINTER(c.c_double), c.c_long, c.c_long, c.c_int, c.c_int,
+            c.POINTER(c.c_long), c.POINTER(c.c_long), c.POINTER(c.c_int),
+            c.POINTER(c.c_double), c.POINTER(c.c_ubyte), c.POINTER(c.c_int),
+            c.POINTER(c.c_int), c.POINTER(c.c_double), c.POINTER(c.c_long),
+            c.POINTER(c.c_int), c.POINTER(c.c_uint), c.POINTER(c.c_int),
+            c.POINTER(c.c_double), c.c_int,
+        ]
+        _pred_lib = lib
+        return _pred_lib
+
+
+def build_ensemble_pack(trees, K: int):
+    """Flatten HostTrees into the predictor's C arrays; None when the
+    ensemble is not representable (raw categorical sets unavailable or a
+    category too large for a bitset)."""
+    if _pred_load() is None:
+        return None
+    node_off = [0]
+    leaf_off = [0]
+    feat, thr, flags, lc, rc, lv = [], [], [], [], [], []
+    cat_off, cat_len, cat_words = [], [], []
+    for t in trees:
+        n_nodes = max(t.num_leaves - 1, 0)
+        for i in range(n_nodes):
+            fl = (1 if t.default_left[i] else 0) | (
+                int(t.missing_type[i]) << 1)
+            co, cl = -1, 0
+            if bool(t.is_cat[i]):
+                s = t.cat_sets[i]
+                if s is None:
+                    return None
+                s = np.asarray(s, np.int64)
+                if len(s) and s.max() >= (1 << 22):
+                    return None          # bitset would be absurdly wide
+                fl |= 8
+                words = np.zeros((int(s.max()) >> 5) + 1 if len(s) else 1,
+                                 np.uint32)
+                for cval in s:
+                    words[cval >> 5] |= np.uint32(1) << np.uint32(cval & 31)
+                co = len(cat_words)
+                cl = len(words)
+                cat_words.extend(words.tolist())
+            feat.append(int(t.split_feature[i]))
+            thr.append(float(t.threshold[i]))
+            flags.append(fl)
+            lc.append(int(t.left_child[i]))
+            rc.append(int(t.right_child[i]))
+            cat_off.append(co)
+            cat_len.append(cl)
+        lv.extend(np.asarray(t.leaf_value[: t.num_leaves],
+                             np.float64).tolist())
+        node_off.append(len(feat))
+        leaf_off.append(len(lv))
+    tree_k = [i % K for i in range(len(trees))]
+    max_feat = max(feat) if feat else -1
+    return dict(
+        max_feat=max_feat,
+        node_off=np.asarray(node_off, np.int64),
+        leaf_off=np.asarray(leaf_off, np.int64),
+        feat=np.asarray(feat, np.int32),
+        thr=np.asarray(thr, np.float64),
+        flags=np.asarray(flags, np.uint8),
+        lc=np.asarray(lc, np.int32),
+        rc=np.asarray(rc, np.int32),
+        leaf_val=np.asarray(lv, np.float64),
+        cat_off=np.asarray(cat_off, np.int64),
+        cat_len=np.asarray(cat_len, np.int32),
+        cat_words=np.asarray(cat_words if cat_words else [0], np.uint32),
+        tree_k=np.asarray(tree_k, np.int32),
+        T=len(trees), K=K,
+    )
+
+
+def predict_ensemble(X: np.ndarray, pack, num_threads: int = 0):
+    """Run the native predictor; (n, K) float64 output, or None."""
+    lib = _pred_load()
+    if lib is None or pack is None:
+        return None
+    X = np.ascontiguousarray(X, np.float64)
+    n, F = X.shape
+    out = np.zeros((n, pack["K"]), np.float64)
+    c = ctypes
+
+    def p(a, ty):
+        return a.ctypes.data_as(c.POINTER(ty))
+
+    rc_ = lib.pd_predict(
+        p(X, c.c_double), n, F, pack["T"], pack["K"],
+        p(pack["node_off"], c.c_long), p(pack["leaf_off"], c.c_long),
+        p(pack["feat"], c.c_int), p(pack["thr"], c.c_double),
+        p(pack["flags"], c.c_ubyte), p(pack["lc"], c.c_int),
+        p(pack["rc"], c.c_int), p(pack["leaf_val"], c.c_double),
+        p(pack["cat_off"], c.c_long), p(pack["cat_len"], c.c_int),
+        p(pack["cat_words"], c.c_uint), p(pack["tree_k"], c.c_int),
+        p(out, c.c_double), int(num_threads))
+    if rc_ != 0:
+        return None
+    return out
