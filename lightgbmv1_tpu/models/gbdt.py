@@ -304,23 +304,37 @@ class GBDT:
             bag = self._bag_fraction_mask(None, iteration)
             trees = []
             leaf_ids = []
+            grow_valids = getattr(self._grow, "_supports_valids", False)
             for k in range(K):
                 g3 = self._sample_g3(grad[:, k], hess[:, k], bag, iteration)
                 key = jax.random.fold_in(self._rng_key, iteration * K + k)
-                tree_dev, leaf_id, _ = self._grow(
-                    binned, g3, feat_masks[k], key, cegb_used
-                )
+                if grow_valids and valid_binned:
+                    # the wave grower routes valid rows through each
+                    # round's splits: valid predictions become a
+                    # leaf_value gather (no per-tree device walk)
+                    tree_dev, leaf_id, _, vlids = self._grow(
+                        binned, g3, feat_masks[k], key, cegb_used,
+                        valids=tuple(valid_binned))
+                else:
+                    tree_dev, leaf_id, _ = self._grow(
+                        binned, g3, feat_masks[k], key, cegb_used
+                    )
+                    vlids = None
                 if self._cegb_enabled:
                     cegb_used = self._update_cegb_state(
                         cegb_used, tree_dev, leaf_id)
                 shrunk = tree_dev._replace(leaf_value=tree_dev.leaf_value * rate)
                 train_score = train_score.at[:, k].add(shrunk.leaf_value[leaf_id])
                 new_valid = []
-                for vb, vscore in zip(valid_binned, valid_scores):
-                    pred = tree_predict_binned(
-                        shrunk, vb, self.meta.nan_bin,
-                        self.meta.missing_type, self._bundle, self._packed,
-                        zero_bins=self.meta.zero_bin)
+                for vi, (vb, vscore) in enumerate(zip(valid_binned,
+                                                      valid_scores)):
+                    if vlids is not None:
+                        pred = shrunk.leaf_value[vlids[vi]]
+                    else:
+                        pred = tree_predict_binned(
+                            shrunk, vb, self.meta.nan_bin,
+                            self.meta.missing_type, self._bundle,
+                            self._packed, zero_bins=self.meta.zero_bin)
                     new_valid.append(vscore.at[:, k].add(pred))
                 valid_scores = tuple(new_valid) if new_valid else valid_scores
                 trees.append(shrunk)

@@ -134,6 +134,11 @@ def intermediate_constraints(boxes, outs, num_leaves, mono_feats,
 
 class WaveState(NamedTuple):
     leaf_id: jax.Array        # (N,) int32 — current leaf of every row
+    valid_lids: tuple         # per valid set: (Nv,) int32 leaf of every
+                              # VALID row, routed through the same per-round
+                              # decisions — valid-set score updates become a
+                              # leaf_value gather instead of a per-tree
+                              # root-to-leaf walk; () when no valid sets
     leaf_hist: jax.Array      # (L, F, B, 3) — per-leaf histograms enabling
                               # the smaller-child + subtraction trick
                               # (reference BeforeFindBestSplit +
@@ -251,7 +256,7 @@ def make_wave_grower(
             return out
         return jnp.clip(out, constr[0], constr[1])
 
-    def grow(binned, g3, base_mask, key, cegb_used=None):
+    def grow(binned, g3, base_mask, key, cegb_used=None, valids=()):
         N = binned.shape[1]
         F = base_mask.shape[0]    # ORIGINAL feature count (binned may be
                                   # the narrower EFB bundle matrix)
@@ -277,6 +282,8 @@ def make_wave_grower(
 
         st = WaveState(
             leaf_id=leaf_id0,
+            valid_lids=tuple(jnp.zeros(v.shape[1], jnp.int32)
+                             for v in valids),
             leaf_hist=(jnp.zeros((L,) + hist0.shape,
                                  jnp.float32).at[0].set(hist0)
                        if use_sub
@@ -351,29 +358,44 @@ def make_wave_grower(
             # instead of K sequential read-modify-write passes over (N,)
             # accumulators (each pass re-reads ~5 N-sized arrays).
             with jax.named_scope("lgbm.partition"):
+                def go_left_k(matrix):
+                    """(K, rows) left-decision of this round's K splits for
+                    every row of ``matrix`` — shared by the train partition
+                    and the valid-row routing."""
+                    mt_k = meta.missing_type[feats][:, None]
+                    bk = jax.vmap(lambda f: bins_of_fn(matrix, f))(feats)
+                    bk = bk.astype(jnp.int32)
+                    na = ((mt_k == MISSING_NAN)
+                          & (bk == meta.nan_bin[feats][:, None])) | (
+                        (mt_k == MISSING_ZERO)
+                        & (bk == meta.zero_bin[feats][:, None]))
+                    g = jnp.where(na, dls[:, None], bk <= thrs[:, None])
+                    if use_cat:  # categorical bitset membership (bin-space)
+                        word = jnp.zeros(bk.shape, jnp.uint32)
+                        for wv in range(W):
+                            word = jnp.where((bk >> 5) == wv,
+                                             bitsets[:, wv][:, None], word)
+                        in_set = ((word >> (bk.astype(jnp.uint32) & 31))
+                                  & 1) == 1
+                        g = jnp.where(iscats[:, None], in_set, g)
+                    return g
+
                 leaf_id = st.leaf_id
-                bins_k = jax.vmap(
-                    lambda f: bins_of_fn(binned, f))(feats)   # (K, N)
-                bins_k = bins_k.astype(jnp.int32)
-                mt_k = meta.missing_type[feats][:, None]
-                is_na = ((mt_k == MISSING_NAN)
-                         & (bins_k == meta.nan_bin[feats][:, None])) | (
-                    (mt_k == MISSING_ZERO)
-                    & (bins_k == meta.zero_bin[feats][:, None]))
-                gl = jnp.where(is_na, dls[:, None], bins_k <= thrs[:, None])
-                if use_cat:  # categorical bitset membership (bin-space)
-                    word = jnp.zeros((K, N), jnp.uint32)
-                    for wv in range(W):
-                        word = jnp.where((bins_k >> 5) == wv,
-                                         bitsets[:, wv][:, None], word)
-                    in_set = ((word >> (bins_k.astype(jnp.uint32) & 31))
-                              & 1) == 1
-                    gl = jnp.where(iscats[:, None], in_set, gl)
+                gl = go_left_k(binned)
                 mine = valid[:, None] & (leaf_id[None, :] == leafs[:, None])
                 go_r = mine & (~gl)                           # (K, N) disjoint
                 leaf_id = leaf_id + jnp.sum(
                     jnp.where(go_r, nls[:, None] - leaf_id[None, :], 0),
                     axis=0)
+                new_vlids = []
+                for vb, vl in zip(valids, st.valid_lids):
+                    gv = go_left_k(vb)
+                    mine_v = valid[:, None] & (vl[None, :] == leafs[:, None])
+                    go_rv = mine_v & (~gv)
+                    new_vlids.append(vl + jnp.sum(
+                        jnp.where(go_rv, nls[:, None] - vl[None, :], 0),
+                        axis=0))
+                new_vlids = tuple(new_vlids)
                 if use_sub:
                     # label only the SMALLER child of each split (known
                     # up front from the recorded left/right counts)
@@ -539,6 +561,7 @@ def make_wave_grower(
 
             return WaveState(
                 leaf_id=leaf_id,
+                valid_lids=new_vlids,
                 leaf_hist=(st.leaf_hist.at[lidx].set(h_left, mode="drop")
                            .at[nlidx].set(h_right, mode="drop")
                            if use_sub else st.leaf_hist),
@@ -569,6 +592,9 @@ def make_wave_grower(
 
         if L > 1:
             st = lax.while_loop(cond, body, st)
+        if valids:
+            return st.tree, st.leaf_id, root_sum, st.valid_lids
         return st.tree, st.leaf_id, root_sum
 
+    grow._supports_valids = True
     return grow

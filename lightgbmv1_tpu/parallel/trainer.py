@@ -381,6 +381,10 @@ def build_trainer(
                 partition=(config.tree_growth != "leafwise_masked"
                            and cegb_lazy is None),
                 **lw_pool, **common)
+        # jax.jit copies grow.__dict__ (functools.wraps), so the wave
+        # grower's _supports_valids capability flag — valid rows routed
+        # through each round's splits instead of per-tree walks — rides
+        # the jitted callable automatically
         return jax.jit(grow), jnp.asarray(binned_np), N
 
     if learner == "voting" and levelwise:

@@ -118,3 +118,56 @@ def test_wave_size_variants_same_quality():
                         lgb.Dataset(X, label=y), num_boost_round=10)
         aucs.append(roc_auc_score(yt, bst.predict(Xt)))
     assert max(aucs) - min(aucs) < 0.01, aucs
+
+
+def test_valid_row_routing_matches_tree_walk():
+    """The wave grower's valid-row routing (WaveState.valid_lids — valid
+    scores via leaf_value gather) must reproduce the tree_predict_binned
+    walk EXACTLY, including NaN missing routing and categorical bitset
+    nodes; metrics and early stopping read these scores."""
+    import numpy as np
+
+    import lightgbmv1_tpu as lgb
+
+    rng = np.random.RandomState(3)
+    n, nv = 4000, 1500
+    X = rng.randn(n, 6)
+    X[:, 0] = rng.randint(0, 6, n)               # categorical
+    X[rng.rand(n, 6) < 0.05] = np.nan            # NaN missing
+    y = (np.nan_to_num(X[:, 1]) - np.nan_to_num(X[:, 2]) > 0).astype(float)
+    Xv = rng.randn(nv, 6)
+    Xv[:, 0] = rng.randint(0, 8, nv)             # incl. unseen categories
+    Xv[rng.rand(nv, 6) < 0.05] = np.nan
+    yv = (np.nan_to_num(Xv[:, 1]) - np.nan_to_num(Xv[:, 2]) > 0).astype(float)
+
+    p = {"objective": "binary", "metric": "auc", "num_leaves": 31,
+         "min_data_in_leaf": 10, "verbosity": -1}
+
+    def run(strip_flag):
+        ds = lgb.Dataset(X, label=y, params=p, categorical_feature=[0])
+        dv = lgb.Dataset(Xv, label=yv, params=p, reference=ds)
+        bst = lgb.train(p, ds, num_boost_round=8, valid_sets=[dv],
+                        valid_names=["v"], verbose_eval=False)
+        g = bst._gbdt
+        if strip_flag:
+            raise AssertionError("strip before training, not after")
+        return np.asarray(g._valid_scores[0].score)
+
+    # tracked path (default)
+    tracked = run(False)
+    # walk path: wrap _grow so the capability flag is invisible
+    import lightgbmv1_tpu.models.gbdt as G
+
+    orig_init = G.GBDT._build_trainer
+
+    def patched(self):
+        orig_init(self)
+        inner = self._grow
+        self._grow = lambda *a, **k: inner(*a, **k)   # hides the attribute
+
+    G.GBDT._build_trainer = patched
+    try:
+        walked = run(False)
+    finally:
+        G.GBDT._build_trainer = orig_init
+    np.testing.assert_array_equal(tracked, walked)
