@@ -653,14 +653,17 @@ class Booster:
 
     def _predict_raw_native(self, X, trees, K):
         """Native bulk prediction; None -> numpy fallback.  The flattened
-        ensemble pack is cached per (tree count, last-tree identity,
-        iteration) — the iteration term invalidates the cache when DART
-        drop-normalization rescales EXISTING trees in place (every such
-        rescale happens inside an update/rollback that moves ``iter``)."""
+        ensemble pack is cached per (tree count, model version) — the
+        version counter bumps on every ``iter`` move, and every in-place
+        ensemble mutation (tree append, rollback truncation, DART
+        drop-rescale of existing trees) happens inside an update/rollback
+        that moves ``iter``.  Tree object identity is deliberately NOT part
+        of the key: host trees may be freshly materialized per call (id()
+        would never hit) and CPython id() can alias after GC."""
         from .native import build_ensemble_pack, predict_ensemble
 
-        key = (len(trees), id(trees[-1]) if trees else 0,
-               self._gbdt.iter if self._gbdt is not None else -1)
+        key = (len(trees),
+               self._gbdt.model_version if self._gbdt is not None else -1)
         cached = getattr(self, "_native_pred_cache", None)
         if cached is None or cached[0] != key:
             pack = build_ensemble_pack(trees, K)

@@ -184,6 +184,9 @@ def _find_bin_with_zero_as_one_bin(
     right_start = left_cnt + int(right_pos[0]) if len(right_pos) else -1
 
     right_max_bin = max_bin - 1 - len(bounds)
+    # when positives exist but right_max_bin == 0 (tiny max_bin with data on
+    # both sides of zero), the reference ALSO falls into the inf-only branch
+    # (bin.cpp:302-309 appends infinity, not kZeroThreshold) — keep parity
     if right_start >= 0 and right_max_bin > 0:
         rb = _greedy_find_bin(dv[right_start:], counts[right_start:],
                               right_max_bin, right_cnt_data, min_data_in_bin)

@@ -230,6 +230,22 @@ class GBDT:
         self._feat_rng = np.random.RandomState(config.feature_fraction_seed)
 
     # ------------------------------------------------------------------
+    @property
+    def iter(self) -> int:
+        return self._iter
+
+    @iter.setter
+    def iter(self, v: int) -> None:
+        # every ensemble mutation (tree append, rollback truncation, DART
+        # drop-rescale of EXISTING trees) happens inside an update/rollback
+        # flow that moves ``iter``; the monotone version counter is the
+        # native-predictor cache invalidation key (with the tree count) —
+        # object identity of host trees is not stable (they may be
+        # re-materialized per call) and CPython id() can alias after GC
+        self._iter = v
+        self.model_version = getattr(self, "model_version", -1) + 1
+
+    # ------------------------------------------------------------------
     def _build_trainer(self):
         from ..parallel.trainer import build_trainer
 

@@ -132,12 +132,14 @@ def _pred_load():
             _pred_failed = True
             return None
         c = ctypes
-        lib.pd_predict.restype = c.c_long
+        # int64 numpy arrays map to int64_t on BOTH sides (c_long would
+        # only agree on LP64; Windows/mingw long is 32-bit)
+        lib.pd_predict.restype = c.c_int64
         lib.pd_predict.argtypes = [
-            c.POINTER(c.c_double), c.c_long, c.c_long, c.c_int, c.c_int,
-            c.POINTER(c.c_long), c.POINTER(c.c_long), c.POINTER(c.c_int),
+            c.POINTER(c.c_double), c.c_int64, c.c_int64, c.c_int, c.c_int,
+            c.POINTER(c.c_int64), c.POINTER(c.c_int64), c.POINTER(c.c_int),
             c.POINTER(c.c_double), c.POINTER(c.c_ubyte), c.POINTER(c.c_int),
-            c.POINTER(c.c_int), c.POINTER(c.c_double), c.POINTER(c.c_long),
+            c.POINTER(c.c_int), c.POINTER(c.c_double), c.POINTER(c.c_int64),
             c.POINTER(c.c_int), c.POINTER(c.c_uint), c.POINTER(c.c_int),
             c.POINTER(c.c_double), c.c_int,
         ]
@@ -222,11 +224,11 @@ def predict_ensemble(X: np.ndarray, pack, num_threads: int = 0):
 
     rc_ = lib.pd_predict(
         p(X, c.c_double), n, F, pack["T"], pack["K"],
-        p(pack["node_off"], c.c_long), p(pack["leaf_off"], c.c_long),
+        p(pack["node_off"], c.c_int64), p(pack["leaf_off"], c.c_int64),
         p(pack["feat"], c.c_int), p(pack["thr"], c.c_double),
         p(pack["flags"], c.c_ubyte), p(pack["lc"], c.c_int),
         p(pack["rc"], c.c_int), p(pack["leaf_val"], c.c_double),
-        p(pack["cat_off"], c.c_long), p(pack["cat_len"], c.c_int),
+        p(pack["cat_off"], c.c_int64), p(pack["cat_len"], c.c_int),
         p(pack["cat_words"], c.c_uint), p(pack["tree_k"], c.c_int),
         p(out, c.c_double), int(num_threads))
     if rc_ != 0:

@@ -21,10 +21,10 @@ constexpr double kZeroThreshold = 1e-35;
 
 struct Ensemble {
   const double* X;
-  long n, F;
+  int64_t n, F;
   int T, K;
-  const long* node_off;   // T+1 node offsets
-  const long* leaf_off;   // T+1 leaf offsets
+  const int64_t* node_off;   // T+1 node offsets
+  const int64_t* leaf_off;   // T+1 leaf offsets
   const int* feat;
   const double* thr;
   const unsigned char* flags;  // bit0 default_left, bits1-2 missing type,
@@ -32,14 +32,14 @@ struct Ensemble {
   const int* lc;
   const int* rc;
   const double* leaf_val;
-  const long* cat_off;    // per NODE offset into cat_words (-1 if none)
+  const int64_t* cat_off;    // per NODE offset into cat_words (-1 if none)
   const int* cat_len;     // per NODE word count
   const unsigned int* cat_words;
   const int* tree_k;      // class index per tree
   double* out;            // (n, K) row-major, pre-zeroed by the caller
 };
 
-inline bool go_left(const Ensemble& e, long node, double v) {
+inline bool go_left(const Ensemble& e, int64_t node, double v) {
   const unsigned char fl = e.flags[node];
   const bool is_nan = std::isnan(v);
   const double v0 = is_nan ? 0.0 : v;
@@ -47,10 +47,10 @@ inline bool go_left(const Ensemble& e, long node, double v) {
     if (is_nan) return false;
     // C truncation FIRST (values in (-1, 0) truncate to category 0, like
     // the numpy walk's np.trunc); negatives after truncation go right
-    const long long c = static_cast<long long>(v0);
+    const int64_t c = static_cast<int64_t>(v0);
     if (c < 0) return false;
-    const long off = e.cat_off[node];
-    const long w = static_cast<long>(c >> 5);
+    const int64_t off = e.cat_off[node];
+    const int64_t w = static_cast<int64_t>(c >> 5);
     if (off < 0 || w >= e.cat_len[node]) return false;
     return (e.cat_words[off + w] >> (c & 31)) & 1u;
   }
@@ -61,18 +61,18 @@ inline bool go_left(const Ensemble& e, long node, double v) {
   return v0 <= e.thr[node];
 }
 
-void predict_rows(const Ensemble& e, long lo, long hi) {
-  for (long i = lo; i < hi; ++i) {
+void predict_rows(const Ensemble& e, int64_t lo, int64_t hi) {
+  for (int64_t i = lo; i < hi; ++i) {
     const double* row = e.X + i * e.F;
     double* orow = e.out + i * e.K;
     for (int t = 0; t < e.T; ++t) {
-      const long nb = e.node_off[t];
-      const long lb = e.leaf_off[t];
+      const int64_t nb = e.node_off[t];
+      const int64_t lb = e.leaf_off[t];
       if (e.node_off[t + 1] == nb) {  // single-leaf tree
         orow[e.tree_k[t]] += e.leaf_val[lb];
         continue;
       }
-      long node = nb;
+      int64_t node = nb;
       for (;;) {
         const bool left = go_left(e, node, row[e.feat[node]]);
         const int c = left ? e.lc[node] : e.rc[node];
@@ -89,10 +89,10 @@ void predict_rows(const Ensemble& e, long lo, long hi) {
 
 extern "C" {
 
-long pd_predict(const double* X, long n, long F, int T, int K,
-                const long* node_off, const long* leaf_off, const int* feat,
+int64_t pd_predict(const double* X, int64_t n, int64_t F, int T, int K,
+                const int64_t* node_off, const int64_t* leaf_off, const int* feat,
                 const double* thr, const unsigned char* flags, const int* lc,
-                const int* rc, const double* leaf_val, const long* cat_off,
+                const int* rc, const double* leaf_val, const int64_t* cat_off,
                 const int* cat_len, const unsigned int* cat_words,
                 const int* tree_k, double* out, int nthreads) {
   Ensemble e{X,  n,  F,  T,  K,  node_off, leaf_off, feat,    thr, flags,
@@ -100,16 +100,16 @@ long pd_predict(const double* X, long n, long F, int T, int K,
   int hw = static_cast<int>(std::thread::hardware_concurrency());
   if (hw <= 0) hw = 1;
   int nt = nthreads > 0 ? nthreads : hw;
-  if (static_cast<long>(nt) > n) nt = static_cast<int>(n > 0 ? n : 1);
+  if (static_cast<int64_t>(nt) > n) nt = static_cast<int>(n > 0 ? n : 1);
   if (nt <= 1) {
     predict_rows(e, 0, n);
     return 0;
   }
   std::vector<std::thread> threads;
-  const long per = (n + nt - 1) / nt;
+  const int64_t per = (n + nt - 1) / nt;
   for (int w = 0; w < nt; ++w) {
-    const long lo = w * per;
-    const long hi = std::min(n, lo + per);
+    const int64_t lo = w * per;
+    const int64_t hi = std::min(n, lo + per);
     if (lo >= hi) break;
     threads.emplace_back([&e, lo, hi] { predict_rows(e, lo, hi); });
   }

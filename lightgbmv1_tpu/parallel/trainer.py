@@ -302,7 +302,7 @@ def build_trainer(
     if wave_size > 128:
         log_warning(f"leafwise_wave_size={wave_size} capped to 128 (the "
                     "per-round decision pass unrolls over the wave)")
-        wave_size = 64
+        wave_size = 128
     mono_mode = config.monotone_constraints_method or "basic"
     has_mono = bool(config.monotone_constraints) and any(
         config.monotone_constraints)

@@ -31,7 +31,6 @@ from tests.conftest import make_binary_problem
 # architecture — anything listed here must be justified in README "Design
 # decisions".
 EXPLICIT_NOOP: dict = {
-    "enable_bundle": "EFB toggle — consumed by io/bundling (in progress)",
     "is_enable_sparse": "no sparse bin storage to toggle: wide-sparse input "
                         "is EFB bundles + from_csr (io/bundle.py)",
     "gpu_platform_id": "OpenCL device selection — device choice is JAX's "
