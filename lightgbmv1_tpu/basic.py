@@ -30,6 +30,11 @@ from .utils import fileio
 from .utils.log import LightGBMError, log_fatal, log_info, log_warning
 
 
+# rows * trees above which bulk prediction routes to the native C++
+# predictor (below it the per-call pack/launch overhead beats the win)
+_NATIVE_PREDICT_MIN_WORK = 500_000
+
+
 def _is_scipy_sparse(data) -> bool:
     return type(data).__module__.split(".")[0] == "scipy" and hasattr(
         data, "tocsr")
@@ -625,11 +630,12 @@ class Booster:
                     active[idx[margin >= es_margin]] = False
         else:
             native = None
-            if n * len(trees) >= 500_000:
+            if n * len(trees) >= _NATIVE_PREDICT_MIN_WORK:
                 # native C++ predictor (the reference Predictor role,
                 # predictor.hpp:29-160): per-row walks over flattened
                 # arrays, threaded; ~10x the vectorized numpy walk
-                native = self._predict_raw_native(X, trees, K)
+                native = self._predict_raw_native(
+                    X, trees, K, start_iteration)
             if native is not None:
                 raw = native
             else:
@@ -651,18 +657,20 @@ class Booster:
             return np.asarray(converted)
         return raw[:, 0] if K == 1 else raw
 
-    def _predict_raw_native(self, X, trees, K):
+    def _predict_raw_native(self, X, trees, K, start_iteration=0):
         """Native bulk prediction; None -> numpy fallback.  The flattened
-        ensemble pack is cached per (tree count, model version) — the
-        version counter bumps on every ``iter`` move, and every in-place
-        ensemble mutation (tree append, rollback truncation, DART
-        drop-rescale of existing trees) happens inside an update/rollback
-        that moves ``iter``.  Tree object identity is deliberately NOT part
-        of the key: host trees may be freshly materialized per call (id()
-        would never hit) and CPython id() can alias after GC."""
+        ensemble pack is cached per (slice start, tree count, model
+        version) — the version counter bumps on every ``iter`` move, and
+        every in-place ensemble mutation (tree append, rollback
+        truncation, DART drop-rescale of existing trees) happens inside an
+        update/rollback that moves ``iter``; the slice start distinguishes
+        same-length windows (start_iteration paging).  Tree object
+        identity is deliberately NOT part of the key: host trees may be
+        freshly materialized per call (id() would never hit) and CPython
+        id() can alias after GC."""
         from .native import build_ensemble_pack, predict_ensemble
 
-        key = (len(trees),
+        key = (start_iteration, len(trees),
                self._gbdt.model_version if self._gbdt is not None else -1)
         cached = getattr(self, "_native_pred_cache", None)
         if cached is None or cached[0] != key:

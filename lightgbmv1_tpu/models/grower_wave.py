@@ -68,6 +68,21 @@ from ..ops.split import (
 from .grower import _node_feature_mask, allowed_features_for
 from .tree import TreeArrays, empty_tree
 
+# Slot bucketing kicks in above this many rows: each extra bucket traces
+# one more (S, N) partition + (S+1)-slot histogram variant, which is pure
+# compile-time cost at test sizes (the CPU suite stays on the single
+# full-wave path).  Lowered by tests to exercise the bucketed branches.
+_BUCKET_MIN_N = 1 << 16
+
+# Optional host callback fired once per EXECUTED wave round with the
+# round's realized split count (jax.debug.callback in the while-loop
+# body).  bench.py sets this on a probe model to record the ACTUAL
+# rounds-per-tree schedule behind `wave_rounds_per_tree` and the per-iter
+# histogram cost — the counting role of the reference's USE_TIMETAG global
+# timers (include/LightGBM/utils/common.h:1054-1138).  None (the default)
+# adds nothing to the traced program.
+_ROUND_PROBE = None
+
 
 def _box_adjacency_per_feature(lo, hi, feats):
     """Yield ``(f, adj_up, adj_dn)`` pairwise adjacency matrices for leaf
@@ -262,6 +277,20 @@ def make_wave_grower(
                                   # the narrower EFB bundle matrix)
         del cegb_used  # CEGB routes to the sequential grower (order-exact)
 
+        # Slot buckets: the wave frontier RAMPS (1, 2, 4, ... splits per
+        # round before reaching K), but a fixed-K round pays the full
+        # 3*(K+1)-row MXU pass and the (K, N) partition regardless.  Rounds
+        # with few splits therefore run a SLICED variant: the round's
+        # n_split <= S splits are compacted to slots 0..n_split-1 and the
+        # partition + histogram run at (S, N) / (S+1) slots — measured ~2x
+        # cheaper at S=4 vs S=64 on the bench config (the remaining floor
+        # is the slot-count-independent in-VMEM one-hot build).  Selection
+        # is by the replicated n_split, so row shards stay in lockstep.
+        if K > 4 and N >= _BUCKET_MIN_N:
+            slot_buckets = sorted({4, min(16, K), K})
+        else:
+            slot_buckets = [K]
+
         leaf_id0 = jnp.zeros(N, jnp.int32)
         hist0 = hist_wave_fn(binned, g3, leaf_id0, 1)[0]
         # smaller-child + subtraction mode: build K child histograms per
@@ -338,6 +367,8 @@ def make_wave_grower(
                     kept = kept.at[j].set(kept[j] & (~clash))
                 valid = kept
             n_split = valid.sum()
+            if _ROUND_PROBE is not None:   # bench round-schedule probe
+                jax.debug.callback(_ROUND_PROBE, n_split)
             order = jnp.cumsum(valid.astype(jnp.int32)) - 1
             nodes = st.num_leaves - 1 + order                 # (K,) int32
             nls = st.num_leaves + order                       # new right leaves
@@ -349,69 +380,107 @@ def make_wave_grower(
             bitsets = st.best_bitset[leafs]                   # (K, W)
             lsums = st.best_left[leafs]                       # (K, 3)
             rsums = st.best_right[leafs]
+            sm_left = lsums[:, 2] <= rsums[:, 2]              # (K,) smaller
+            order_c = jnp.clip(order, 0, K - 1)
 
-            # ---- decision + child labeling, one vectorized pass -----------
-            # (the analog of K DataPartition::Split scatters); rows of leaf
-            # ``leafs[j]`` go to slot 2j (left, keeps the leaf id) or 2j+1
-            # (right, becomes leaf ``nls[j]``); all other rows are dead (2K).
-            # Batched over the wave: (K, N) intermediates stream once
-            # instead of K sequential read-modify-write passes over (N,)
-            # accumulators (each pass re-reads ~5 N-sized arrays).
-            with jax.named_scope("lgbm.partition"):
-                def go_left_k(matrix):
-                    """(K, rows) left-decision of this round's K splits for
-                    every row of ``matrix`` — shared by the train partition
-                    and the valid-row routing."""
-                    mt_k = meta.missing_type[feats][:, None]
-                    bk = jax.vmap(lambda f: bins_of_fn(matrix, f))(feats)
+            # ---- decision + labeling + histogram, sliced to S slots -------
+            # One vectorized (S, N) decision pass (the analog of K
+            # DataPartition::Split scatters) + one (S+1)-slot histogram.
+            # ``round_pass(S)`` is traced per slot bucket; the round's
+            # n_split <= S splits are compacted to slots 0..n_split-1 via
+            # ``order`` (cumsum of valid — dense even when the intermediate-
+            # monotone deferral clears mid-prefix picks).
+            def round_pass(S):
+                sidx = jnp.where(valid, order_c, S)          # (K,) slot|drop
+
+                def to_slot(v, fill):
+                    base = jnp.full((S,) + v.shape[1:], fill, v.dtype)
+                    return base.at[sidx].set(v, mode="drop")
+
+                feats_s = to_slot(feats, 0)
+                thrs_s = to_slot(thrs, 0)
+                dls_s = to_slot(dls, False)
+                # empty slots carry leaf id L: matches no row's leaf
+                leafs_s = to_slot(leafs, L)
+                nls_s = to_slot(nls, 0)
+                sml_s = to_slot(sm_left, False)
+                iscats_s = to_slot(iscats, False) if use_cat else None
+                bitsets_s = to_slot(bitsets, 0) if use_cat else None
+
+                def go_left_s(matrix):
+                    """(S, rows) left-decision of this round's splits —
+                    shared by the train partition and valid routing."""
+                    mt_k = meta.missing_type[feats_s][:, None]
+                    bk = jax.vmap(lambda f: bins_of_fn(matrix, f))(feats_s)
                     bk = bk.astype(jnp.int32)
                     na = ((mt_k == MISSING_NAN)
-                          & (bk == meta.nan_bin[feats][:, None])) | (
+                          & (bk == meta.nan_bin[feats_s][:, None])) | (
                         (mt_k == MISSING_ZERO)
-                        & (bk == meta.zero_bin[feats][:, None]))
-                    g = jnp.where(na, dls[:, None], bk <= thrs[:, None])
+                        & (bk == meta.zero_bin[feats_s][:, None]))
+                    g = jnp.where(na, dls_s[:, None], bk <= thrs_s[:, None])
                     if use_cat:  # categorical bitset membership (bin-space)
                         word = jnp.zeros(bk.shape, jnp.uint32)
                         for wv in range(W):
                             word = jnp.where((bk >> 5) == wv,
-                                             bitsets[:, wv][:, None], word)
+                                             bitsets_s[:, wv][:, None], word)
                         in_set = ((word >> (bk.astype(jnp.uint32) & 31))
                                   & 1) == 1
-                        g = jnp.where(iscats[:, None], in_set, g)
+                        g = jnp.where(iscats_s[:, None], in_set, g)
                     return g
 
-                leaf_id = st.leaf_id
-                gl = go_left_k(binned)
-                mine = valid[:, None] & (leaf_id[None, :] == leafs[:, None])
-                go_r = mine & (~gl)                           # (K, N) disjoint
-                leaf_id = leaf_id + jnp.sum(
-                    jnp.where(go_r, nls[:, None] - leaf_id[None, :], 0),
-                    axis=0)
-                new_vlids = []
-                for vb, vl in zip(valids, st.valid_lids):
-                    gv = go_left_k(vb)
-                    mine_v = valid[:, None] & (vl[None, :] == leafs[:, None])
-                    go_rv = mine_v & (~gv)
-                    new_vlids.append(vl + jnp.sum(
-                        jnp.where(go_rv, nls[:, None] - vl[None, :], 0),
-                        axis=0))
-                new_vlids = tuple(new_vlids)
+                siota = jnp.arange(S, dtype=jnp.int32)
+                with jax.named_scope("lgbm.partition"):
+                    gl = go_left_s(binned)                    # (S, N)
+                    mine = st.leaf_id[None, :] == leafs_s[:, None]
+                    go_r = mine & (~gl)                       # disjoint rows
+                    leaf_id = st.leaf_id + jnp.sum(
+                        jnp.where(go_r, nls_s[:, None] - st.leaf_id[None, :],
+                                  0), axis=0)
+                    vl_new = []
+                    for vb, vl in zip(valids, st.valid_lids):
+                        gv = go_left_s(vb)
+                        mine_v = vl[None, :] == leafs_s[:, None]
+                        go_rv = mine_v & (~gv)
+                        vl_new.append(vl + jnp.sum(
+                            jnp.where(go_rv, nls_s[:, None] - vl[None, :], 0),
+                            axis=0))
+                    if use_sub:
+                        # label only the SMALLER child of each split (known
+                        # up front from the recorded left/right counts)
+                        in_small = gl == sml_s[:, None]
+                        label = jnp.sum(
+                            jnp.where(mine & in_small, siota[:, None] - S, 0),
+                            axis=0) + S
+                    else:
+                        slot2 = 2 * siota[:, None] + (~gl).astype(jnp.int32)
+                        label = jnp.sum(jnp.where(mine, slot2 - 2 * S, 0),
+                                        axis=0) + 2 * S
+
                 if use_sub:
-                    # label only the SMALLER child of each split (known
-                    # up front from the recorded left/right counts)
-                    sm_left = lsums[:, 2] <= rsums[:, 2]      # (K,)
-                    in_small = gl == sm_left[:, None]
-                    label = jnp.sum(
-                        jnp.where(mine & in_small, kiota[:, None] - K, 0),
-                        axis=0) + K
+                    h = hist_wave_fn(binned, g3, label, S)    # (S, F, B, 3)
                 else:
-                    slot = 2 * kiota[:, None] + (~gl).astype(jnp.int32)
-                    label = jnp.sum(jnp.where(mine, slot - 2 * K, 0),
-                                    axis=0) + 2 * K
+                    h = hist_wave_fn(binned, g3, label, 2 * S)
+                full = 2 * K if not use_sub else K
+                if h.shape[0] < full:   # pad to the bucket-invariant width
+                    h = jnp.concatenate(
+                        [h, jnp.zeros((full - h.shape[0],) + h.shape[1:],
+                                      h.dtype)], axis=0)
+                return (h, leaf_id) + tuple(vl_new)
+
+            if len(slot_buckets) > 1:
+                s_idx = jnp.zeros((), jnp.int32)
+                for S in slot_buckets[:-1]:
+                    s_idx = s_idx + (n_split > S).astype(jnp.int32)
+                outs = lax.switch(
+                    s_idx, [lambda S=S: round_pass(S) for S in slot_buckets])
+            else:
+                outs = round_pass(slot_buckets[0])
+            h_slot, leaf_id = outs[0], outs[1]
+            new_vlids = tuple(outs[2:])
 
             if use_sub:
-                # ---- K-slot smaller-child pass + subtraction -------------
-                h_small = hist_wave_fn(binned, g3, label, K)  # (K, F, B, 3)
+                # ---- smaller-child histograms + subtraction --------------
+                h_small = h_slot[order_c]          # slot-order -> rank-order
                 h_parent = st.leaf_hist[leafs]
                 smL = sm_left[:, None, None, None]
                 h_left = jnp.where(smL, h_small, h_parent - h_small)
@@ -419,8 +488,9 @@ def make_wave_grower(
                 hist = jnp.stack([h_left, h_right], axis=1).reshape(
                     (2 * K,) + h_left.shape[1:])
             else:
-                # ---- one batched histogram pass for all 2K children ------
-                hist = hist_wave_fn(binned, g3, label, 2 * K)  # (2K, F, B, 3)
+                ch_idx = jnp.stack([2 * order_c, 2 * order_c + 1],
+                                   axis=1).reshape(2 * K)
+                hist = h_slot[ch_idx]              # slot-order -> rank-order
 
             # ---- children metadata --------------------------------------
             cleafs = jnp.stack([leafs, nls], axis=1).reshape(2 * K)

@@ -144,13 +144,17 @@ def _kernel(iota_ref, bins_ref, g3_ref, leaf_ref, out_ref, *, lpad, num_bins,
         sl = slice(c * cb * fblk, (c * cb + cb_c) * fblk)
         bw = rep(bins_f, cb_c, 1)                            # (T, cb_c*FBLK)
         oh_cmp = bw == iota_ref[0:1, sl]
+        # bool -> numeric cast IS the one-hot (exactly 1.0/0.0): a direct
+        # convert, not a select pass — the one-hot build is the
+        # slot-count-independent floor of the whole pass, so every VPU op
+        # here is measurable in the roofline fraction
         if precision == "int8":
-            oh = jnp.where(oh_cmp, 1.0, 0.0).astype(jnp.int8)
+            oh = oh_cmp.astype(jnp.int8)
             acc = lax.dot_general(lg_parts[0], oh, (((1,), (0,)), ((), ())),
                                   preferred_element_type=jnp.int32)
             out_ref[0, :, sl] += acc.astype(jnp.float32) * scale_rep
         elif precision in ("bf16", "bf16x2"):
-            oh = jnp.where(oh_cmp, 1.0, 0.0).astype(jnp.bfloat16)
+            oh = oh_cmp.astype(jnp.bfloat16)
             upd = lax.dot_general(lg_parts[0], oh, (((1,), (0,)), ((), ())),
                                   preferred_element_type=jnp.float32)
             for p in lg_parts[1:]:
@@ -158,7 +162,7 @@ def _kernel(iota_ref, bins_ref, g3_ref, leaf_ref, out_ref, *, lpad, num_bins,
                                             preferred_element_type=jnp.float32)
             out_ref[0, :, sl] += upd
         else:
-            oh = jnp.where(oh_cmp, 1.0, 0.0)
+            oh = oh_cmp.astype(jnp.float32)
             out_ref[0, :, sl] += lax.dot_general(
                 lg_parts[0], oh, (((1,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32,

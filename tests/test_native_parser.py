@@ -109,3 +109,38 @@ def test_native_predictor_parity():
     for i, t in enumerate(trees):
         raw[:, i % 3] += t.predict(X)
     np.testing.assert_array_equal(native, raw)
+
+
+def test_native_predictor_slice_windows_not_aliased(monkeypatch):
+    """Two predict() calls selecting DIFFERENT same-length tree windows
+    (start_iteration paging) must not hit the same native-pack cache entry
+    (regression: the pack cache key once ignored the slice start)."""
+    import numpy as np
+
+    import lightgbmv1_tpu as lgb
+    from lightgbmv1_tpu import basic as basic_mod
+    from lightgbmv1_tpu.native import build_ensemble_pack
+
+    if build_ensemble_pack([], 1) is None:
+        import pytest
+
+        pytest.skip("native predictor unavailable (no compiler)")
+    monkeypatch.setattr(basic_mod, "_NATIVE_PREDICT_MIN_WORK", 0)
+    rng = np.random.RandomState(9)
+    X = rng.randn(500, 5)
+    y = (X[:, 0] + 0.5 * X[:, 1] + rng.randn(500) * 0.3 > 0).astype(float)
+    bst = lgb.train({"objective": "binary", "num_leaves": 7,
+                     "verbosity": -1, "min_data_in_leaf": 5},
+                    lgb.Dataset(X, label=y), num_boost_round=8)
+    trees = bst._all_trees()
+
+    def window_raw(lo, hi):
+        raw = np.zeros(500)
+        for t in trees[lo:hi]:
+            raw += t.predict(X)
+        return raw
+
+    a = bst.predict(X, num_iteration=4, raw_score=True)
+    b = bst.predict(X, start_iteration=4, num_iteration=4, raw_score=True)
+    np.testing.assert_allclose(a, window_raw(0, 4), rtol=1e-12)
+    np.testing.assert_allclose(b, window_raw(4, 8), rtol=1e-12)
