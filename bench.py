@@ -48,70 +48,176 @@ def make_data(n, seed):
     return X, y
 
 
-def measure_hist_and_roofline(ds, N):
-    """Measured feature-histogram pass time + roofline fraction — the
+def make_multiclass_data(n, seed, n_class=5, f=28):
+    """Synthetic multiclass set for the parity block (the reference's
+    Experiments.rst multiclass rows use proprietary Allstate/Yahoo data —
+    not downloadable here, zero egress; shapes follow the binary block)."""
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, f).astype(np.float32)
+    # label function fixed across train/valid splits (centers must NOT
+    # depend on the split seed)
+    centers = np.random.RandomState(12345).randn(n_class, f) \
+        .astype(np.float32) * 0.6
+    logits = X @ centers.T
+    logits[:, 0] += 0.8 * X[:, 0] * X[:, 1]
+    logits[:, 1] += 0.6 * np.sin(2.0 * X[:, 2])
+    logits += rng.randn(n, n_class).astype(np.float32) * 1.5
+    y = logits.argmax(axis=1).astype(np.float64)
+    return X, y
+
+
+def make_rank_data(n_query, docs, seed, f=64):
+    """MSLR-WEB30K-shaped synthetic ranking set: fixed-size queries,
+    graded relevance 0..4 by within-query score quantiles (the reference's
+    MS-LTR rows, docs/Experiments.rst:113-151)."""
+    rng = np.random.RandomState(seed)
+    n = n_query * docs
+    X = rng.randn(n, f).astype(np.float32)
+    score = (X[:, 0] + 0.6 * X[:, 1] * X[:, 2] - 0.4 * X[:, 3]
+             + 0.3 * np.sin(2.0 * X[:, 4])
+             + rng.randn(n).astype(np.float32) * 1.2)
+    s = score.reshape(n_query, docs)
+    ranks = s.argsort(axis=1).argsort(axis=1) / (docs - 1)
+    y = np.digitize(ranks.reshape(-1), [0.5, 0.75, 0.9, 0.97]) \
+        .astype(np.float64)
+    group = np.full(n_query, docs, dtype=np.int64)
+    return X, y, group
+
+
+# Reference C++ CLI on THIS host: multiclass / lambdarank parity blocks,
+# same synthetic data (identical generator + seed via
+# tools/measure_ref_parity.py), same config, 1 core, idle machine,
+# training-only timing (process wall minus logged data-load time,
+# metric_freq=<iters> so eval cost is excluded).  Measured 2026-07-31
+# (round 5): multiclass 250k rows x 28 feat x 5 classes, 127 leaves,
+# 50 iters -> 13.5 s; lambdarank 2000x100 docs, 64 feat, 63 leaves,
+# 100 iters -> 12.2 s.
+REF_MC_M_ROW_TREES_S = 4.619
+REF_MC_LOGLOSS = 0.830193
+REF_RK_M_ROW_TREES_S = 1.635
+REF_RK_NDCG10 = 0.613977
+
+
+def timed_per_rep(make_reps, r1, r2):
+    """Per-rep seconds from a TWO-length-scan differential: wall(r2) -
+    wall(r1) over (r2 - r1) reps cancels dispatch latency and other
+    per-call fixed costs (the ~113 ms tunnel round-trip would otherwise
+    dominate and overstate per-rep time severalfold)."""
+    import jax
+
+    f1, f2 = make_reps(r1), make_reps(r2)
+    jax.device_get(f1())
+    jax.device_get(f2())
+    diffs = []
+    for _ in range(5):
+        t0 = time.time()
+        jax.device_get(f1())
+        t1 = time.time()
+        jax.device_get(f2())
+        t2 = time.time()
+        diffs.append(((t2 - t1) - (t1 - t0)) / (r2 - r1))
+    # MEDIAN, not min: the minimum of a difference of two noisy walls
+    # can go spuriously small (slow short run + fast long run) and
+    # overstate throughput past physical peaks
+    return max(float(np.median(diffs)), 1e-9)
+
+
+def estimated_wave_schedule(K=64, budget=254):
+    """Frontier-doubling estimate (1,2,4,..,K then sustained K) — the
+    fallback when the round probe cannot run, always flagged
+    `wave_rounds_estimated` in the record."""
+    rounds, splits, k = [], 0, 1
+    while splits < budget:
+        rounds.append(min(k, budget - splits))
+        splits += rounds[-1]
+        k = min(2 * k, K)
+    return {"schedule": rounds, "rounds_per_tree": len(rounds),
+            "estimated": True}
+
+
+def probe_round_schedule(cfg_lw, ds, iters=3):
+    """ACTUAL wave-round schedule per tree (VERDICT r4 weak #2: the old
+    record derived hist_ms_per_iter from an assumed 4 rounds/tree; the
+    frontier RAMPS 1,2,4,... so a 255-leaf tree takes ~10).  A fresh probe
+    model is traced with grower_wave._ROUND_PROBE set: the while-loop body
+    fires a host callback with each round's realized split count."""
+    from lightgbmv1_tpu.models import grower_wave
+    from lightgbmv1_tpu.models.gbdt import create_boosting
+
+    schedule = []
+    grower_wave._ROUND_PROBE = lambda k: schedule.append(int(k))
+    try:
+        probe = create_boosting(cfg_lw, ds)
+        for _ in range(iters):
+            probe.train_one_iter(check_stop=False)
+        import jax
+
+        jax.device_get(probe._train_scores.score)
+        # debug.callback effects are ASYNC: device_get waits for the value,
+        # not for pending host callbacks — flush before reading the list
+        jax.effects_barrier()
+    finally:
+        grower_wave._ROUND_PROBE = None
+    if not schedule:
+        return None
+    per_tree = len(schedule) / iters
+    return {"schedule": schedule, "rounds_per_tree": per_tree}
+
+
+def measure_hist_and_roofline(ds, N, schedule=None):
+    """Measured feature-histogram pass times + roofline fraction — the
     BASELINE.json tracked metric ("feature-histogram build ms/iter") and
     the evidence behind PERF.md's kernel-quality claim.  Methodology of
     docs/GPU-Performance.rst:108-124 (time the device histogram kernel on
     the benchmark config), plus a same-session matmul peak measurement so
     the roofline fraction compares against THIS device's real ceiling.
     Every number is from R reps inside one jit scan (one dispatch), with
-    per-rep input perturbation to defeat CSE."""
+    per-rep input perturbation to defeat CSE.
+
+    ``hist_ms_per_iter`` is derived from the PROBED round schedule: each
+    round's pass is priced at its slot bucket's measured time (the wave
+    grower runs sliced 4/16/64-slot variants), plus the 1-slot root pass.
+    """
     import jax
     import jax.numpy as jnp
     from jax import lax
 
-    from lightgbmv1_tpu.ops.histogram import hist_wave
+    from lightgbmv1_tpu.models.grower_wave import slot_buckets_for
+    from lightgbmv1_tpu.ops.histogram import default_hist_method, hist_wave
 
-    SLOTS = 64            # the wave grower's K+1 slots at auto K=64
+    K = 64                # the wave grower's auto K at 255 leaves
+    BUCKETS = tuple(slot_buckets_for(K, N))   # single source of truth
     B = 64                # padded bin axis for max_bin=63
     binned = jnp.asarray(ds.train_matrix)
     F = binned.shape[0]
     rng = np.random.RandomState(7)
     g3 = jnp.asarray(rng.randn(N, 3).astype(np.float32))
-    label = jnp.asarray(rng.randint(0, SLOTS, size=N).astype(np.int32))
-
-    from lightgbmv1_tpu.ops.histogram import default_hist_method
-
     method = default_hist_method("auto", binned.dtype)
 
-    def timed_per_rep(make_reps, r1, r2):
-        """Per-rep seconds from a TWO-length-scan differential: wall(r2) -
-        wall(r1) over (r2 - r1) reps cancels dispatch latency and other
-        per-call fixed costs (the ~113 ms tunnel round-trip would otherwise
-        dominate and overstate per-rep time severalfold)."""
-        f1, f2 = make_reps(r1), make_reps(r2)
-        jax.device_get(f1())
-        jax.device_get(f2())
-        diffs = []
-        for _ in range(5):
-            t0 = time.time()
-            jax.device_get(f1())
-            t1 = time.time()
-            jax.device_get(f2())
-            t2 = time.time()
-            diffs.append(((t2 - t1) - (t1 - t0)) / (r2 - r1))
-        # MEDIAN, not min: the minimum of a difference of two noisy walls
-        # can go spuriously small (slow short run + fast long run) and
-        # overstate throughput past physical peaks
-        return max(float(np.median(diffs)), 1e-9)
+    def hist_make_for(slots):
+        label = jnp.asarray(
+            rng.randint(0, slots, size=N).astype(np.int32))
 
-    def hist_make(r):
-        @jax.jit
-        def reps():
-            def body(c, i):
-                g = g3 * (1.0 + 1e-6 * i.astype(jnp.float32))  # defeat CSE
-                h = hist_wave(binned, g, label, SLOTS, B, method=method)
-                return c + h.sum(), None
-            s, _ = lax.scan(body, jnp.float32(0), jnp.arange(r))
-            return s
-        return reps
+        def hist_make(r):
+            @jax.jit
+            def reps():
+                def body(c, i):
+                    g = g3 * (1.0 + 1e-6 * i.astype(jnp.float32))
+                    h = hist_wave(binned, g, label, slots, B, method=method)
+                    return c + h.sum(), None
+                s, _ = lax.scan(body, jnp.float32(0), jnp.arange(r))
+                return s
+            return reps
+        return hist_make
 
-    per_pass = timed_per_rep(hist_make, 4, 16)
-    hist_ms = per_pass * 1e3
-    # one-hot MXU formulation: (3*(SLOTS+1), rows) @ (rows, B*F) per pass,
+    pass_ms = {}
+    for slots in (1,) + BUCKETS:
+        pass_ms[slots] = timed_per_rep(hist_make_for(slots), 4, 16) * 1e3
+
+    per_pass = pass_ms[K] / 1e3
+    # one-hot MXU formulation: (3*(K+1), rows) @ (rows, B*F) per pass,
     # bf16x2 = 2 passes (ops/hist_pallas.py)
-    hist_flops = 2 * 3 * (SLOTS + 1) * N * B * F * 2
+    hist_flops = 2 * 3 * (K + 1) * N * B * F * 2
     hist_tfs = hist_flops / per_pass / 1e12
 
     # device matmul peak, same session, same measurement discipline
@@ -131,14 +237,150 @@ def measure_hist_and_roofline(ds, N):
         return reps
 
     peak_tfs = (2 * M ** 3) / timed_per_rep(mm_make, 8, 64) / 1e12
-    return {
-        "hist_ms_per_pass": round(hist_ms, 2),
-        # a 255-leaf wave tree runs ceil(254/64) = 4 wave rounds per iter
-        # (auto wave K = num_leaves/4, smaller-child subtraction pass)
-        "hist_ms_per_iter": round(hist_ms * 4, 2),
+
+    def bucket_of(k):
+        for s in BUCKETS:
+            if k <= s:
+                return s
+        return K
+
+    out = {
+        "hist_ms_per_pass": round(pass_ms[K], 2),
+        "hist_ms_per_pass_root": round(pass_ms[1], 2),
         "hist_achieved_tf_s": round(hist_tfs, 2),
         "device_matmul_peak_tf_s": round(peak_tfs, 2),
         "hist_roofline_frac": round(hist_tfs / peak_tfs, 4),
+    }
+    for s in BUCKETS[:-1]:   # ramp buckets exist only when bucketing is on
+        out[f"hist_ms_per_pass_s{s}"] = round(pass_ms[s], 2)
+    if schedule:
+        rounds = schedule["schedule"]
+        iters = max(1, round(len(rounds) / schedule["rounds_per_tree"]))
+        if schedule.get("estimated"):
+            out["wave_rounds_estimated"] = True
+    else:
+        est = estimated_wave_schedule(K)
+        rounds, iters = est["schedule"], 1
+        out["wave_rounds_estimated"] = True
+    per_iter = (sum(pass_ms[bucket_of(k)] for k in rounds) / iters
+                + pass_ms[1])
+    out["wave_rounds_per_tree"] = round(len(rounds) / iters, 2)
+    out["hist_ms_per_iter"] = round(per_iter, 2)
+    return out
+
+
+def measure_phases(ds, N, gb_lw, schedule, hist_fields, n_valid,
+                   per_iter_ms):
+    """Per-phase ms/iter breakdown (VERDICT r4 #3) — the role of the
+    reference's USE_TIMETAG global timer printout
+    (include/LightGBM/utils/common.h:1054-1138).
+
+    Each phase op is timed with the two-length-scan differential at the
+    bench shapes and priced over the PROBED round schedule:
+      hist        — from measure_hist_and_roofline (per-bucket passes)
+      partition   — the (S, N) decision pass (bin reads + compares + the
+                    leaf-id/label reductions), per bucket, train rows
+      valid_route — the same pass over the attached valid set's rows
+      split       — the vmapped 2K-child find_best_split scan
+      other       — residual vs the measured per-iteration wall (top-k,
+                    tree assembly scatters, scan/while overheads)
+    The partition/split ops are re-created at bench shapes from the same
+    modules the grower uses; 'other' being a residual is what keeps the
+    decomposition honest against the measured total."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from lightgbmv1_tpu.models.grower_wave import slot_buckets_for
+    from lightgbmv1_tpu.ops.split import NO_CONSTRAINT, find_best_split
+
+    B = 64
+    K = 64
+    BUCKETS = tuple(slot_buckets_for(K, N))
+    binned = jnp.asarray(ds.train_matrix)
+    F = binned.shape[0]
+    L = 255
+    rng = np.random.RandomState(11)
+    rounds = schedule["schedule"]
+    iters = max(1, round(len(rounds) / schedule["rounds_per_tree"]))
+
+    def bucket_of(k):
+        for s in BUCKETS:
+            if k <= s:
+                return s
+        return K
+
+    def part_make_for(S, rows):
+        lids = jnp.asarray(rng.randint(0, L, size=rows).astype(np.int32))
+        feats = jnp.asarray(rng.randint(0, F, size=S).astype(np.int32))
+        thrs = jnp.asarray(rng.randint(0, B, size=S).astype(np.int32))
+        leafs = jnp.asarray(rng.randint(0, L, size=S).astype(np.int32))
+        nls = leafs + 1
+        sml = jnp.asarray(rng.rand(S) < 0.5)
+        siota = jnp.arange(S, dtype=jnp.int32)
+        mat = binned[:, :rows]
+
+        def make(r):
+            @jax.jit
+            def reps():
+                def body(c, i):
+                    fk = (feats + i) % F
+                    bk = jax.vmap(lambda f: mat[f])(fk).astype(jnp.int32)
+                    gl = bk <= thrs[:, None]
+                    mine = lids[None, :] == leafs[:, None]
+                    upd = jnp.sum(jnp.where(
+                        mine & (~gl), nls[:, None] - lids[None, :], 0),
+                        axis=0)
+                    lab = jnp.sum(jnp.where(
+                        mine & (gl == sml[:, None]), siota[:, None] - S, 0),
+                        axis=0) + S
+                    return c + upd.sum() + lab.sum(), None
+                s, _ = lax.scan(body, jnp.int32(0), jnp.arange(r))
+                return s
+            return reps
+        return make
+
+    part_ms = {s: timed_per_rep(part_make_for(s, N), 4, 16) * 1e3
+               for s in BUCKETS}
+    partv_ms = {s: timed_per_rep(part_make_for(s, n_valid), 4, 16) * 1e3
+                for s in BUCKETS} if n_valid else {s: 0.0 for s in BUCKETS}
+
+    meta = gb_lw.meta
+    params = gb_lw.split_params
+    h2k = jnp.asarray(
+        np.abs(rng.randn(2 * K, F, B, 3)).astype(np.float32))
+    parents = h2k[:, 0].sum(axis=1)                    # (2K, 3)
+    mask = jnp.ones(F, bool)
+    nc = jnp.asarray(NO_CONSTRAINT, jnp.float32)
+
+    def split_make(r):
+        @jax.jit
+        def reps():
+            def body(c, i):
+                h = h2k * (1.0 + 1e-6 * i.astype(jnp.float32))
+                res = jax.vmap(
+                    lambda hh, pp: find_best_split(
+                        hh, pp, meta, mask, params, nc, 1, 0.0, 0.0,
+                        None, None))(h, parents)
+                return c + res.gain.sum(), None
+            s, _ = lax.scan(body, jnp.float32(0), jnp.arange(r))
+            return s
+        return reps
+
+    split_round_ms = timed_per_rep(split_make, 2, 8) * 1e3
+
+    hist_iter = hist_fields.get("hist_ms_per_iter", 0.0)
+    part_iter = sum(part_ms[bucket_of(k)] for k in rounds) / iters
+    partv_iter = sum(partv_ms[bucket_of(k)] for k in rounds) / iters
+    split_iter = split_round_ms * len(rounds) / iters
+    other = per_iter_ms - hist_iter - part_iter - partv_iter - split_iter
+    return {
+        "phase_hist_ms": round(hist_iter, 2),
+        "phase_partition_ms": round(part_iter, 2),
+        "phase_valid_route_ms": round(partv_iter, 2),
+        "phase_split_ms": round(split_iter, 2),
+        "phase_other_ms": round(other, 2),
+        "phase_total_measured_ms": round(per_iter_ms, 2),
     }
 
 
@@ -248,10 +490,28 @@ def main():
 
     extra = {}
     if backend != "cpu" and os.environ.get("BENCH_FULL", "1") == "1":
+        schedule = None
         try:
-            extra.update(measure_hist_and_roofline(ds, N))
+            schedule = probe_round_schedule(cfg_lw, ds)
         except Exception as e:  # noqa: BLE001 — partial records beat none
+            extra["round_probe_error"] = f"{type(e).__name__}: {e}"[:200]
+        if schedule is None:
+            # degrade to the estimated frontier schedule, flagged, so the
+            # record still carries hist_ms_per_iter + phase fields
+            schedule = estimated_wave_schedule()
+        hist_fields = {}
+        try:
+            hist_fields = measure_hist_and_roofline(ds, N, schedule)
+            extra.update(hist_fields)
+        except Exception as e:  # noqa: BLE001
             extra["hist_error"] = f"{type(e).__name__}: {e}"[:200]
+        try:
+            if schedule:
+                extra.update(measure_phases(
+                    ds, N, gb_lw, schedule, hist_fields, N_TEST,
+                    per_iter_ms=lw_dt / lw_trees * 1e3))
+        except Exception as e:  # noqa: BLE001
+            extra["phase_error"] = f"{type(e).__name__}: {e}"[:200]
 
         # DART per-iteration cost (fused single-dispatch iteration):
         # VERDICT r3 #7 asks this within ~2x of the scanned GBDT path
@@ -274,10 +534,103 @@ def main():
             dart_dt = time.time() - t0
             dart_mrt = N * DIT / dart_dt / 1e6
             extra["dart_M_row_trees_per_s"] = round(dart_mrt, 3)
+            # denominator = the SCANNED LEAF-WISE number the name promises
+            # (VERDICT r4 weak #4: this once divided by the level-wise
+            # block's throughput); note DART here is timed per-iteration
+            # dispatch while the denominator block is scanned, so the
+            # ratio carries ~113 ms/iter of tunnel dispatch against DART
             extra["dart_frac_of_scanned_gbdt"] = round(
-                dart_mrt / max(row_trees_per_s, 1e-9), 3)
+                dart_mrt / max(leafwise_mrt, 1e-9), 3)
         except Exception as e:  # noqa: BLE001
             extra["dart_error"] = f"{type(e).__name__}: {e}"[:200]
+
+        # ---- parity set beyond binary (VERDICT r4 missing #1): the
+        # reference publishes multiclass and ranking rows in
+        # docs/Experiments.rst:113-151; golden tests prove these families
+        # CORRECT — these blocks put speed + quality on record against the
+        # same-host reference binary at matched configs (constants
+        # measured with tools/measure_ref_parity.py, 1 core, idle host,
+        # training-only timing via metric_freq=<iters>)
+        try:
+            MC_N, MC_CLS, MC_IT = 250_000, 5, 50
+            Xm, ym = make_multiclass_data(MC_N, 10, MC_CLS)
+            Xmv, ymv = make_multiclass_data(50_000, 11, MC_CLS)
+            cfg_mc = Config.from_dict({
+                "objective": "multiclass", "num_class": MC_CLS,
+                "num_leaves": 127, "max_bin": 63, "learning_rate": 0.1,
+                "min_data_in_leaf": 20, "metric": "multi_logloss",
+                "verbosity": -1, "tree_growth": "leafwise"})
+            dsm = BinnedDataset.from_numpy(Xm, label=ym, config=cfg_mc)
+            dsmv = BinnedDataset.from_numpy(Xmv, label=ymv, config=cfg_mc,
+                                            reference=dsm)
+            gbm = create_boosting(cfg_mc, dsm)
+            gbm.add_valid(dsmv, "test")
+            # warm-up block has the SAME scan length as the timed block —
+            # a different length would recompile inside the timed window
+            BLK = MC_IT // 2
+            gbm.train_iters(BLK)
+            jax.device_get(gbm._train_scores.score)
+            t0 = time.time()
+            gbm.train_iters(BLK)
+            jax.device_get(gbm._train_scores.score)
+            mc_dt = time.time() - t0
+            mc_mrt = MC_N * BLK * MC_CLS / mc_dt / 1e6
+            mll = None
+            for (_, name, value, _) in gbm.eval_valid():
+                if name == "multi_logloss":
+                    mll = float(value)
+            extra["multiclass_M_row_trees_per_s"] = round(mc_mrt, 3)
+            extra["multiclass_logloss"] = (round(mll, 5)
+                                           if mll is not None else None)
+            # reference C++ on THIS host, same data/config (recorded by
+            # tools/measure_ref_parity.py)
+            if REF_MC_M_ROW_TREES_S:
+                extra["multiclass_ref_cpp_M_row_trees_per_s"] = \
+                    REF_MC_M_ROW_TREES_S
+                extra["multiclass_vs_ref_same_host"] = round(
+                    mc_mrt / REF_MC_M_ROW_TREES_S, 4)
+                extra["multiclass_ref_cpp_logloss"] = REF_MC_LOGLOSS
+        except Exception as e:  # noqa: BLE001
+            extra["multiclass_error"] = f"{type(e).__name__}: {e}"[:200]
+
+        try:
+            RK_Q, RK_D, RK_IT = 2000, 100, 100
+            Xr, yr, gr = make_rank_data(RK_Q, RK_D, 20)
+            Xrv, yrv, grv = make_rank_data(400, RK_D, 21)
+            cfg_rk = Config.from_dict({
+                "objective": "lambdarank", "num_leaves": 63, "max_bin": 63,
+                "learning_rate": 0.1, "min_data_in_leaf": 20,
+                "metric": "ndcg", "eval_at": [10], "verbosity": -1,
+                "tree_growth": "leafwise"})
+            dsr = BinnedDataset.from_numpy(Xr, label=yr, group=gr,
+                                           config=cfg_rk)
+            dsrv = BinnedDataset.from_numpy(Xrv, label=yrv, group=grv,
+                                            config=cfg_rk, reference=dsr)
+            gbr = create_boosting(cfg_rk, dsr)
+            gbr.add_valid(dsrv, "test")
+            # same-scan-length warm-up, then three timed blocks
+            BLKR = RK_IT // 4
+            gbr.train_iters(BLKR)
+            jax.device_get(gbr._train_scores.score)
+            t0 = time.time()
+            for _ in range(3):
+                gbr.train_iters(BLKR)
+            jax.device_get(gbr._train_scores.score)
+            rk_dt = time.time() - t0
+            rk_mrt = RK_Q * RK_D * 3 * BLKR / rk_dt / 1e6
+            ndcg = None
+            for (_, name, value, _) in gbr.eval_valid():
+                if "ndcg" in name:
+                    ndcg = float(value)
+            extra["rank_M_row_trees_per_s"] = round(rk_mrt, 3)
+            extra["rank_ndcg10"] = round(ndcg, 5) if ndcg is not None else None
+            if REF_RK_M_ROW_TREES_S:
+                extra["rank_ref_cpp_M_row_trees_per_s"] = REF_RK_M_ROW_TREES_S
+                extra["rank_vs_ref_same_host"] = round(
+                    rk_mrt / REF_RK_M_ROW_TREES_S, 4)
+                extra["rank_ref_cpp_ndcg10"] = REF_RK_NDCG10
+        except Exception as e:  # noqa: BLE001
+            extra["rank_error"] = f"{type(e).__name__}: {e}"[:200]
 
         # 500-tree north star (docs/Experiments.rst:110-135 methodology on
         # this host's data): reference side measured with the same binary

@@ -317,6 +317,15 @@ class Config:
                                    # exact sequential best-first order
     hist_method: str = "auto"      # auto | scatter | onehot | pallas
     hist_dtype: str = "bf16x2"     # bf16 | bf16x2 | f32 | int8 (quantized) precision
+    # histogram precision for the wave grower's SUSTAINED rounds (the
+    # largest slot bucket of a big wave — deep-frontier rounds whose
+    # leaves hold small gradient aggregates); "" = auto: bf16x2 drops to
+    # single-pass bf16 there (measured faster at equal-or-better 500-iter
+    # AUC), any other hist_dtype is used unchanged.  Ramp-up rounds and
+    # the root pass — where per-bin sums are large and precision-critical
+    # — always use hist_dtype.  The TPU analog of the reference's
+    # fp32-hist-GPU-parity precedent (docs/GPU-Performance.rst:133-160).
+    hist_dtype_deep: str = ""
     num_shards: int = 0            # devices for data-parallel (0 = all available)
     profile_dir: str = ""          # write a jax.profiler device trace of
                                    # training here; hist/split/partition
@@ -437,6 +446,9 @@ class Config:
                 self.hist_method = "scatter"
             elif self.force_row_wise:
                 self.hist_method = "onehot"
+        if self.gpu_use_dp:
+            # the double-precision request covers deep wave rounds too
+            self.hist_dtype_deep = "f32"
         if self.gpu_use_dp and self.hist_dtype in ("bf16", "bf16x2", "int8"):
             # gpu_use_dp = highest-precision device histograms
             # (reference gpu_tree_learner.h:79 hist_t selection)

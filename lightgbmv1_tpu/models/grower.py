@@ -735,7 +735,7 @@ def make_levelwise_grower(
     hist_frontier_fn: Callable = None,
     split_fn: Callable = None,
     sums_fn: Callable = None,
-    bins_of_rows_fn: Callable = None,
+    bins_of_fn: Callable = None,
 ):
     """Depth-wise tree growth with the whole frontier batched per level.
 
@@ -812,9 +812,11 @@ def make_levelwise_grower(
         def sums_fn(g3):
             return g3.sum(axis=0)
 
-    if bins_of_rows_fn is None:
-        def bins_of_rows_fn(binned, f_row):
-            return jnp.take_along_axis(binned, f_row[None, :], axis=0)[0]
+    if bins_of_fn is None:
+        def bins_of_fn(binned, feat):
+            return binned[feat]
+
+    use_cat_lw = bool(np.asarray(meta.is_categorical).any())
 
     def allowed_features_batch(used):
         if groups_lw is None:
@@ -870,13 +872,18 @@ def make_levelwise_grower(
                 p_hist, p_mask, p_new, p_sml = prev
                 Lp = p_hist.shape[0]
                 # label rows of each split's smaller child with the PARENT
-                # slot; everything else is dead (slot Lp, sliced away)
+                # slot; everything else is dead (slot Lp, sliced away).
+                # (Lp, N) broadcast-compare, NOT a per-row table gather —
+                # 1M-row gathers measure 8-12 ms on this device vs ~3 ms
+                # for a whole compare pass (tools/microbench_gather.py)
                 sm_id = jnp.where(p_sml, jnp.arange(Lp, dtype=jnp.int32),
                                   p_new)
-                slot_of_leaf = jnp.full(L + 1, Lp, jnp.int32).at[
-                    jnp.where(p_mask, sm_id, L + 1)].set(
-                    jnp.arange(Lp, dtype=jnp.int32), mode="drop")
-                label = slot_of_leaf[jnp.minimum(leaf_id, L)]
+                sm_leaf = jnp.where(p_mask, sm_id, L + 1)       # (Lp,)
+                mine_s = sm_leaf[:, None] == leaf_id[None, :]   # (Lp, N)
+                label = jnp.sum(jnp.where(
+                    mine_s,
+                    jnp.arange(Lp, dtype=jnp.int32)[:, None] - Lp, 0),
+                    axis=0) + Lp
                 h_small = hist_frontier_fn(binned, g3, label, Lp + 1)[:Lp]
                 smL = p_sml[:, None, None, None]
                 h_left = jnp.where(smL, h_small, p_hist - h_small)
@@ -970,25 +977,36 @@ def make_levelwise_grower(
                     applied, jnp.stack([tleaf, new_leaf[tleaf]]),
                     forced_leaf[s]))
 
-            # per-row partition update (vectorized over all rows at once)
-            feat_l = jnp.where(split_mask, res.feature, 0)
-            thr_l = jnp.where(split_mask, res.threshold_bin, 0)
-            dl_l = res.default_left
-            lid_c = jnp.minimum(leaf_id, Ld - 1)
-            f_row = feat_l[lid_c]
-            in_split = split_mask[lid_c] & (leaf_id < Ld)
-            b_row = bins_of_rows_fn(binned, f_row)
-            is_na = ((meta.missing_type[f_row] == MISSING_NAN)
-                     & (b_row == meta.nan_bin[f_row])) | (
-                (meta.missing_type[f_row] == MISSING_ZERO)
-                & (b_row == meta.zero_bin[f_row]))
-            go_left = jnp.where(is_na, dl_l[lid_c], b_row <= thr_l[lid_c])
-            # categorical rows: bin-space bitset membership
-            bi = b_row.astype(jnp.int32)
-            word = res.cat_bitset.reshape(-1)[lid_c * W + (bi >> 5)]
-            in_set = ((word >> (bi.astype(jnp.uint32) & 31)) & 1) == 1
-            go_left = jnp.where(res.is_cat[lid_c], in_set, go_left)
-            leaf_id = jnp.where(in_split & (~go_left), new_leaf[lid_c], leaf_id)
+            # partition update: (Ld, N) broadcast-compare over the level's
+            # split leaves (the same formulation as the wave grower's
+            # round_pass — per-row table gathers measure 8-12 ms per 1M
+            # rows on this device vs ~3 ms for the whole compare pass,
+            # tools/microbench_gather.py; this was ~2/3 of the level-wise
+            # iteration before round 5)
+            feat_k = res.feature                             # (Ld,)
+            leafk = jnp.where(split_mask,
+                              jnp.arange(Ld, dtype=jnp.int32), L)
+            bk = jax.vmap(lambda f: bins_of_fn(binned, f))(feat_k) \
+                .astype(jnp.int32)                           # (Ld, N)
+            mt_k = meta.missing_type[feat_k][:, None]
+            na_k = ((mt_k == MISSING_NAN)
+                    & (bk == meta.nan_bin[feat_k][:, None])) | (
+                (mt_k == MISSING_ZERO)
+                & (bk == meta.zero_bin[feat_k][:, None]))
+            glk = jnp.where(na_k, res.default_left[:, None],
+                            bk <= res.threshold_bin[:, None])
+            if use_cat_lw:  # categorical: bin-space bitset membership
+                word = jnp.zeros(bk.shape, jnp.uint32)
+                for wv in range(W):
+                    word = jnp.where((bk >> 5) == wv,
+                                     res.cat_bitset[:, wv][:, None], word)
+                in_set = ((word >> (bk.astype(jnp.uint32) & 31)) & 1) == 1
+                glk = jnp.where(res.is_cat[:, None], in_set, glk)
+            mine = leafk[:, None] == leaf_id[None, :]        # (Ld, N)
+            go_r = mine & (~glk)
+            leaf_id = leaf_id + jnp.sum(
+                jnp.where(go_r, new_leaf[:, None] - leaf_id[None, :], 0),
+                axis=0)
 
             # tree array updates (scatter with out-of-bounds drop for masked)
             nd = jnp.where(split_mask, node_idx, L1 + 1)

@@ -74,6 +74,16 @@ from .tree import TreeArrays, empty_tree
 # full-wave path).  Lowered by tests to exercise the bucketed branches.
 _BUCKET_MIN_N = 1 << 16
 
+
+def slot_buckets_for(K: int, N: int):
+    """The wave grower's slot-bucket ladder for wave size ``K`` over ``N``
+    rows — the single source of truth, shared with bench.py's round-cost
+    derivation (each probed round is priced at its bucket's measured pass
+    time)."""
+    if K > 4 and N >= _BUCKET_MIN_N:
+        return sorted({4, min(16, K), K})
+    return [K]
+
 # Optional host callback fired once per EXECUTED wave round with the
 # round's realized split count (jax.debug.callback in the while-loop
 # body).  bench.py sets this on a probe model to record the ACTUAL
@@ -217,9 +227,12 @@ def make_wave_grower(
 ):
     """Build the jittable ``grow(binned, g3, base_mask, key)`` function.
 
-    ``hist_wave_fn(binned, g3, label, nslots) -> (nslots, F, B, 3)`` —
-    histograms of the rows labeled ``0..nslots-1`` (label ``nslots`` = dead);
-    globally summed in distributed mode.
+    ``hist_wave_fn(binned, g3, label, nslots, deep=False) ->
+    (nslots, F, B, 3)`` — histograms of the rows labeled ``0..nslots-1``
+    (label ``nslots`` = dead); globally summed in distributed mode.
+    ``deep=True`` marks a sustained (largest-bucket) round of a big wave —
+    the implementation may drop to the configured cheaper histogram dtype
+    there (config.hist_dtype_deep).
     ``split_fn(hist, parent, mask, key, uid, constraint, depth,
     parent_output) -> SplitResult`` — vmapped over the 2K children.
     ``sums_fn(g3) -> (3,)`` — root totals (psum over the row axis when
@@ -286,13 +299,10 @@ def make_wave_grower(
         # cheaper at S=4 vs S=64 on the bench config (the remaining floor
         # is the slot-count-independent in-VMEM one-hot build).  Selection
         # is by the replicated n_split, so row shards stay in lockstep.
-        if K > 4 and N >= _BUCKET_MIN_N:
-            slot_buckets = sorted({4, min(16, K), K})
-        else:
-            slot_buckets = [K]
+        slot_buckets = slot_buckets_for(K, N)
 
         leaf_id0 = jnp.zeros(N, jnp.int32)
-        hist0 = hist_wave_fn(binned, g3, leaf_id0, 1)[0]
+        hist0 = hist_wave_fn(binned, g3, leaf_id0, 1, deep=False)[0]
         # smaller-child + subtraction mode: build K child histograms per
         # round instead of 2K (halves the one-hot MXU pass and, in
         # data-parallel mode, the psum volume — the reference's
@@ -456,10 +466,17 @@ def make_wave_grower(
                         label = jnp.sum(jnp.where(mine, slot2 - 2 * S, 0),
                                         axis=0) + 2 * S
 
+                # sustained rounds (the LARGEST bucket of a big wave) may
+                # run the configured cheaper deep precision; ramp rounds
+                # and the root pass always keep full precision.  With
+                # bucketing off (small N) there ARE no separate ramp
+                # variants — everything stays full precision
+                deep = S == K and K >= 32 and len(slot_buckets) > 1
                 if use_sub:
-                    h = hist_wave_fn(binned, g3, label, S)    # (S, F, B, 3)
+                    h = hist_wave_fn(binned, g3, label, S,    # (S, F, B, 3)
+                                     deep=deep)
                 else:
-                    h = hist_wave_fn(binned, g3, label, 2 * S)
+                    h = hist_wave_fn(binned, g3, label, 2 * S, deep=deep)
                 full = 2 * K if not use_sub else K
                 if h.shape[0] < full:   # pad to the bucket-invariant width
                     h = jnp.concatenate(

@@ -1,0 +1,99 @@
+"""Measure the reference C++ CLI on the bench's multiclass / lambdarank
+parity datasets (VERDICT r4 missing #1) — run on an IDLE host, 1 core.
+
+Generates the IDENTICAL synthetic data bench.py uses (same generator
+functions, same seeds), writes TSVs + .query files, runs the reference
+binary (built at /tmp/refbuild/lightgbm per the round-4 recipe:
+`cmake -S /root/reference -B /tmp/refbuild && move artifacts out of the
+source dir`), and prints the constants to record in bench.py
+(REF_MC_* / REF_RK_*).
+
+Training-only timing: process wall minus the binary's logged data-loading
+time, with metric_freq = num_iterations so per-iteration eval cost is
+excluded (the same discipline as the binary-objective yardstick recorded
+in round 4)."""
+import os
+import re
+import subprocess
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from bench import make_multiclass_data, make_rank_data  # noqa: E402
+
+BIN = os.environ.get("REF_LGBM", "/tmp/refbuild/lightgbm")
+WORK = "/tmp/ref_parity"
+os.makedirs(WORK, exist_ok=True)
+
+
+def write_tsv(path, X, y):
+    t0 = time.time()
+    arr = np.column_stack([y, X])
+    np.savetxt(path, arr, fmt="%.6g", delimiter="\t")
+    print(f"wrote {path} in {time.time() - t0:.1f}s", flush=True)
+
+
+def run_conf(name, lines):
+    conf = os.path.join(WORK, f"{name}.conf")
+    with open(conf, "w") as fh:
+        fh.write("\n".join(lines) + "\n")
+    t0 = time.time()
+    out = subprocess.run([BIN, f"config={conf}"], cwd=WORK,
+                         capture_output=True, text=True, timeout=3600)
+    wall = time.time() - t0
+    text = out.stdout + out.stderr
+    m = re.search(r"Finished loading data in ([\d.]+) seconds", text)
+    load_s = float(m.group(1)) if m else 0.0
+    return wall - load_s, text
+
+
+def main():
+    # ---- multiclass (must mirror bench.py's cfg_mc block) ----------------
+    MC_N, MC_CLS, MC_IT = 250_000, 5, 50
+    Xm, ym = make_multiclass_data(MC_N, 10, MC_CLS)
+    Xmv, ymv = make_multiclass_data(50_000, 11, MC_CLS)
+    tr, va = os.path.join(WORK, "mc.train.tsv"), os.path.join(WORK, "mc.valid.tsv")
+    if not os.path.exists(tr):
+        write_tsv(tr, Xm, ym)
+        write_tsv(va, Xmv, ymv)
+    train_s, text = run_conf("mc", [
+        "task = train", "objective = multiclass", f"num_class = {MC_CLS}",
+        f"data = {tr}", f"valid = {va}", "num_leaves = 127", "max_bin = 63",
+        "learning_rate = 0.1", "min_data_in_leaf = 20",
+        "metric = multi_logloss", f"num_iterations = {MC_IT}",
+        f"metric_freq = {MC_IT}", "num_threads = 1", "verbosity = 1",
+        "output_model = /dev/null",
+    ])
+    lls = re.findall(r"multi_logloss\s*:\s*([\d.]+)", text)
+    mrt = MC_N * MC_IT * MC_CLS / train_s / 1e6
+    print(f"REF_MC_M_ROW_TREES_S = {mrt:.3f}   # {train_s:.1f}s train")
+    print(f"REF_MC_LOGLOSS = {lls[-1] if lls else None}")
+
+    # ---- lambdarank (must mirror bench.py's cfg_rk block) ----------------
+    RK_Q, RK_D, RK_IT = 2000, 100, 100
+    Xr, yr, gr = make_rank_data(RK_Q, RK_D, 20)
+    Xrv, yrv, grv = make_rank_data(400, RK_D, 21)
+    tr, va = os.path.join(WORK, "rk.train.tsv"), os.path.join(WORK, "rk.valid.tsv")
+    if not os.path.exists(tr):
+        write_tsv(tr, Xr, yr)
+        write_tsv(va, Xrv, yrv)
+        np.savetxt(tr + ".query", gr, fmt="%d")
+        np.savetxt(va + ".query", grv, fmt="%d")
+    train_s, text = run_conf("rk", [
+        "task = train", "objective = lambdarank",
+        f"data = {tr}", f"valid = {va}", "num_leaves = 63", "max_bin = 63",
+        "learning_rate = 0.1", "min_data_in_leaf = 20",
+        "metric = ndcg", "eval_at = 10", f"num_iterations = {RK_IT}",
+        f"metric_freq = {RK_IT}", "num_threads = 1", "verbosity = 1",
+        "output_model = /dev/null",
+    ])
+    nd = re.findall(r"ndcg@10\s*:\s*([\d.]+)", text)
+    mrt = RK_Q * RK_D * RK_IT / train_s / 1e6
+    print(f"REF_RK_M_ROW_TREES_S = {mrt:.3f}   # {train_s:.1f}s train")
+    print(f"REF_RK_NDCG10 = {nd[-1] if nd else None}")
+
+
+if __name__ == "__main__":
+    main()
