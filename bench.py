@@ -122,10 +122,14 @@ def timed_per_rep(make_reps, r1, r2):
     return max(float(np.median(diffs)), 1e-9)
 
 
-def estimated_wave_schedule(K=64, budget=254):
+def estimated_wave_schedule(K=None, budget=254):
     """Frontier-doubling estimate (1,2,4,..,K then sustained K) — the
     fallback when the round probe cannot run, always flagged
     `wave_rounds_estimated` in the record."""
+    if K is None:
+        from lightgbmv1_tpu.models.grower_wave import auto_wave_size
+
+        K = auto_wave_size(255)
     rounds, splits, k = [], 0, 1
     while splits < budget:
         rounds.append(min(k, budget - splits))
@@ -135,33 +139,27 @@ def estimated_wave_schedule(K=64, budget=254):
             "estimated": True}
 
 
-def probe_round_schedule(cfg_lw, ds, iters=3):
+def probe_round_schedule(model, n_trees=5, K=None):
     """ACTUAL wave-round schedule per tree (VERDICT r4 weak #2: the old
     record derived hist_ms_per_iter from an assumed 4 rounds/tree; the
-    frontier RAMPS 1,2,4,... so a 255-leaf tree takes ~10).  A fresh probe
-    model is traced with grower_wave._ROUND_PROBE set: the while-loop body
-    fires a host callback with each round's realized split count."""
-    from lightgbmv1_tpu.models import grower_wave
-    from lightgbmv1_tpu.models.gbdt import create_boosting
+    frontier RAMPS 1,2,4,... so a 255-leaf tree takes ~10-11).  Replayed
+    EXACTLY from trees the bench already trained — their recorded
+    structure + gains determine the executed round grouping
+    (grower_wave.replay_wave_schedule; the axon runtime cannot run
+    jax.debug callbacks, and the replay needs no device round-trip at
+    all).  A CPU test pins replay == the live _ROUND_PROBE counts."""
+    from lightgbmv1_tpu.models.grower_wave import (auto_wave_size,
+                                                    replay_wave_schedule)
 
-    schedule = []
-    grower_wave._ROUND_PROBE = lambda k: schedule.append(int(k))
-    try:
-        probe = create_boosting(cfg_lw, ds)
-        for _ in range(iters):
-            probe.train_one_iter(check_stop=False)
-        import jax
-
-        jax.device_get(probe._train_scores.score)
-        # debug.callback effects are ASYNC: device_get waits for the value,
-        # not for pending host callbacks — flush before reading the list
-        jax.effects_barrier()
-    finally:
-        grower_wave._ROUND_PROBE = None
-    if not schedule:
+    if K is None:   # the bench config leaves leafwise_wave_size on auto
+        K = auto_wave_size(255)
+    trees = model.materialize_host_trees()[:n_trees]
+    scheds = [s for s in replay_wave_schedule(trees, K) if s]
+    if not scheds:
         return None
-    per_tree = len(schedule) / iters
-    return {"schedule": schedule, "rounds_per_tree": per_tree}
+    rounds = [k for s in scheds for k in s]
+    return {"schedule": rounds,
+            "rounds_per_tree": len(rounds) / len(scheds)}
 
 
 def measure_hist_and_roofline(ds, N, schedule=None):
@@ -182,10 +180,11 @@ def measure_hist_and_roofline(ds, N, schedule=None):
     import jax.numpy as jnp
     from jax import lax
 
-    from lightgbmv1_tpu.models.grower_wave import slot_buckets_for
+    from lightgbmv1_tpu.models.grower_wave import (auto_wave_size,
+                                                    slot_buckets_for)
     from lightgbmv1_tpu.ops.histogram import default_hist_method, hist_wave
 
-    K = 64                # the wave grower's auto K at 255 leaves
+    K = auto_wave_size(255)   # the wave grower's auto K (= 63) at 255 leaves
     BUCKETS = tuple(slot_buckets_for(K, N))   # single source of truth
     B = 64                # padded bin axis for max_bin=63
     binned = jnp.asarray(ds.train_matrix)
@@ -291,11 +290,12 @@ def measure_phases(ds, N, gb_lw, schedule, hist_fields, n_valid,
     import jax.numpy as jnp
     from jax import lax
 
-    from lightgbmv1_tpu.models.grower_wave import slot_buckets_for
+    from lightgbmv1_tpu.models.grower_wave import (auto_wave_size,
+                                                    slot_buckets_for)
     from lightgbmv1_tpu.ops.split import NO_CONSTRAINT, find_best_split
 
     B = 64
-    K = 64
+    K = auto_wave_size(255)
     BUCKETS = tuple(slot_buckets_for(K, N))
     binned = jnp.asarray(ds.train_matrix)
     F = binned.shape[0]
@@ -367,7 +367,9 @@ def measure_phases(ds, N, gb_lw, schedule, hist_fields, n_valid,
             return s
         return reps
 
-    split_round_ms = timed_per_rep(split_make, 2, 8) * 1e3
+    # the split scan is small (hundreds of k elements); high rep counts
+    # keep the differential above tunnel noise (at 2/8 reps it measured 0)
+    split_round_ms = timed_per_rep(split_make, 8, 64) * 1e3
 
     hist_iter = hist_fields.get("hist_ms_per_iter", 0.0)
     part_iter = sum(part_ms[bucket_of(k)] for k in rounds) / iters
@@ -492,7 +494,7 @@ def main():
     if backend != "cpu" and os.environ.get("BENCH_FULL", "1") == "1":
         schedule = None
         try:
-            schedule = probe_round_schedule(cfg_lw, ds)
+            schedule = probe_round_schedule(gb_lw)
         except Exception as e:  # noqa: BLE001 — partial records beat none
             extra["round_probe_error"] = f"{type(e).__name__}: {e}"[:200]
         if schedule is None:

@@ -315,7 +315,10 @@ class Config:
                                    # K=1 i.e. exact sequential best-first
                                    # order for trees up to 7 leaves); 1 ==
                                    # exact sequential best-first order
-    hist_method: str = "auto"      # auto | scatter | onehot | pallas
+    # auto: static pick, measured only for ambiguous shapes; bench: ALWAYS
+    # time the applicable implementations at init and pick the winner
+    # (reference Dataset::GetShareStates, src/io/dataset.cpp:590-684)
+    hist_method: str = "auto"      # auto | bench | scatter | onehot | pallas
     hist_dtype: str = "bf16x2"     # bf16 | bf16x2 | f32 | int8 (quantized) precision
     # histogram precision for the wave grower's SUSTAINED rounds (the
     # largest slot bucket of a big wave — deep-frontier rounds whose

@@ -75,6 +75,49 @@ from .tree import TreeArrays, empty_tree
 _BUCKET_MIN_N = 1 << 16
 
 
+def replay_wave_schedule(trees, K: int):
+    """Per-round split counts of the wave policy, replayed EXACTLY from
+    grown trees' recorded structure + gains.
+
+    The device ranks frontier leaves by best gain and commits the top-K
+    per round; a leaf's ranking gain equals the ``split_gain`` recorded on
+    the node it became, and every candidate that ever wins a budget race
+    IS an internal node of the final tree — so replaying the ranked
+    commit order over internal nodes reproduces the executed round
+    grouping without any device round-trip (the axon runtime does not
+    support jax.debug callbacks; _ROUND_PROBE covers CPU runs and the
+    parity test ties the two together, tests/test_wave_bucket.py).
+    Caveats: fp-equal gain ties replay by node index (the device breaks
+    ties by leaf index), and the intermediate-monotone same-round
+    deferral is not modeled — neither occurs in the bench configs."""
+    out = []
+    for t in trees:
+        gains = np.asarray(t.split_gain)
+        lc = np.asarray(t.left_child)
+        rc = np.asarray(t.right_child)
+        if int(t.num_leaves) <= 1:
+            out.append([])
+            continue
+        sched = []
+        cand = [0]
+        while cand:
+            cand.sort(key=lambda n: (-gains[n], n))
+            take, cand = cand[:K], cand[K:]
+            sched.append(len(take))
+            cand += [int(c) for n in take for c in (lc[n], rc[n]) if c >= 0]
+        out.append(sched)
+    return out
+
+
+def auto_wave_size(num_leaves: int) -> int:
+    """The auto (leafwise_wave_size=0) wave size policy — num_leaves // 4
+    (measured optimum with the smaller-child subtraction pass, PERF.md).
+    Single source of truth for the trainer AND bench.py's round-schedule
+    replay/pricing (a mismatched K would silently re-derive the wrong
+    schedule)."""
+    return max(1, num_leaves // 4)
+
+
 def slot_buckets_for(K: int, N: int):
     """The wave grower's slot-bucket ladder for wave size ``K`` over ``N``
     rows — the single source of truth, shared with bench.py's round-cost

@@ -402,11 +402,29 @@ class MulticlassSoftmax(ObjectiveFunction):
         self._onehot = jnp.asarray(
             np.eye(self.num_class, dtype=np.float32)[lbl]
         )  # (N, K)
+        # weighted class priors (reference class_init_probs_,
+        # multiclass_objective.hpp:59-84) — the BoostFromScore base
+        counts = np.bincount(lbl, weights=self._np_weight,
+                             minlength=self.num_class).astype(np.float64)
+        self._class_probs = counts / max(counts.sum(), 1e-15)
+
+    def boost_from_score(self, class_id: int = 0) -> float:
+        # reference MulticlassSoftmax::BoostFromScore
+        # (multiclass_objective.hpp:155): log of the class prior
+        if not self.config.boost_from_average:
+            return 0.0
+        return float(np.log(max(1e-15, self._class_probs[class_id])))
 
     def _grad_hess(self, s):
         p = jax.nn.softmax(s, axis=-1)          # (N, K)
         grad = p - self._onehot
-        hess = 2.0 * p * (1.0 - p)              # reference factor 2
+        # hessian factor K/(K-1) (reference MulticlassSoftmax::factor_,
+        # src/objective/multiclass_objective.hpp:47 — NOT a constant 2,
+        # which over-damps leaf outputs for K > 2 and measurably slows
+        # convergence: round-5 bench showed logloss 1.143 vs the
+        # reference's 1.032 at 20 iters / 5 classes before this fix)
+        factor = self.num_class / (self.num_class - 1.0)
+        hess = factor * p * (1.0 - p)
         return grad, hess
 
     def convert_output(self, raw):
@@ -419,6 +437,14 @@ class MulticlassSoftmax(ObjectiveFunction):
 
 class MulticlassOVA(MulticlassSoftmax):
     name = "multiclassova"
+
+    def boost_from_score(self, class_id: int = 0) -> float:
+        # reference: per-class binary BoostFromScore (log-odds of the
+        # class prior over sigmoid), multiclass_objective.hpp:261-263
+        if not self.config.boost_from_average:
+            return 0.0
+        p = float(np.clip(self._class_probs[class_id], 1e-15, 1 - 1e-15))
+        return float(np.log(p / (1.0 - p)) / self.config.sigmoid)
 
     def _grad_hess(self, s):
         sig = self.config.sigmoid

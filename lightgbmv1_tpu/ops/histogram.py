@@ -244,7 +244,7 @@ def default_hist_method(config_method: str = "auto",
     (num_bins > 256) routes to the XLA one-hot path — the Pallas kernel is
     uint8-only (see hist_pallas.hist_leaves_pallas).
     """
-    if config_method != "auto":
+    if config_method not in ("auto", "bench"):
         return config_method
     platform = jax.default_backend()
     if platform == "cpu":
@@ -252,3 +252,96 @@ def default_hist_method(config_method: str = "auto",
     if bin_dtype is not None and jnp.dtype(bin_dtype).itemsize > 1:
         return "onehot"
     return "pallas"
+
+
+def benchmark_hist_methods(binned_np, num_bins: int, precision: str,
+                           packed: bool, num_features: int,
+                           nslots: int = 16, max_rows: int = 131072,
+                           candidates=None) -> str:
+    """Time the applicable histogram implementations on the REAL matrix
+    shapes and return the fastest — the role of the reference's
+    ``Dataset::GetShareStates`` col-wise/row-wise auto-benchmark
+    (src/io/dataset.cpp:590-684: time both once at init, log, pick).
+
+    Used when ``hist_method=bench`` (always measure), and by ``auto`` for
+    shapes where the static choice is ambiguous (trainer decides).  Timing
+    runs on a row subset (the reference subsamples too) with a TWO-length
+    in-jit scan differential — (wall(r2) - wall(r1)) / (r2 - r1) — so the
+    per-dispatch latency of a tunneled device (~113 ms here) cancels
+    instead of swamping the few-ms passes being compared.
+
+    Multi-process runs must NOT call this: per-host wall-clock could pick
+    different methods on different hosts around the same collectives (the
+    trainer falls back to the static pick there, like the reference's
+    single GetShareStates decision)."""
+    import time as _time
+
+    import numpy as _np
+    from jax import lax as _lax
+
+    from ..utils.log import log_info, log_warning
+
+    if candidates is None:
+        if jax.default_backend() == "cpu":
+            candidates = ["scatter", "onehot"]
+        elif jnp.dtype(binned_np.dtype).itemsize > 1:
+            # device scatter-add is a known non-starter (module docstring);
+            # int16 bins exclude pallas -> onehot is the only device path
+            candidates = ["onehot"]
+        else:
+            candidates = ["pallas", "onehot"]
+    if packed:
+        candidates = [m for m in candidates if m == "pallas"]
+    if len(candidates) <= 1:
+        pick = candidates[0] if candidates else default_hist_method(
+            "auto", binned_np.dtype)
+        log_info(f"hist-method benchmark: single applicable candidate "
+                 f"-> {pick}" + (" (4-bit packing pins the pallas kernel)"
+                                 if packed else ""))
+        return pick
+    n = min(binned_np.shape[1], max_rows)
+    binned = jnp.asarray(_np.ascontiguousarray(binned_np[:, :n]))
+    rng = _np.random.RandomState(0)
+    g3 = jnp.asarray(rng.randn(n, 3).astype(_np.float32))
+    label = jnp.asarray(rng.randint(0, nslots + 1, n).astype(_np.int32))
+    times = {}
+    for m in candidates:
+        try:
+            def reps_for(r, m=m):
+                @jax.jit
+                def reps():
+                    def body(c, i):
+                        g = g3 * (1.0 + 1e-6 * i.astype(jnp.float32))
+                        h = hist_wave(binned, g, label, nslots, num_bins,
+                                      method=m, precision=precision,
+                                      packed=packed,
+                                      num_features=num_features)
+                        return c + h.sum(), None
+                    s, _ = _lax.scan(body, jnp.float32(0), jnp.arange(r))
+                    return s
+                return reps
+
+            f1, f2 = reps_for(2), reps_for(10)
+            jax.block_until_ready(f1())
+            jax.block_until_ready(f2())
+            diffs = []
+            for _ in range(3):
+                t0 = _time.perf_counter()
+                jax.block_until_ready(f1())
+                t1 = _time.perf_counter()
+                jax.block_until_ready(f2())
+                t2 = _time.perf_counter()
+                diffs.append(((t2 - t1) - (t1 - t0)) / 8.0)
+            times[m] = max(float(_np.median(diffs)), 1e-9)
+        except Exception as e:  # noqa: BLE001 — a failing candidate loses
+            log_warning(f"hist-method benchmark: {m} failed "
+                        f"({type(e).__name__}); excluded")
+            continue
+    if not times:
+        return default_hist_method("auto", binned_np.dtype)
+    pick = min(times, key=times.get)
+    log_info("hist-method benchmark (%s rows x %s cols, %s): %s -> %s"
+             % (n, binned_np.shape[0], binned_np.dtype,
+                ", ".join(f"{m}={v * 1e3:.2f}ms"
+                          for m, v in sorted(times.items())), pick))
+    return pick

@@ -205,6 +205,33 @@ def build_trainer(
     learner = config.tree_learner
     method = default_hist_method(config.hist_method, binned_np.dtype)
     precision = config.hist_dtype
+    # hist_method=bench: time the applicable implementations on the real
+    # shapes and pick the winner (the reference's GetShareStates
+    # col-wise/row-wise auto-benchmark, src/io/dataset.cpp:590-684);
+    # hist_method=auto measures only when the static choice is genuinely
+    # ambiguous (uint8 bins on a device with a very wide feature axis,
+    # where pallas-vs-onehot tiling economics flip) so the common paths
+    # keep zero startup cost.  Multi-process runs always take the static
+    # pick: per-host wall-clock timing could choose DIFFERENT programs
+    # around the same collectives (the reference makes one GetShareStates
+    # decision, not one per rank).
+    wants_bench = config.hist_method == "bench" or (
+        config.hist_method == "auto"
+        and jax.default_backend() != "cpu"
+        and np.dtype(binned_np.dtype).itemsize == 1
+        and binned_np.shape[0] > 256)
+    if wants_bench and jax.process_count() > 1:
+        log_warning("hist_method=bench: multi-process run takes the "
+                    "static method pick (a per-host timed choice could "
+                    "diverge across ranks)")
+        wants_bench = False
+    if wants_bench:
+        from ..ops.histogram import benchmark_hist_methods
+
+        method = benchmark_hist_methods(
+            binned_np,
+            bundle_num_bins if bundle is not None else num_bins,
+            precision, packed, int(meta.num_bins.shape[0]))
     N = binned_np.shape[1]
     if row_sharded:
         if learner != "data":
@@ -304,7 +331,9 @@ def build_trainer(
         # measured optimum from K=32 to ~64 (PERF.md round-4 sweep).
         # Small trees (num_leaves <= 7) stay at K=1 — the reference's exact
         # sequential best-first order, which the golden parity fixtures pin.
-        wave_size = max(1, config.num_leaves // 4)
+        from ..models.grower_wave import auto_wave_size
+
+        wave_size = auto_wave_size(config.num_leaves)
     # cap bounds the unrolled per-round decision loop's compile-time graph
     if wave_size > 128:
         log_warning(f"leafwise_wave_size={wave_size} capped to 128 (the "

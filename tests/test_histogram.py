@@ -174,3 +174,40 @@ def test_pallas_single_leaf_masks_rows(rng):
     finally:
         hp.hist_leaves_pallas = orig
     np.testing.assert_allclose(one, full[2], rtol=1e-4, atol=1e-4)
+
+
+def test_hist_method_bench_picks_measured_best():
+    """hist_method=bench times the applicable implementations on the real
+    shapes and picks the winner (reference Dataset::GetShareStates,
+    src/io/dataset.cpp:590-684).  On CPU the candidates are
+    scatter/onehot for uint8 bins and onehot/scatter for int16 bins; the
+    pick must be one of the timed candidates for each dtype."""
+    import numpy as np
+
+    from lightgbmv1_tpu.ops.histogram import benchmark_hist_methods
+
+    rng = np.random.RandomState(0)
+    u8 = rng.randint(0, 16, size=(6, 4096)).astype(np.uint8)
+    pick8 = benchmark_hist_methods(u8, 16, "f32", False, 6, nslots=4)
+    assert pick8 in ("scatter", "onehot")
+    i16 = rng.randint(0, 300, size=(6, 4096)).astype(np.int16)
+    pick16 = benchmark_hist_methods(i16, 300, "f32", False, 6, nslots=4)
+    assert pick16 in ("scatter", "onehot")
+
+
+def test_hist_method_bench_end_to_end():
+    """The bench pick flows through training and matches auto's result."""
+    import numpy as np
+
+    import lightgbmv1_tpu as lgb
+
+    rng = np.random.RandomState(1)
+    X = rng.randn(1500, 5)
+    y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(float)
+    a = lgb.train({"objective": "binary", "num_leaves": 15,
+                   "verbosity": -1, "hist_method": "bench"},
+                  lgb.Dataset(X, label=y), num_boost_round=3)
+    b = lgb.train({"objective": "binary", "num_leaves": 15,
+                   "verbosity": -1},
+                  lgb.Dataset(X, label=y), num_boost_round=3)
+    np.testing.assert_allclose(a.predict(X), b.predict(X), rtol=1e-6)

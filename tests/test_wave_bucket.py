@@ -56,20 +56,28 @@ def test_bucketed_rounds_match_single_bucket(params, monkeypatch):
     np.testing.assert_allclose(a.predict(X), b.predict(X), rtol=1e-6)
 
 
-def test_round_probe_counts_rounds(monkeypatch):
-    """The _ROUND_PROBE hook fires once per executed wave round — the
-    count bench.py records as wave_rounds_per_tree."""
+def test_round_probe_matches_tree_replay(monkeypatch):
+    """The _ROUND_PROBE hook fires once per executed wave round with the
+    round's split count, and replay_wave_schedule must reproduce the SAME
+    per-round schedule from the grown trees alone — the replay is what
+    bench.py records as wave_rounds_per_tree on hardware where debug
+    callbacks cannot run (axon)."""
     X, y = make_problem(n=1200)
-    counts = {"n": 0}
+    live = []
     monkeypatch.setattr(grower_wave, "_ROUND_PROBE",
-                        lambda k: counts.__setitem__("n", counts["n"] + 1))
+                        lambda k: live.append(int(k)))
     m = lgb.train({"objective": "binary", "num_leaves": 31,
                    "leafwise_wave_size": 8, "tree_growth": "leafwise",
                    "verbosity": -1},
                   lgb.Dataset(X, label=y), num_boost_round=2)
+    import jax
+
+    jax.effects_barrier()   # debug.callback effects are async
+    trees = m._all_trees()
+    replayed = [k for s in grower_wave.replay_wave_schedule(trees, 8)
+                for k in s]
     t = m._all_trees()[0]
     # a 31-leaf tree at K=8 needs >= ceil(30/8) = 4 rounds; the ramp
     # (1, 2, 4, 8, ...) makes it >= 6 when the tree fills its budget
-    assert counts["n"] >= 2 * max(
-        1, int(np.ceil((t.num_leaves - 1) / 8)))
-    assert counts["n"] <= 2 * 30   # and bounded by one round per split
+    assert len(live) >= 2 * max(1, int(np.ceil((t.num_leaves - 1) / 8)))
+    assert replayed == live
