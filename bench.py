@@ -193,7 +193,7 @@ def measure_hist_and_roofline(ds, N, schedule=None):
     g3 = jnp.asarray(rng.randn(N, 3).astype(np.float32))
     method = default_hist_method("auto", binned.dtype)
 
-    def hist_make_for(slots):
+    def hist_make_for(slots, precision):
         label = jnp.asarray(
             rng.randint(0, slots, size=N).astype(np.int32))
 
@@ -202,18 +202,29 @@ def measure_hist_and_roofline(ds, N, schedule=None):
             def reps():
                 def body(c, i):
                     g = g3 * (1.0 + 1e-6 * i.astype(jnp.float32))
-                    h = hist_wave(binned, g, label, slots, B, method=method)
+                    h = hist_wave(binned, g, label, slots, B, method=method,
+                                  precision=precision)
                     return c + h.sum(), None
                 s, _ = lax.scan(body, jnp.float32(0), jnp.arange(r))
                 return s
             return reps
         return hist_make
 
+    # price each bucket at the precision TRAINING actually uses there:
+    # sustained (largest-bucket) rounds run the deep dtype (single-pass
+    # bf16 under the default policy, parallel/trainer.py), ramp rounds and
+    # the root keep bf16x2 — pricing everything at bf16x2 would overstate
+    # phase_hist_ms by ~2x on the sustained rounds
     pass_ms = {}
     for slots in (1,) + BUCKETS:
-        pass_ms[slots] = timed_per_rep(hist_make_for(slots), 4, 16) * 1e3
+        prec = "bf16" if slots == K else "bf16x2"
+        pass_ms[slots] = timed_per_rep(
+            hist_make_for(slots, prec), 4, 16) * 1e3
 
-    per_pass = pass_ms[K] / 1e3
+    # the roofline fraction grades the KERNEL at full bf16x2 (2 MXU
+    # passes), independent of the training-time deep-precision policy
+    per_pass = timed_per_rep(hist_make_for(K, "bf16x2"), 4, 16)
+    out_full_pass_ms = per_pass * 1e3
     # one-hot MXU formulation: (3*(K+1), rows) @ (rows, B*F) per pass,
     # bf16x2 = 2 passes (ops/hist_pallas.py)
     hist_flops = 2 * 3 * (K + 1) * N * B * F * 2
@@ -244,7 +255,10 @@ def measure_hist_and_roofline(ds, N, schedule=None):
         return K
 
     out = {
-        "hist_ms_per_pass": round(pass_ms[K], 2),
+        # the BASELINE-tracked kernel pass at full bf16x2 precision
+        "hist_ms_per_pass": round(out_full_pass_ms, 2),
+        # the sustained-round pass as TRAINING runs it (deep bf16 policy)
+        "hist_ms_per_pass_deep": round(pass_ms[K], 2),
         "hist_ms_per_pass_root": round(pass_ms[1], 2),
         "hist_achieved_tf_s": round(hist_tfs, 2),
         "device_matmul_peak_tf_s": round(peak_tfs, 2),
@@ -576,11 +590,19 @@ def main():
             gbm.train_iters(BLK)
             jax.device_get(gbm._train_scores.score)
             mc_dt = time.time() - t0
-            mc_mrt = MC_N * BLK * MC_CLS / mc_dt / 1e6
-            mll = None
+            mll = None   # quality read at exactly MC_IT trees (ref parity)
             for (_, name, value, _) in gbm.eval_valid():
                 if name == "multi_logloss":
                     mll = float(value)
+            # tunnel drift can randomly halve a single window (measured
+            # 2x swings minutes apart): best-of-3 like the binary block,
+            # with the extra blocks AFTER the quality eval
+            for _ in range(2):
+                t0 = time.time()
+                gbm.train_iters(BLK)
+                jax.device_get(gbm._train_scores.score)
+                mc_dt = min(mc_dt, time.time() - t0)
+            mc_mrt = MC_N * BLK * MC_CLS / mc_dt / 1e6
             extra["multiclass_M_row_trees_per_s"] = round(mc_mrt, 3)
             extra["multiclass_logloss"] = (round(mll, 5)
                                            if mll is not None else None)
@@ -614,12 +636,13 @@ def main():
             BLKR = RK_IT // 4
             gbr.train_iters(BLKR)
             jax.device_get(gbr._train_scores.score)
-            t0 = time.time()
+            rk_dt = 1e30   # best single block of three (tunnel drift)
             for _ in range(3):
+                t0 = time.time()
                 gbr.train_iters(BLKR)
-            jax.device_get(gbr._train_scores.score)
-            rk_dt = time.time() - t0
-            rk_mrt = RK_Q * RK_D * 3 * BLKR / rk_dt / 1e6
+                jax.device_get(gbr._train_scores.score)
+                rk_dt = min(rk_dt, time.time() - t0)
+            rk_mrt = RK_Q * RK_D * BLKR / rk_dt / 1e6
             ndcg = None
             for (_, name, value, _) in gbr.eval_valid():
                 if "ndcg" in name:

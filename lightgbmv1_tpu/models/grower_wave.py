@@ -395,7 +395,15 @@ def make_wave_grower(
         kiota = jnp.arange(K, dtype=jnp.int32)
 
         def cond(st: WaveState):
-            return (~st.done) & (st.num_leaves < L)
+            # max(best_gain) > 0 stops BEFORE a zero-split round: the old
+            # `done | (n_split == 0)` exit ran one full (partition + hist)
+            # pass just to discover nothing splits — a wasted round on
+            # every gain-exhausted tree, and a trailing 0 the tree-replay
+            # schedule (replay_wave_schedule) could not see.  A positive
+            # frontier gain guarantees n_split >= 1 (the intermediate-
+            # monotone deferral never clears the FIRST valid pick).
+            return (~st.done) & (st.num_leaves < L) & \
+                (jnp.max(st.best_gain) > 0)
 
         def body(st: WaveState) -> WaveState:
             budget = L - st.num_leaves
