@@ -217,9 +217,12 @@ def measure_hist_and_roofline(ds, N, schedule=None):
     # phase_hist_ms by ~2x on the sustained rounds
     pass_ms = {}
     for slots in (1,) + BUCKETS:
-        prec = "bf16" if slots == K else "bf16x2"
+        # mirror the grower's deep gate exactly (grower_wave round_pass:
+        # S == K and K >= 32 and bucketing active) so pricing cannot
+        # drift from what training runs
+        deep = slots == K and K >= 32 and len(BUCKETS) > 1
         pass_ms[slots] = timed_per_rep(
-            hist_make_for(slots, prec), 4, 16) * 1e3
+            hist_make_for(slots, "bf16" if deep else "bf16x2"), 4, 16) * 1e3
 
     # the roofline fraction grades the KERNEL at full bf16x2 (2 MXU
     # passes), independent of the training-time deep-precision policy
