@@ -36,8 +36,8 @@ from ..ops.split import SplitParams, make_feature_meta
 from ..utils.log import log_fatal, log_info, log_warning
 from ..utils.timer import global_timer
 from .grower import make_leafwise_grower
-from .tree import (HostTree, TreeArrays, tree_predict_binned,
-                   tree_used_features)
+from .tree import (HostTree, TreeArrays, leaf_lookup,
+                   tree_predict_binned, tree_used_features)
 
 
 def _np_weighted_quantile_sorted(v, w, q):
@@ -57,7 +57,8 @@ class _ScoreUpdater:
         )
 
     def add_leaf_values(self, leaf_values: jax.Array, leaf_id: jax.Array, k: int):
-        self.score = self.score.at[:, k].add(leaf_values[leaf_id])
+        self.score = self.score.at[:, k].add(
+            leaf_lookup(leaf_values, leaf_id))
 
     def add_pred(self, pred: jax.Array, k: int):
         self.score = self.score.at[:, k].add(pred)
@@ -340,11 +341,17 @@ class GBDT:
                     cegb_used = self._update_cegb_state(
                         cegb_used, tree_dev, leaf_id)
                 shrunk = tree_dev._replace(leaf_value=tree_dev.leaf_value * rate)
-                train_score = train_score.at[:, k].add(shrunk.leaf_value[leaf_id])
+                train_score = train_score.at[:, k].add(
+                    leaf_lookup(shrunk.leaf_value, leaf_id))
                 new_valid = []
                 for vi, (vb, vscore) in enumerate(zip(valid_binned,
                                                       valid_scores)):
                     if vlids is not None:
+                        # native gather, NOT leaf_lookup: this path is
+                        # pinned bit-exact against the tree walk
+                        # (test_valid_row_routing_matches_tree_walk), and
+                        # valid sets are small enough that the gather tax
+                        # does not matter
                         pred = shrunk.leaf_value[vlids[vi]]
                     else:
                         pred = tree_predict_binned(
@@ -1070,9 +1077,7 @@ class DART(GBDT):
             # drop_stack (full TreeArrays over P slots) is only needed for
             # valid-set removal, where no assignments were recorded.
             if use_lids:
-                preds = jax.vmap(
-                    lambda lv, lid: lv[lid.astype(jnp.int32)]
-                )(drop_lv, drop_lids)                            # (P, N)
+                preds = jax.vmap(leaf_lookup)(drop_lv, drop_lids)  # (P, N)
             else:
                 preds = jax.vmap(lambda t: pred_with(t, binned))(drop_stack)
             drop_delta = preds.T @ drop_weight                   # (N, K)
@@ -1121,7 +1126,7 @@ class DART(GBDT):
             for k in range(K):
                 tree_k = jax.tree_util.tree_map(lambda a: a[k], stacked)
                 new_train = new_train.at[:, k].add(
-                    tree_k.leaf_value[leaf_ids[k]])
+                    leaf_lookup(tree_k.leaf_value, leaf_ids[k]))
                 new_valids = [
                     nv.at[:, k].add(pred_with(tree_k, vb))
                     for nv, vb in zip(new_valids, valid_binned)

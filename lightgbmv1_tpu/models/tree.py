@@ -48,6 +48,32 @@ class TreeArrays(NamedTuple):
                                 # (reference: cat_threshold_inner_, tree.h:427)
 
 
+def leaf_lookup(table: jax.Array, leaf_id: jax.Array) -> jax.Array:
+    """``table[leaf_id]`` without a device gather.
+
+    TPU gathers run at ~1 element per several cycles (7.8 ms for 1M rows
+    from a 255-entry table, tools/microbench_gather.py) while a
+    broadcast-compare select-reduce streams the same lookup in ~0.8 ms
+    and is EXACT — each row reduces exactly one nonzero, so there is no
+    summation error.  Falls back to the native gather for wide tables
+    where the O(rows·L) compare loses.  This is the score-application
+    analog of the reference ScoreUpdater's per-leaf AddScore
+    (src/boosting/score_updater.hpp), reformulated for the VPU."""
+    L = table.shape[0]
+    if L > 1024:
+        return table[leaf_id]
+    iota = jnp.arange(L, dtype=jnp.int32)
+    eq = leaf_id[:, None].astype(jnp.int32) == iota[None, :]
+    # Each element of the result is value-equal to table[leaf_id], but
+    # consumers may see 1-ulp drift vs the gather formulation: XLA is free
+    # to reassociate a producer's scale factor across the reduce and
+    # fma-fuse into a consumer add (one rounding instead of two).  Paths
+    # with a PINNED bit-parity contract (the wave grower's valid-score
+    # routing vs the tree walk) therefore keep the native gather — valid
+    # sets are small; this formulation is for the big train-row tables.
+    return jnp.sum(jnp.where(eq, table[None, :], 0), axis=1)
+
+
 def empty_tree(max_leaves: int, cat_words: int = 1) -> TreeArrays:
     L = max_leaves
     L1 = max(L - 1, 1)
