@@ -102,24 +102,13 @@ def timed_per_rep(make_reps, r1, r2):
     """Per-rep seconds from a TWO-length-scan differential: wall(r2) -
     wall(r1) over (r2 - r1) reps cancels dispatch latency and other
     per-call fixed costs (the ~113 ms tunnel round-trip would otherwise
-    dominate and overstate per-rep time severalfold)."""
-    import jax
+    dominate and overstate per-rep time severalfold).  Thin wrapper over
+    the shared helper so this file, tools/phase_attrib.py and the tests
+    all run the SAME methodology (median of interleaved pairs, device_get
+    sync)."""
+    from lightgbmv1_tpu.utils.timer import scan_differential_ms
 
-    f1, f2 = make_reps(r1), make_reps(r2)
-    jax.device_get(f1())
-    jax.device_get(f2())
-    diffs = []
-    for _ in range(5):
-        t0 = time.time()
-        jax.device_get(f1())
-        t1 = time.time()
-        jax.device_get(f2())
-        t2 = time.time()
-        diffs.append(((t2 - t1) - (t1 - t0)) / (r2 - r1))
-    # MEDIAN, not min: the minimum of a difference of two noisy walls
-    # can go spuriously small (slow short run + fast long run) and
-    # overstate throughput past physical peaks
-    return max(float(np.median(diffs)), 1e-9)
+    return scan_differential_ms(make_reps, r1, r2) / 1e3
 
 
 def estimated_wave_schedule(K=None, budget=254):
@@ -531,6 +520,35 @@ def main():
                     per_iter_ms=lw_dt / lw_trees * 1e3))
         except Exception as e:  # noqa: BLE001
             extra["phase_error"] = f"{type(e).__name__}: {e}"[:200]
+
+        # ---- phase_other attribution (the USE_TIMETAG discipline applied
+        # to the residual): decompose phase_other_ms into named sub-phases
+        # with the same differential methodology, priced over the replayed
+        # round schedule; the record flags any unattributed remainder
+        # above 10% of the measured per-iteration wall so the residual can
+        # never silently regrow (tools/phase_attrib.py).
+        try:
+            if "phase_other_ms" in extra:
+                from lightgbmv1_tpu.models.grower_wave import (
+                    auto_wave_size, slot_buckets_for)
+                from tools.phase_attrib import measure_other_breakdown
+
+                K_att = auto_wave_size(255)
+                rounds = schedule["schedule"]
+                iters = max(1, round(len(rounds)
+                                     / schedule["rounds_per_tree"]))
+                bd = measure_other_breakdown(
+                    N=N, F=28, B=64, L=255, K=K_att,
+                    rounds_per_iter=len(rounds) / iters,
+                    n_buckets=len(slot_buckets_for(K_att, N)),
+                    n_valid=N_TEST, num_class=1,
+                    objective=gb_lw.objective,
+                    fused=cfg_lw.fused_bookkeeping)
+                extra.update(bd.record(
+                    extra["phase_other_ms"],
+                    extra["phase_total_measured_ms"]))
+        except Exception as e:  # noqa: BLE001
+            extra["phase_attrib_error"] = f"{type(e).__name__}: {e}"[:200]
 
         # DART per-iteration cost (fused single-dispatch iteration):
         # VERDICT r3 #7 asks this within ~2x of the scanned GBDT path

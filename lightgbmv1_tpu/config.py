@@ -329,6 +329,15 @@ class Config:
     # — always use hist_dtype.  The TPU analog of the reference's
     # fp32-hist-GPU-parity precedent (docs/GPU-Performance.rst:133-160).
     hist_dtype_deep: str = ""
+    # fused per-round bookkeeping in the wave grower: the frontier /
+    # tree-assembly state lives in two packed tables written with ONE
+    # coalesced multi-node scatter each per round, instead of ~30
+    # per-field scatters (the phase-attribution harness measured the
+    # scatter storm as the dominant slice of the per-iteration residual,
+    # tools/phase_attrib.py).  False = legacy per-field scatters; trees
+    # are bit-identical either way on the exact-fp32 scatter path
+    # (tests/test_phase_attrib.py pins this).
+    fused_bookkeeping: bool = True
     num_shards: int = 0            # devices for data-parallel (0 = all available)
     profile_dir: str = ""          # write a jax.profiler device trace of
                                    # training here; hist/split/partition
@@ -449,8 +458,10 @@ class Config:
                 self.hist_method = "scatter"
             elif self.force_row_wise:
                 self.hist_method = "onehot"
-        if self.gpu_use_dp:
-            # the double-precision request covers deep wave rounds too
+        if self.gpu_use_dp and not self.hist_dtype_deep:
+            # the double-precision request covers deep wave rounds too —
+            # but an EXPLICIT hist_dtype_deep wins (the trainer documents
+            # "hist_dtype_deep overrides"; stomping it broke that contract)
             self.hist_dtype_deep = "f32"
         if self.gpu_use_dp and self.hist_dtype in ("bf16", "bf16x2", "int8"):
             # gpu_use_dp = highest-precision device histograms

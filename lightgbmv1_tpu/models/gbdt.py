@@ -321,6 +321,8 @@ class GBDT:
             bag = self._bag_fraction_mask(None, iteration)
             trees = []
             leaf_ids = []
+            train_preds = []
+            valid_preds = [[] for _ in valid_binned]
             grow_valids = getattr(self._grow, "_supports_valids", False)
             for k in range(K):
                 g3 = self._sample_g3(grad[:, k], hess[:, k], bag, iteration)
@@ -341,27 +343,37 @@ class GBDT:
                     cegb_used = self._update_cegb_state(
                         cegb_used, tree_dev, leaf_id)
                 shrunk = tree_dev._replace(leaf_value=tree_dev.leaf_value * rate)
-                train_score = train_score.at[:, k].add(
-                    leaf_lookup(shrunk.leaf_value, leaf_id))
-                new_valid = []
-                for vi, (vb, vscore) in enumerate(zip(valid_binned,
-                                                      valid_scores)):
+                train_preds.append(leaf_lookup(shrunk.leaf_value, leaf_id))
+                for vi, vb in enumerate(valid_binned):
                     if vlids is not None:
                         # native gather, NOT leaf_lookup: this path is
                         # pinned bit-exact against the tree walk
                         # (test_valid_row_routing_matches_tree_walk), and
                         # valid sets are small enough that the gather tax
                         # does not matter
-                        pred = shrunk.leaf_value[vlids[vi]]
+                        valid_preds[vi].append(shrunk.leaf_value[vlids[vi]])
                     else:
-                        pred = tree_predict_binned(
+                        valid_preds[vi].append(tree_predict_binned(
                             shrunk, vb, self.meta.nan_bin,
                             self.meta.missing_type, self._bundle,
-                            self._packed, zero_bins=self.meta.zero_bin)
-                    new_valid.append(vscore.at[:, k].add(pred))
-                valid_scores = tuple(new_valid) if new_valid else valid_scores
+                            self._packed, zero_bins=self.meta.zero_bin))
                 trees.append(shrunk)
                 leaf_ids.append(leaf_id)
+            # Deferred score bookkeeping: every class's leaf values land in
+            # ONE (N, K) elementwise add per score cache instead of K
+            # column-slice updates — this step's gradients were computed
+            # BEFORE the class loop, so deferral is bit-identical (score
+            # columns are independent elements receiving the same single
+            # add).  Together with the leaf_lookup formulation this keeps
+            # the whole gradient -> g3 -> score-update chain a handful of
+            # row-streaming ops inside the same fused dispatch as the
+            # trees' round-0 histogram passes (tools/phase_attrib.py
+            # itemizes the cost under grad_g3_ms / score_update_ms).
+            train_score = train_score + jnp.stack(train_preds, axis=1)
+            if valid_binned:
+                valid_scores = tuple(
+                    vs + jnp.stack(vp, axis=1)
+                    for vs, vp in zip(valid_scores, valid_preds))
             stacked = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *trees)
             return (train_score, valid_scores, stacked, jnp.stack(leaf_ids),
                     cegb_used)

@@ -48,6 +48,17 @@ from ..ops.split import (
 )
 from .tree import TreeArrays
 
+# Level-wise frontier chunk cap — the analog of the wave grower's 128-slot
+# wave_size cap: the level-wise partition + smaller-child label passes are
+# (Ld, N) broadcast-compares over the whole frontier, and a wide level
+# (Ld up to num_leaves/2) would materialize multi-GB intermediates at
+# bench N (128 x 1M int32 is already 512 MB).  Frontier slices are
+# processed in groups of at most this many splits — disjoint row
+# ownership makes the chunked int32 accumulation bit-identical to the
+# single-pass sum (tests/test_partition_grower.py pins this).  Lowered by
+# tests to exercise the chunked branches.
+_LEVEL_CHUNK = 128
+
 
 class GrowerState(NamedTuple):
     leaf_id: jax.Array        # (N,) int32
@@ -879,11 +890,18 @@ def make_levelwise_grower(
                 sm_id = jnp.where(p_sml, jnp.arange(Lp, dtype=jnp.int32),
                                   p_new)
                 sm_leaf = jnp.where(p_mask, sm_id, L + 1)       # (Lp,)
-                mine_s = sm_leaf[:, None] == leaf_id[None, :]   # (Lp, N)
-                label = jnp.sum(jnp.where(
-                    mine_s,
-                    jnp.arange(Lp, dtype=jnp.int32)[:, None] - Lp, 0),
-                    axis=0) + Lp
+                # chunked (<=_LEVEL_CHUNK, N) broadcast-compare: each row
+                # is owned by at most ONE frontier slot, so the chunked
+                # int32 accumulation is bit-identical to one (Lp, N) pass
+                acc = jnp.zeros(N, jnp.int32)
+                for c0 in range(0, Lp, _LEVEL_CHUNK):
+                    c1 = min(c0 + _LEVEL_CHUNK, Lp)
+                    mine_c = sm_leaf[c0:c1, None] == leaf_id[None, :]
+                    acc = acc + jnp.sum(jnp.where(
+                        mine_c,
+                        jnp.arange(c0, c1, dtype=jnp.int32)[:, None] - Lp,
+                        0), axis=0)
+                label = acc + Lp
                 h_small = hist_frontier_fn(binned, g3, label, Lp + 1)[:Lp]
                 smL = p_sml[:, None, None, None]
                 h_left = jnp.where(smL, h_small, p_hist - h_small)
@@ -982,31 +1000,43 @@ def make_levelwise_grower(
             # round_pass — per-row table gathers measure 8-12 ms per 1M
             # rows on this device vs ~3 ms for the whole compare pass,
             # tools/microbench_gather.py; this was ~2/3 of the level-wise
-            # iteration before round 5)
+            # iteration before round 5), processed in frontier chunks of
+            # at most _LEVEL_CHUNK splits so wide levels never
+            # materialize the full (Ld, N) intermediates (the wave
+            # grower's 128-slot cap, applied to the level frontier).
+            # Disjoint row ownership keeps the chunked accumulation
+            # bit-identical to the single pass.
             feat_k = res.feature                             # (Ld,)
             leafk = jnp.where(split_mask,
                               jnp.arange(Ld, dtype=jnp.int32), L)
-            bk = jax.vmap(lambda f: bins_of_fn(binned, f))(feat_k) \
-                .astype(jnp.int32)                           # (Ld, N)
-            mt_k = meta.missing_type[feat_k][:, None]
-            na_k = ((mt_k == MISSING_NAN)
-                    & (bk == meta.nan_bin[feat_k][:, None])) | (
-                (mt_k == MISSING_ZERO)
-                & (bk == meta.zero_bin[feat_k][:, None]))
-            glk = jnp.where(na_k, res.default_left[:, None],
-                            bk <= res.threshold_bin[:, None])
-            if use_cat_lw:  # categorical: bin-space bitset membership
-                word = jnp.zeros(bk.shape, jnp.uint32)
-                for wv in range(W):
-                    word = jnp.where((bk >> 5) == wv,
-                                     res.cat_bitset[:, wv][:, None], word)
-                in_set = ((word >> (bk.astype(jnp.uint32) & 31)) & 1) == 1
-                glk = jnp.where(res.is_cat[:, None], in_set, glk)
-            mine = leafk[:, None] == leaf_id[None, :]        # (Ld, N)
-            go_r = mine & (~glk)
-            leaf_id = leaf_id + jnp.sum(
-                jnp.where(go_r, new_leaf[:, None] - leaf_id[None, :], 0),
-                axis=0)
+            delta = jnp.zeros(N, jnp.int32)
+            for c0 in range(0, Ld, _LEVEL_CHUNK):
+                c1 = min(c0 + _LEVEL_CHUNK, Ld)
+                fk = feat_k[c0:c1]
+                bk = jax.vmap(lambda f: bins_of_fn(binned, f))(fk) \
+                    .astype(jnp.int32)                       # (<=C, N)
+                mt_k = meta.missing_type[fk][:, None]
+                na_k = ((mt_k == MISSING_NAN)
+                        & (bk == meta.nan_bin[fk][:, None])) | (
+                    (mt_k == MISSING_ZERO)
+                    & (bk == meta.zero_bin[fk][:, None]))
+                glk = jnp.where(na_k, res.default_left[c0:c1, None],
+                                bk <= res.threshold_bin[c0:c1, None])
+                if use_cat_lw:  # categorical: bin-space bitset membership
+                    word = jnp.zeros(bk.shape, jnp.uint32)
+                    for wv in range(W):
+                        word = jnp.where(
+                            (bk >> 5) == wv,
+                            res.cat_bitset[c0:c1, wv][:, None], word)
+                    in_set = ((word >> (bk.astype(jnp.uint32) & 31))
+                              & 1) == 1
+                    glk = jnp.where(res.is_cat[c0:c1, None], in_set, glk)
+                mine = leafk[c0:c1, None] == leaf_id[None, :]
+                go_r = mine & (~glk)
+                delta = delta + jnp.sum(
+                    jnp.where(go_r, new_leaf[c0:c1, None]
+                              - leaf_id[None, :], 0), axis=0)
+            leaf_id = leaf_id + delta
 
             # tree array updates (scatter with out-of-bounds drop for masked)
             nd = jnp.where(split_mask, node_idx, L1 + 1)

@@ -45,6 +45,17 @@ collective per ROUND instead of per split: the data-parallel learner wraps
 ``hist_wave_fn`` in a ``lax.psum`` (the analog of the reference's
 ReduceScatter of histograms, data_parallel_tree_learner.cpp:155-173), the
 feature-/voting-parallel learners substitute ``split_fn``.
+
+Round bookkeeping (round 6): the per-leaf frontier state and the tree
+arrays under construction live behind a store codec.  The default
+``_PackedStore`` keeps them in two packed f32 tables committed with one
+coalesced scatter each per round; ``_FieldStore`` is the legacy
+one-array-per-field layout (~30 small scatters per round) kept for the
+bit-parity test and attribution A/Bs (config ``fused_bookkeeping``).
+The phase-attribution harness (tools/phase_attrib.py) measured the
+legacy scatter storm as the dominant slice of the per-iteration
+``phase_other_ms`` residual; both layouts grow bit-identical trees on
+the exact-fp32 histogram path (tests/test_phase_attrib.py).
 """
 
 from __future__ import annotations
@@ -212,24 +223,355 @@ class WaveState(NamedTuple):
                               # (reference BeforeFindBestSplit +
                               # FeatureHistogram::Subtract); (1, F, B, 3)
                               # dummy when the state would exceed the cap
-    best_gain: jax.Array      # (L,) — frontier priority queue (−inf = closed)
-    best_feat: jax.Array      # (L,) int32
-    best_bin: jax.Array       # (L,) int32
-    best_dl: jax.Array        # (L,) bool
-    best_left: jax.Array      # (L, 3)
-    best_right: jax.Array     # (L, 3)
-    best_iscat: jax.Array     # (L,) bool
-    best_bitset: jax.Array    # (L, W) uint32
-    leaf_constr: jax.Array    # (L, 2) — monotone [min, max] output bounds
+    store: dict               # codec-owned frontier + tree bookkeeping —
+                              # _PackedStore (fused, two coalesced tables)
+                              # or _FieldStore (legacy per-field arrays)
     leaf_box: jax.Array       # (L, F, 2) — bin-space region per leaf
                               # (intermediate monotone mode; (1, 1, 2) dummy)
-    leaf_out: jax.Array       # (L,) — current leaf output (path smoothing)
-    leaf_used: jax.Array      # (L, F) bool — branch features (interactions)
-    leaf_depth: jax.Array     # (L,) int32
-    leaf_is_left: jax.Array   # (L,) bool
-    tree: TreeArrays
+    leaf_used: jax.Array      # (L, F) bool — branch features; (1, 1) dummy
+                              # unless interaction constraints are on
     num_leaves: jax.Array     # () int32
     done: jax.Array           # () bool
+
+
+def subtract_child_hists(h_slot, leaf_hist, leafs, order_c, sm_left):
+    """Smaller-child + parent-subtraction child histograms of one wave
+    round (reference BeforeFindBestSplit smaller-leaf trick +
+    FeatureHistogram::Subtract): ``h_slot`` holds the measured smaller
+    children in slot order; the larger sibling is the stored parent
+    histogram minus the smaller.  Returns the rank-order interleaved
+    ``(2K, F, B, 3)`` child stack plus the separate left/right halves.
+    Module-level so tools/phase_attrib.py can time exactly the ops the
+    grower's round body runs."""
+    h_small = h_slot[order_c]              # slot-order -> rank-order
+    h_parent = leaf_hist[leafs]
+    smL = sm_left[:, None, None, None]
+    h_left = jnp.where(smL, h_small, h_parent - h_small)
+    h_right = h_parent - h_left
+    hist = jnp.stack([h_left, h_right], axis=1).reshape(
+        (2 * h_left.shape[0],) + h_left.shape[1:])
+    return hist, h_left, h_right
+
+
+# ---------------------------------------------------------------------------
+# Per-round bookkeeping stores.
+#
+# The round body computes one set of values either way; the store decides
+# HOW they are kept between rounds.  tools/phase_attrib.py instantiates
+# both stores directly to time their write paths — the same code objects
+# the grower's while-loop body calls.
+# ---------------------------------------------------------------------------
+
+
+class _FieldStore:
+    """Legacy (unfused) bookkeeping: every frontier / tree field is its
+    own array and every round writes each with its own K- or 2K-row
+    scatter (~30 small scatters per round).  Selectable via
+    ``fused_bookkeeping=false`` — the reference layout for the
+    fused-vs-unfused bit-parity test (tests/test_phase_attrib.py) and for
+    attribution A/Bs."""
+
+    fused = False
+
+    def __init__(self, L, L1, W, use_mc, use_cat):
+        self.L, self.L1, self.W = L, L1, W
+        self.use_mc, self.use_cat = use_mc, use_cat
+
+    def init(self, res0, out0):
+        L, W = self.L, self.W
+        return dict(
+            best_gain=jnp.full(L, -jnp.inf, jnp.float32).at[0]
+            .set(res0.gain),
+            best_feat=jnp.zeros(L, jnp.int32).at[0].set(res0.feature),
+            best_bin=jnp.zeros(L, jnp.int32).at[0].set(res0.threshold_bin),
+            best_dl=jnp.zeros(L, bool).at[0].set(res0.default_left),
+            best_left=jnp.zeros((L, 3), jnp.float32).at[0]
+            .set(res0.left_sum),
+            best_right=jnp.zeros((L, 3), jnp.float32).at[0]
+            .set(res0.right_sum),
+            best_iscat=jnp.zeros(L, bool).at[0].set(res0.is_cat),
+            best_bitset=jnp.zeros((L, W), jnp.uint32).at[0]
+            .set(res0.cat_bitset),
+            leaf_constr=jnp.tile(jnp.asarray(NO_CONSTRAINT, jnp.float32),
+                                 (L, 1)),
+            leaf_out=jnp.zeros(L, jnp.float32).at[0].set(out0),
+            leaf_depth=jnp.zeros(L, jnp.int32),
+            leaf_is_left=jnp.zeros(L, bool),
+            tree=empty_tree(L, W),
+        )
+
+    def gains(self, s):
+        return s["best_gain"]
+
+    def leaf_out_full(self, s):
+        return s["leaf_out"]
+
+    def read(self, s, leafs):
+        t = s["tree"]
+        return dict(
+            feats=s["best_feat"][leafs],
+            thrs=s["best_bin"][leafs],
+            dls=s["best_dl"][leafs],
+            iscats=s["best_iscat"][leafs],
+            bitsets=s["best_bitset"][leafs],
+            lsums=s["best_left"][leafs],
+            rsums=s["best_right"][leafs],
+            pconstr=s["leaf_constr"][leafs],
+            pout=s["leaf_out"][leafs],
+            pdepth=s["leaf_depth"][leafs],
+            was_left=s["leaf_is_left"][leafs],
+            parent=t.leaf_parent[leafs],
+        )
+
+    def write(self, s, r):
+        res = r["res"]
+        t = s["tree"]
+        lc = t.left_child.at[r["fix_l"]].set(r["nidx"], mode="drop")
+        rc = t.right_child.at[r["fix_r"]].set(r["nidx"], mode="drop")
+        lc = lc.at[r["nidx"]].set(-(r["leafs"] + 1), mode="drop")
+        rc = rc.at[r["nidx"]].set(-(r["nls"] + 1), mode="drop")
+        tree = t._replace(
+            num_leaves=r["num_leaves_new"],
+            split_feature=t.split_feature.at[r["nidx"]]
+            .set(r["feats"], mode="drop"),
+            threshold_bin=t.threshold_bin.at[r["nidx"]]
+            .set(r["thrs"], mode="drop"),
+            default_left=t.default_left.at[r["nidx"]]
+            .set(r["dls"], mode="drop"),
+            is_cat=t.is_cat.at[r["nidx"]].set(r["iscats"], mode="drop"),
+            cat_bitset=t.cat_bitset.at[r["nidx"]]
+            .set(r["bitsets"], mode="drop"),
+            missing_type=t.missing_type.at[r["nidx"]]
+            .set(r["mtypes"], mode="drop"),
+            left_child=lc,
+            right_child=rc,
+            split_gain=t.split_gain.at[r["nidx"]]
+            .set(r["vals"], mode="drop"),
+            internal_value=t.internal_value.at[r["nidx"]]
+            .set(r["pout"], mode="drop"),
+            internal_weight=t.internal_weight.at[r["nidx"]]
+            .set(r["psum"][:, 1], mode="drop"),
+            internal_count=t.internal_count.at[r["nidx"]]
+            .set(r["psum"][:, 2], mode="drop"),
+            leaf_value=t.leaf_value.at[r["lidx"]]
+            .set(r["out_l"], mode="drop")
+            .at[r["nlidx"]].set(r["out_r"], mode="drop"),
+            leaf_weight=t.leaf_weight.at[r["lidx"]]
+            .set(r["lsums"][:, 1], mode="drop")
+            .at[r["nlidx"]].set(r["rsums"][:, 1], mode="drop"),
+            leaf_count=t.leaf_count.at[r["lidx"]]
+            .set(r["lsums"][:, 2], mode="drop")
+            .at[r["nlidx"]].set(r["rsums"][:, 2], mode="drop"),
+            leaf_parent=t.leaf_parent.at[r["lidx"]]
+            .set(r["nidx"], mode="drop")
+            .at[r["nlidx"]].set(r["nidx"], mode="drop"),
+        )
+        cidx = r["cidx"]
+        return dict(
+            best_gain=s["best_gain"].at[cidx].set(r["cgain"], mode="drop"),
+            best_feat=s["best_feat"].at[cidx]
+            .set(res.feature, mode="drop"),
+            best_bin=s["best_bin"].at[cidx]
+            .set(res.threshold_bin, mode="drop"),
+            best_dl=s["best_dl"].at[cidx]
+            .set(res.default_left, mode="drop"),
+            best_left=s["best_left"].at[cidx]
+            .set(res.left_sum, mode="drop"),
+            best_right=s["best_right"].at[cidx]
+            .set(res.right_sum, mode="drop"),
+            best_iscat=s["best_iscat"].at[cidx]
+            .set(res.is_cat, mode="drop"),
+            best_bitset=s["best_bitset"].at[cidx]
+            .set(res.cat_bitset, mode="drop"),
+            leaf_constr=s["leaf_constr"].at[cidx]
+            .set(r["cconstr"], mode="drop"),
+            leaf_out=s["leaf_out"].at[cidx].set(r["couts"], mode="drop"),
+            leaf_depth=s["leaf_depth"].at[cidx]
+            .set(r["cdepth"], mode="drop"),
+            leaf_is_left=s["leaf_is_left"].at[r["lidx"]]
+            .set(True, mode="drop")
+            .at[r["nlidx"]].set(False, mode="drop"),
+            tree=tree,
+        )
+
+    def finalize(self, s, num_leaves):
+        return s["tree"]._replace(num_leaves=num_leaves)
+
+
+class _PackedStore:
+    """Fused per-round bookkeeping (``fused_bookkeeping=true``, default).
+
+    All per-leaf frontier + tree-leaf state lives in ONE ``(L, CF)`` f32
+    table and all per-node tree state in ONE ``(L1, 10)`` f32 table.  A
+    round commits with one coalesced 2K-row scatter into the frontier
+    table, one K-row scatter into the node table, and one two-column
+    child-pointer fixup — three scatters instead of the legacy layout's
+    ~30 per-field scatters per round (the phase-attribution harness
+    measured that scatter storm as the largest slice of the
+    per-iteration ``phase_other_ms`` residual, tools/phase_attrib.py).
+
+    Integers and booleans ride as exact small f32 values (every id, bin,
+    depth and child index is far below 2^24), so packing is bit-lossless
+    and the grown trees are bit-identical to the unfused layout on the
+    exact-fp32 histogram path (tests/test_phase_attrib.py pins this).
+    Categorical state (uint32 bitsets) keeps separate arrays — f32
+    storage cannot carry arbitrary 32-bit patterns by value — and the
+    monotone constraint bounds add two columns only when constraints are
+    on, so the common no-cat/no-mono config pays for neither."""
+
+    fused = True
+
+    # frontier-table columns (per leaf)
+    GAIN, FEAT, BIN, DL = 0, 1, 2, 3
+    LS, RS = 4, 7                    # [4:7) left sums, [7:10) right sums
+    OUT, DEPTH, ISLEFT = 10, 11, 12
+    LVAL, LWEIGHT, LCNT, LPAR = 13, 14, 15, 16
+    CMIN, CMAX = 17, 18              # only materialized when use_mc
+    # node-table columns (per internal node)
+    NFEAT, NBIN, NDL, NMT, NGAIN, NIVAL, NIW, NIC, NLC, NRC = range(10)
+
+    def __init__(self, L, L1, W, use_mc, use_cat):
+        self.L, self.L1, self.W = L, L1, W
+        self.use_mc, self.use_cat = use_mc, use_cat
+        self.CF = 19 if use_mc else 17
+
+    def init(self, res0, out0):
+        L, L1, W = self.L, self.L1, self.W
+        z = jnp.float32(0.0)
+        ft = jnp.zeros((L, self.CF), jnp.float32)
+        ft = ft.at[:, self.GAIN].set(-jnp.inf)
+        ft = ft.at[:, self.LPAR].set(-1.0)
+        if self.use_mc:
+            ft = ft.at[:, self.CMIN].set(float(NO_CONSTRAINT[0]))
+            ft = ft.at[:, self.CMAX].set(float(NO_CONSTRAINT[1]))
+        root = jnp.stack([
+            res0.gain,
+            res0.feature.astype(jnp.float32),
+            res0.threshold_bin.astype(jnp.float32),
+            res0.default_left.astype(jnp.float32),
+            res0.left_sum[0], res0.left_sum[1], res0.left_sum[2],
+            res0.right_sum[0], res0.right_sum[1], res0.right_sum[2],
+            out0, z, z, z, z, z, jnp.float32(-1.0),
+        ] + ([jnp.float32(NO_CONSTRAINT[0]),
+              jnp.float32(NO_CONSTRAINT[1])] if self.use_mc else []))
+        ft = ft.at[0].set(root)
+        nt = jnp.zeros((L1, 10), jnp.float32)
+        nt = nt.at[:, self.NLC].set(-1.0).at[:, self.NRC].set(-2.0)
+        out = {"ft": ft, "nt": nt}
+        if self.use_cat:
+            out["f_iscat"] = jnp.zeros(L, bool).at[0].set(res0.is_cat)
+            out["f_bitset"] = jnp.zeros((L, W), jnp.uint32).at[0] \
+                .set(res0.cat_bitset)
+            out["n_iscat"] = jnp.zeros(L1, bool)
+            out["n_bitset"] = jnp.zeros((L1, W), jnp.uint32)
+        return out
+
+    def gains(self, s):
+        return s["ft"][:, self.GAIN]
+
+    def leaf_out_full(self, s):
+        return s["ft"][:, self.OUT]
+
+    def read(self, s, leafs):
+        rows = s["ft"][leafs]                      # ONE gather for all fields
+        K = leafs.shape[0]
+        return dict(
+            feats=rows[:, self.FEAT].astype(jnp.int32),
+            thrs=rows[:, self.BIN].astype(jnp.int32),
+            dls=rows[:, self.DL] != 0,
+            lsums=rows[:, self.LS:self.LS + 3],
+            rsums=rows[:, self.RS:self.RS + 3],
+            pout=rows[:, self.OUT],
+            pdepth=rows[:, self.DEPTH].astype(jnp.int32),
+            was_left=rows[:, self.ISLEFT] != 0,
+            parent=rows[:, self.LPAR].astype(jnp.int32),
+            pconstr=(rows[:, self.CMIN:self.CMAX + 1] if self.use_mc
+                     else jnp.tile(jnp.asarray(NO_CONSTRAINT, jnp.float32),
+                                   (K, 1))),
+            iscats=(s["f_iscat"][leafs] if self.use_cat
+                    else jnp.zeros(K, bool)),
+            bitsets=(s["f_bitset"][leafs] if self.use_cat
+                     else jnp.zeros((K, self.W), jnp.uint32)),
+        )
+
+    def write(self, s, r):
+        res = r["res"]
+        n2 = r["cidx"].shape[0]                    # 2K
+        K = n2 // 2
+        # -- frontier + tree-leaf state: ONE coalesced 2K-row scatter ----
+        crows = jnp.concatenate([
+            r["cgain"][:, None],
+            res.feature.astype(jnp.float32)[:, None],
+            res.threshold_bin.astype(jnp.float32)[:, None],
+            res.default_left.astype(jnp.float32)[:, None],
+            res.left_sum, res.right_sum,
+            r["couts"][:, None],
+            r["cdepth"].astype(jnp.float32)[:, None],
+            jnp.tile(jnp.asarray([1.0, 0.0], jnp.float32), K)[:, None],
+            r["couts"][:, None],                  # leaf_value == leaf_out
+            r["csums"][:, 1:2], r["csums"][:, 2:3],
+            jnp.stack([r["nidx"], r["nidx"]], axis=1).reshape(n2)
+            .astype(jnp.float32)[:, None],
+        ] + ([r["cconstr"]] if self.use_mc else []), axis=1)
+        ft = s["ft"].at[r["cidx"]].set(crows, mode="drop")
+        # -- node state: one K-row scatter + one 2-column pointer fixup --
+        nrows = jnp.concatenate([
+            r["feats"].astype(jnp.float32)[:, None],
+            r["thrs"].astype(jnp.float32)[:, None],
+            r["dls"].astype(jnp.float32)[:, None],
+            r["mtypes"].astype(jnp.float32)[:, None],
+            r["vals"][:, None],
+            r["pout"][:, None],
+            r["psum"][:, 1:2], r["psum"][:, 2:3],
+            (-(r["leafs"] + 1)).astype(jnp.float32)[:, None],
+            (-(r["nls"] + 1)).astype(jnp.float32)[:, None],
+        ], axis=1)
+        nt = s["nt"]
+        # parents are strictly OLDER nodes than this round's new rows, so
+        # the fixup and the row write never collide and order is free
+        rows2 = jnp.concatenate([r["fix_l"], r["fix_r"]])
+        cols2 = jnp.concatenate([jnp.full(K, self.NLC, jnp.int32),
+                                 jnp.full(K, self.NRC, jnp.int32)])
+        vals2 = jnp.concatenate([r["nidx"], r["nidx"]]).astype(jnp.float32)
+        nt = nt.at[rows2, cols2].set(vals2, mode="drop")
+        nt = nt.at[r["nidx"]].set(nrows, mode="drop")
+        out = {"ft": ft, "nt": nt}
+        if self.use_cat:
+            out["f_iscat"] = s["f_iscat"].at[r["cidx"]] \
+                .set(res.is_cat, mode="drop")
+            out["f_bitset"] = s["f_bitset"].at[r["cidx"]] \
+                .set(res.cat_bitset, mode="drop")
+            out["n_iscat"] = s["n_iscat"].at[r["nidx"]] \
+                .set(r["iscats"], mode="drop")
+            out["n_bitset"] = s["n_bitset"].at[r["nidx"]] \
+                .set(r["bitsets"], mode="drop")
+        return out
+
+    def finalize(self, s, num_leaves):
+        ft, nt = s["ft"], s["nt"]
+        L1, W = self.L1, self.W
+        return TreeArrays(
+            num_leaves=num_leaves,
+            split_feature=nt[:, self.NFEAT].astype(jnp.int32),
+            threshold_bin=nt[:, self.NBIN].astype(jnp.int32),
+            threshold=jnp.zeros(L1, jnp.float32),
+            default_left=nt[:, self.NDL] != 0,
+            missing_type=nt[:, self.NMT].astype(jnp.int32),
+            left_child=nt[:, self.NLC].astype(jnp.int32),
+            right_child=nt[:, self.NRC].astype(jnp.int32),
+            split_gain=nt[:, self.NGAIN],
+            internal_value=nt[:, self.NIVAL],
+            internal_weight=nt[:, self.NIW],
+            internal_count=nt[:, self.NIC],
+            leaf_value=ft[:, self.LVAL],
+            leaf_weight=ft[:, self.LWEIGHT],
+            leaf_count=ft[:, self.LCNT],
+            leaf_parent=ft[:, self.LPAR].astype(jnp.int32),
+            is_cat=(s["n_iscat"] if self.use_cat
+                    else jnp.zeros(L1, bool)),
+            cat_bitset=(s["n_bitset"] if self.use_cat
+                        else jnp.zeros((L1, W), jnp.uint32)),
+        )
 
 
 def _topk_by_rank(gains: jax.Array, K: int):
@@ -263,6 +605,7 @@ def make_wave_grower(
     monotone_mode: str = "basic",
     interaction_groups=None,
     wave_size: int = 32,
+    fused_bookkeeping: bool = True,
     hist_wave_fn: Callable = None,
     split_fn: Callable = None,
     sums_fn: Callable = None,
@@ -283,6 +626,10 @@ def make_wave_grower(
     ``bins_of_fn(binned, feat) -> (N,)`` — ORIGINAL bins of a feature; the
     EFB path substitutes the bundle-column decode (io/bundle.py
     bundle_bins_of_feat), so ``binned`` may be the (BF, N) bundled matrix.
+    ``fused_bookkeeping`` selects the per-round state layout: packed
+    tables with one coalesced scatter each (_PackedStore, default) or the
+    legacy per-field scatters (_FieldStore); trees are bit-identical
+    either way on the exact-fp32 histogram path.
     """
     L = num_leaves
     L1 = max(L - 1, 1)
@@ -292,12 +639,15 @@ def make_wave_grower(
     use_mc = bool(np.asarray(meta.monotone_type).any())
     use_cat = bool(np.asarray(meta.is_categorical).any())
     use_inter = use_mc and monotone_mode == "intermediate"
+    use_groups = interaction_groups is not None
     if use_inter:
         _mt = np.asarray(meta.monotone_type)
         inter_feats = [int(f) for f in np.where(_mt != 0)[0]]
         inter_types = [int(_mt[f]) for f in inter_feats]
     groups = (jnp.asarray(interaction_groups)
               if interaction_groups is not None else None)
+    store = (_PackedStore if fused_bookkeeping else _FieldStore)(
+        L, L1, W, use_mc, use_cat)
 
     if split_fn is None:
         def split_fn(hist, parent, mask, key, uid, constraint, depth,
@@ -362,6 +712,19 @@ def make_wave_grower(
             out0 = smooth_output(out0, root_sum[2], 0.0, params)
         res0 = split_fn(hist0, root_sum, mask0, key, 0, no_constr, 0, out0)
 
+        # round-invariant work hoisted out of the while-loop body: with
+        # per-node column sampling off and no interaction constraints the
+        # children's feature mask is the same every round, and with no
+        # monotone constraints every child's constraint is the NO_CONSTRAINT
+        # constant — neither needs per-round gathers/scatters
+        cmask_const = (jnp.broadcast_to(base_mask, (2 * K, F))
+                       if feature_fraction_bynode >= 1.0 and not use_groups
+                       else None)
+        pconstr_const = (None if use_mc
+                         else jnp.tile(no_constr, (K, 1)))
+        cconstr_const = (None if use_mc
+                         else jnp.tile(no_constr, (2 * K, 1)))
+
         st = WaveState(
             leaf_id=leaf_id0,
             valid_lids=tuple(jnp.zeros(v.shape[1], jnp.int32)
@@ -370,24 +733,12 @@ def make_wave_grower(
                                  jnp.float32).at[0].set(hist0)
                        if use_sub
                        else jnp.zeros((1,) + hist0.shape, jnp.float32)),
-            best_gain=jnp.full(L, -jnp.inf, jnp.float32).at[0].set(res0.gain),
-            best_feat=jnp.zeros(L, jnp.int32).at[0].set(res0.feature),
-            best_bin=jnp.zeros(L, jnp.int32).at[0].set(res0.threshold_bin),
-            best_dl=jnp.zeros(L, bool).at[0].set(res0.default_left),
-            best_left=jnp.zeros((L, 3), jnp.float32).at[0].set(res0.left_sum),
-            best_right=jnp.zeros((L, 3), jnp.float32).at[0].set(res0.right_sum),
-            best_iscat=jnp.zeros(L, bool).at[0].set(res0.is_cat),
-            best_bitset=jnp.zeros((L, W), jnp.uint32).at[0].set(res0.cat_bitset),
-            leaf_constr=jnp.tile(jnp.asarray(NO_CONSTRAINT, jnp.float32),
-                                 (L, 1)),
+            store=store.init(res0, out0),
             leaf_box=(jnp.zeros((L, F, 2), jnp.int32)
                       .at[0, :, 1].set(meta.num_bins)
                       if use_inter else jnp.zeros((1, 1, 2), jnp.int32)),
-            leaf_out=jnp.zeros(L, jnp.float32).at[0].set(out0),
-            leaf_used=jnp.zeros((L, F), bool),
-            leaf_depth=jnp.zeros(L, jnp.int32),
-            leaf_is_left=jnp.zeros(L, bool),
-            tree=empty_tree(L, W),
+            leaf_used=(jnp.zeros((L, F), bool) if use_groups
+                       else jnp.zeros((1, 1), bool)),
             num_leaves=jnp.asarray(1, jnp.int32),
             done=jnp.asarray(L <= 1),
         )
@@ -403,11 +754,11 @@ def make_wave_grower(
             # frontier gain guarantees n_split >= 1 (the intermediate-
             # monotone deferral never clears the FIRST valid pick).
             return (~st.done) & (st.num_leaves < L) & \
-                (jnp.max(st.best_gain) > 0)
+                (jnp.max(store.gains(st.store)) > 0)
 
         def body(st: WaveState) -> WaveState:
             budget = L - st.num_leaves
-            vals, leafs = _topk_by_rank(st.best_gain, K)      # (K,) gain order
+            vals, leafs = _topk_by_rank(store.gains(st.store), K)  # (K,)
             valid = (vals > 0) & (kiota < budget)
             if use_inter and K > 1:
                 # soundness: two leaves ADJACENT along a monotone feature
@@ -434,13 +785,13 @@ def make_wave_grower(
             nodes = st.num_leaves - 1 + order                 # (K,) int32
             nls = st.num_leaves + order                       # new right leaves
 
-            feats = st.best_feat[leafs]
-            thrs = st.best_bin[leafs]
-            dls = st.best_dl[leafs]
-            iscats = st.best_iscat[leafs]
-            bitsets = st.best_bitset[leafs]                   # (K, W)
-            lsums = st.best_left[leafs]                       # (K, 3)
-            rsums = st.best_right[leafs]
+            # one store read for every frontier field of the K split leaves
+            # (the packed store turns 10+ per-field gathers into a single
+            # (K, CF) table row gather)
+            rd = store.read(st.store, leafs)
+            feats, thrs, dls = rd["feats"], rd["thrs"], rd["dls"]
+            iscats, bitsets = rd["iscats"], rd["bitsets"]     # (K,), (K, W)
+            lsums, rsums = rd["lsums"], rd["rsums"]           # (K, 3)
             sm_left = lsums[:, 2] <= rsums[:, 2]              # (K,) smaller
             order_c = jnp.clip(order, 0, K - 1)
 
@@ -548,13 +899,8 @@ def make_wave_grower(
 
             if use_sub:
                 # ---- smaller-child histograms + subtraction --------------
-                h_small = h_slot[order_c]          # slot-order -> rank-order
-                h_parent = st.leaf_hist[leafs]
-                smL = sm_left[:, None, None, None]
-                h_left = jnp.where(smL, h_small, h_parent - h_small)
-                h_right = h_parent - h_left
-                hist = jnp.stack([h_left, h_right], axis=1).reshape(
-                    (2 * K,) + h_left.shape[1:])
+                hist, h_left, h_right = subtract_child_hists(
+                    h_slot, st.leaf_hist, leafs, order_c, sm_left)
             else:
                 ch_idx = jnp.stack([2 * order_c, 2 * order_c + 1],
                                    axis=1).reshape(2 * K)
@@ -569,12 +915,14 @@ def make_wave_grower(
                 # this leaf's constraint was stored (the reference's
                 # leaves_to_update_ propagation, monotone_constraints.hpp)
                 constr_tab = intermediate_constraints(
-                    st.leaf_box, st.leaf_out, st.num_leaves,
-                    inter_feats, inter_types)
+                    st.leaf_box, store.leaf_out_full(st.store),
+                    st.num_leaves, inter_feats, inter_types)
                 pconstr = constr_tab[leafs]                   # (K, 2)
+            elif use_mc:
+                pconstr = rd["pconstr"]                       # (K, 2)
             else:
-                pconstr = st.leaf_constr[leafs]               # (K, 2)
-            pout = st.leaf_out[leafs]                         # (K,)
+                pconstr = pconstr_const     # hoisted NO_CONSTRAINT rows
+            pout = rd["pout"]                                 # (K,)
             out_l = jax.vmap(clamp_out)(lsums, pconstr, pout)
             out_r = jax.vmap(clamp_out)(rsums, pconstr, pout)
             if use_inter:
@@ -611,28 +959,40 @@ def make_wave_grower(
                                   jnp.maximum(pconstr[:, 0], mid), pconstr[:, 0])
                 constr_l = jnp.stack([min_l, max_l], axis=1)
                 constr_r = jnp.stack([min_r, max_r], axis=1)
+            if use_mc:
+                cconstr = jnp.stack([constr_l, constr_r],
+                                    axis=1).reshape(2 * K, 2)
             else:
-                constr_l = constr_r = pconstr
-            cconstr = jnp.stack([constr_l, constr_r], axis=1).reshape(2 * K, 2)
+                cconstr = cconstr_const     # hoisted NO_CONSTRAINT rows
             couts = jnp.stack([out_l, out_r], axis=1).reshape(2 * K)
-            d = st.leaf_depth[leafs] + 1                      # (K,)
+            d = rd["pdepth"] + 1                              # (K,)
             cdepth = jnp.stack([d, d], axis=1).reshape(2 * K)
             depth_ok = (max_depth <= 0) | (cdepth < max_depth)
 
-            used_child = st.leaf_used[leafs] | jax.nn.one_hot(
-                feats, F, dtype=bool)                         # (K, F)
-            cused = jnp.stack([used_child, used_child], axis=1) \
-                .reshape(2 * K, F)
-            allow = jax.vmap(allowed_features)(cused)         # (2K, F)
             cuids = jnp.stack([2 * nodes + 1, 2 * nodes + 2],
                               axis=1).reshape(2 * K)
+            if use_groups:
+                # branch-feature tracking feeds ONLY the interaction-
+                # constraint mask — with no groups the whole block is
+                # hoisted away (dead per-round one-hot + scatter)
+                used_child = st.leaf_used[leafs] | jax.nn.one_hot(
+                    feats, F, dtype=bool)                     # (K, F)
+                cused = jnp.stack([used_child, used_child], axis=1) \
+                    .reshape(2 * K, F)
+                allow = jax.vmap(allowed_features)(cused)     # (2K, F)
+            else:
+                cused = allow = None
             if feature_fraction_bynode < 1.0:
                 cmask = jax.vmap(
                     lambda u: _node_feature_mask(key, u, base_mask,
                                                  feature_fraction_bynode)
-                )(cuids) & allow
-            else:
+                )(cuids)
+                if allow is not None:
+                    cmask = cmask & allow
+            elif allow is not None:
                 cmask = jnp.broadcast_to(base_mask, (2 * K, F)) & allow
+            else:
+                cmask = cmask_const         # hoisted: same mask every round
 
             if use_inter:
                 # child regions: a numerical split cuts the parent's box at
@@ -653,86 +1013,67 @@ def make_wave_grower(
             cvalid = jnp.stack([valid, valid], axis=1).reshape(2 * K)
             cidx = jnp.where(cvalid, cleafs, L + 1)           # drop slot
 
-            # ---- tree assembly (scatter at K nodes, like the level-wise
-            # grower's batch update) ---------------------------------------
-            t = st.tree
+            # ---- tree assembly + frontier commit ------------------------
+            # One store.write per round: the packed store coalesces the
+            # whole commit into a 2K-row frontier-table scatter, a K-row
+            # node-table scatter and a 2-column pointer fixup; the legacy
+            # store reproduces the historical ~30 per-field scatters.
             nidx = jnp.where(valid, nodes, L1 + 1)
             lidx = jnp.where(valid, leafs, L + 1)
             nlidx = jnp.where(valid, nls, L + 1)
-            p = t.leaf_parent[leafs]
-            was_left = st.leaf_is_left[leafs]
+            p = rd["parent"]
+            was_left = rd["was_left"]
             fix_l = jnp.where(valid & (p >= 0) & was_left,
                               jnp.maximum(p, 0), L1 + 1)
             fix_r = jnp.where(valid & (p >= 0) & (~was_left),
                               jnp.maximum(p, 0), L1 + 1)
-            lc = t.left_child.at[fix_l].set(nidx, mode="drop")
-            rc = t.right_child.at[fix_r].set(nidx, mode="drop")
-            lc = lc.at[nidx].set(-(leafs + 1), mode="drop")
-            rc = rc.at[nidx].set(-(nls + 1), mode="drop")
             psum_k = lsums + rsums                            # parent sums
-            tree = t._replace(
-                num_leaves=st.num_leaves + n_split,
-                split_feature=t.split_feature.at[nidx].set(feats, mode="drop"),
-                threshold_bin=t.threshold_bin.at[nidx].set(thrs, mode="drop"),
-                default_left=t.default_left.at[nidx].set(dls, mode="drop"),
-                is_cat=t.is_cat.at[nidx].set(iscats, mode="drop"),
-                cat_bitset=t.cat_bitset.at[nidx].set(bitsets, mode="drop"),
-                missing_type=t.missing_type.at[nidx].set(
-                    meta.missing_type[feats], mode="drop"),
-                left_child=lc,
-                right_child=rc,
-                split_gain=t.split_gain.at[nidx].set(vals, mode="drop"),
-                internal_value=t.internal_value.at[nidx].set(pout, mode="drop"),
-                internal_weight=t.internal_weight.at[nidx].set(
-                    psum_k[:, 1], mode="drop"),
-                internal_count=t.internal_count.at[nidx].set(
-                    psum_k[:, 2], mode="drop"),
-                leaf_value=t.leaf_value.at[lidx].set(out_l, mode="drop")
-                .at[nlidx].set(out_r, mode="drop"),
-                leaf_weight=t.leaf_weight.at[lidx].set(lsums[:, 1], mode="drop")
-                .at[nlidx].set(rsums[:, 1], mode="drop"),
-                leaf_count=t.leaf_count.at[lidx].set(lsums[:, 2], mode="drop")
-                .at[nlidx].set(rsums[:, 2], mode="drop"),
-                leaf_parent=t.leaf_parent.at[lidx].set(nidx, mode="drop")
-                .at[nlidx].set(nidx, mode="drop"),
-            )
+            new_store = store.write(st.store, dict(
+                res=res, cgain=cgain, cidx=cidx, nidx=nidx,
+                lidx=lidx, nlidx=nlidx, fix_l=fix_l, fix_r=fix_r,
+                leafs=leafs, nls=nls,
+                feats=feats, thrs=thrs, dls=dls,
+                iscats=iscats, bitsets=bitsets,
+                mtypes=meta.missing_type[feats],
+                vals=vals, pout=pout, psum=psum_k,
+                lsums=lsums, rsums=rsums, csums=csums,
+                out_l=out_l, out_r=out_r, couts=couts,
+                cdepth=cdepth, cconstr=cconstr,
+                num_leaves_new=st.num_leaves + n_split,
+            ))
+
+            if use_sub:
+                # packed: ONE interleaved scatter at cidx (hist is already
+                # the rank-interleaved (2K, ...) child stack); legacy: the
+                # historical two half-scatters
+                leaf_hist = (
+                    st.leaf_hist.at[cidx].set(hist, mode="drop")
+                    if store.fused else
+                    st.leaf_hist.at[lidx].set(h_left, mode="drop")
+                    .at[nlidx].set(h_right, mode="drop"))
+            else:
+                leaf_hist = st.leaf_hist
 
             return WaveState(
                 leaf_id=leaf_id,
                 valid_lids=new_vlids,
-                leaf_hist=(st.leaf_hist.at[lidx].set(h_left, mode="drop")
-                           .at[nlidx].set(h_right, mode="drop")
-                           if use_sub else st.leaf_hist),
-                best_gain=st.best_gain.at[cidx].set(cgain, mode="drop"),
-                best_feat=st.best_feat.at[cidx].set(res.feature, mode="drop"),
-                best_bin=st.best_bin.at[cidx].set(res.threshold_bin,
-                                                  mode="drop"),
-                best_dl=st.best_dl.at[cidx].set(res.default_left, mode="drop"),
-                best_left=st.best_left.at[cidx].set(res.left_sum, mode="drop"),
-                best_right=st.best_right.at[cidx].set(res.right_sum,
-                                                      mode="drop"),
-                best_iscat=st.best_iscat.at[cidx].set(res.is_cat, mode="drop"),
-                best_bitset=st.best_bitset.at[cidx].set(res.cat_bitset,
-                                                        mode="drop"),
-                leaf_constr=st.leaf_constr.at[cidx].set(cconstr, mode="drop"),
+                leaf_hist=leaf_hist,
+                store=new_store,
                 leaf_box=(st.leaf_box.at[lidx].set(box_l, mode="drop")
                           .at[nlidx].set(box_r, mode="drop")
                           if use_inter else st.leaf_box),
-                leaf_out=st.leaf_out.at[cidx].set(couts, mode="drop"),
-                leaf_used=st.leaf_used.at[cidx].set(cused, mode="drop"),
-                leaf_depth=st.leaf_depth.at[cidx].set(cdepth, mode="drop"),
-                leaf_is_left=st.leaf_is_left.at[lidx].set(True, mode="drop")
-                .at[nlidx].set(False, mode="drop"),
-                tree=tree,
+                leaf_used=(st.leaf_used.at[cidx].set(cused, mode="drop")
+                           if use_groups else st.leaf_used),
                 num_leaves=st.num_leaves + n_split,
                 done=st.done | (n_split == 0),
             )
 
         if L > 1:
             st = lax.while_loop(cond, body, st)
+        tree = store.finalize(st.store, st.num_leaves)
         if valids:
-            return st.tree, st.leaf_id, root_sum, st.valid_lids
-        return st.tree, st.leaf_id, root_sum
+            return tree, st.leaf_id, root_sum, st.valid_lids
+        return tree, st.leaf_id, root_sum
 
     grow._supports_valids = True
     return grow

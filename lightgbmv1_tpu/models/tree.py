@@ -14,6 +14,7 @@ gather + select per level).
 
 from __future__ import annotations
 
+import os
 from typing import List, NamedTuple, Optional
 
 import jax
@@ -48,8 +49,25 @@ class TreeArrays(NamedTuple):
                                 # (reference: cat_threshold_inner_, tree.h:427)
 
 
+# Debug-mode bounds contract for leaf_lookup (set LGBM_TPU_DEBUG_BOUNDS=1
+# or flip this flag in tests): out-of-range leaf ids poison their rows
+# with NaN instead of silently contributing 0.0, so a caller relying on
+# the gather's clamp semantics fails loudly instead of training on wrong
+# scores.  Off by default — the where() adds a pass over the rows.
+DEBUG_BOUNDS = bool(int(os.environ.get("LGBM_TPU_DEBUG_BOUNDS", "0")))
+
+
 def leaf_lookup(table: jax.Array, leaf_id: jax.Array) -> jax.Array:
     """``table[leaf_id]`` without a device gather.
+
+    PRECONDITION: every ``leaf_id`` must be in ``[0, len(table))``.  The
+    XLA gather this replaces CLAMPS out-of-bounds indices to the edge
+    entry; the broadcast-compare below instead contributes **0.0** for
+    any out-of-range id — a silent semantic change for a caller that
+    relied on the clamp.  All in-tree call sites pass partition-produced
+    leaf ids, which are in-range by construction; new callers must
+    guarantee the same (enable ``DEBUG_BOUNDS`` to get NaN poisoning on
+    violations instead of silent zeros).
 
     TPU gathers run at ~1 element per several cycles (7.8 ms for 1M rows
     from a 255-entry table, tools/microbench_gather.py) while a
@@ -60,18 +78,24 @@ def leaf_lookup(table: jax.Array, leaf_id: jax.Array) -> jax.Array:
     analog of the reference ScoreUpdater's per-leaf AddScore
     (src/boosting/score_updater.hpp), reformulated for the VPU."""
     L = table.shape[0]
+    lid = leaf_id.astype(jnp.int32)
     if L > 1024:
-        return table[leaf_id]
-    iota = jnp.arange(L, dtype=jnp.int32)
-    eq = leaf_id[:, None].astype(jnp.int32) == iota[None, :]
-    # Each element of the result is value-equal to table[leaf_id], but
-    # consumers may see 1-ulp drift vs the gather formulation: XLA is free
-    # to reassociate a producer's scale factor across the reduce and
-    # fma-fuse into a consumer add (one rounding instead of two).  Paths
-    # with a PINNED bit-parity contract (the wave grower's valid-score
-    # routing vs the tree walk) therefore keep the native gather — valid
-    # sets are small; this formulation is for the big train-row tables.
-    return jnp.sum(jnp.where(eq, table[None, :], 0), axis=1)
+        out = table[leaf_id]
+    else:
+        iota = jnp.arange(L, dtype=jnp.int32)
+        eq = lid[:, None] == iota[None, :]
+        # Each element of the result is value-equal to table[leaf_id], but
+        # consumers may see 1-ulp drift vs the gather formulation: XLA is
+        # free to reassociate a producer's scale factor across the reduce
+        # and fma-fuse into a consumer add (one rounding instead of two).
+        # Paths with a PINNED bit-parity contract (the wave grower's
+        # valid-score routing vs the tree walk) therefore keep the native
+        # gather — valid sets are small; this formulation is for the big
+        # train-row tables.
+        out = jnp.sum(jnp.where(eq, table[None, :], 0), axis=1)
+    if DEBUG_BOUNDS:
+        out = jnp.where((lid >= 0) & (lid < L), out, jnp.nan)
+    return out
 
 
 def empty_tree(max_leaves: int, cat_words: int = 1) -> TreeArrays:

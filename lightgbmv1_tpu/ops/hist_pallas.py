@@ -155,11 +155,24 @@ def _kernel(iota_ref, bins_ref, g3_ref, leaf_ref, out_ref, *, lpad, num_bins,
             out_ref[0, :, sl] += acc.astype(jnp.float32) * scale_rep
         elif precision in ("bf16", "bf16x2"):
             oh = oh_cmp.astype(jnp.bfloat16)
-            upd = lax.dot_general(lg_parts[0], oh, (((1,), (0,)), ((), ())),
-                                  preferred_element_type=jnp.float32)
-            for p in lg_parts[1:]:
-                upd = upd + lax.dot_general(p, oh, (((1,), (0,)), ((), ())),
-                                            preferred_element_type=jnp.float32)
+            if len(lg_parts) > 1:
+                # bf16x2: ONE stacked (2·M, T) @ (T, lanes) pass sharing the
+                # built one-hot block across the hi and lo accumulations,
+                # instead of two matmuls that each re-stream it — the
+                # one-hot build + stream is the slot-count-independent
+                # floor of the pass.  Splitting the output and adding
+                # hi + lo afterwards is bit-identical to the two-matmul
+                # form: each output row's fp32 dot is unchanged and the
+                # final add keeps the same operand order.
+                stacked = lax.dot_general(
+                    jnp.concatenate(lg_parts, axis=0), oh,
+                    (((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32)
+                upd = stacked[:m_pad] + stacked[m_pad:]
+            else:
+                upd = lax.dot_general(lg_parts[0], oh,
+                                      (((1,), (0,)), ((), ())),
+                                      preferred_element_type=jnp.float32)
             out_ref[0, :, sl] += upd
         else:
             oh = oh_cmp.astype(jnp.float32)

@@ -257,7 +257,7 @@ def default_hist_method(config_method: str = "auto",
 def benchmark_hist_methods(binned_np, num_bins: int, precision: str,
                            packed: bool, num_features: int,
                            nslots: int = 16, max_rows: int = 131072,
-                           candidates=None) -> str:
+                           candidates=None, must_include=None) -> str:
     """Time the applicable histogram implementations on the REAL matrix
     shapes and return the fastest — the role of the reference's
     ``Dataset::GetShareStates`` col-wise/row-wise auto-benchmark
@@ -269,6 +269,15 @@ def benchmark_hist_methods(binned_np, num_bins: int, precision: str,
     in-jit scan differential — (wall(r2) - wall(r1)) / (r2 - r1) — so the
     per-dispatch latency of a tunneled device (~113 ms here) cancels
     instead of swamping the few-ms passes being compared.
+
+    ``must_include`` seeds the candidate list with a method the user
+    forced (``force_col_wise`` -> scatter, ``force_row_wise`` -> onehot):
+    an explicit ``hist_method=bench`` used to time candidate lists that
+    could never contain the forced method (scatter is excluded from
+    device lists), silently ignoring the force — the reference fatals on
+    such conflicts in ``CheckParamConflict``; here the forced method
+    competes in the timing instead, so the force is honored when it wins
+    and the measured evidence is on the log when it does not.
 
     Multi-process runs must NOT call this: per-host wall-clock could pick
     different methods on different hosts around the same collectives (the
@@ -292,6 +301,13 @@ def benchmark_hist_methods(binned_np, num_bins: int, precision: str,
             candidates = ["pallas", "onehot"]
     if packed:
         candidates = [m for m in candidates if m == "pallas"]
+    if must_include and must_include not in candidates:
+        if packed and must_include != "pallas":
+            log_warning(f"hist_method=bench: forced method "
+                        f"'{must_include}' cannot run on 4-bit packed "
+                        "bins; force ignored")
+        else:
+            candidates = [must_include] + list(candidates)
     if len(candidates) <= 1:
         pick = candidates[0] if candidates else default_hist_method(
             "auto", binned_np.dtype)

@@ -228,10 +228,22 @@ def build_trainer(
     if wants_bench:
         from ..ops.histogram import benchmark_hist_methods
 
+        # force_col_wise/force_row_wise name a histogram build strategy
+        # (config.__post_init__ maps them onto scatter/onehot for
+        # hist_method=auto); an EXPLICIT bench request used to ignore
+        # them — the candidate lists never contained the forced method
+        # on device.  Seed the list with it so the force competes in the
+        # timing (the reference fatals on such conflicts in
+        # CheckParamConflict; timing the forced method keeps the
+        # measured evidence on the log instead).
+        forced_method = ("scatter" if config.force_col_wise
+                         else "onehot" if config.force_row_wise else None)
         method = benchmark_hist_methods(
             binned_np,
             bundle_num_bins if bundle is not None else num_bins,
-            precision, packed, int(meta.num_bins.shape[0]))
+            precision, packed, int(meta.num_bins.shape[0]),
+            must_include=(forced_method
+                          if config.hist_method == "bench" else None))
     N = binned_np.shape[1]
     if row_sharded:
         if learner != "data":
@@ -382,6 +394,7 @@ def build_trainer(
     wave_common = {k: v for k, v in common.items() if k != "cegb_coupled"}
     wave_common["wave_size"] = wave_size
     wave_common["monotone_mode"] = mono_mode
+    wave_common["fused_bookkeeping"] = config.fused_bookkeeping
     # sequential-grower histogram pool cap (reference histogram_pool_size;
     # the wave/level growers use frontier-sized buffers and need no cap)
     lw_pool = dict(hist_pool_mb=config.histogram_pool_size, num_features=F)
