@@ -96,6 +96,13 @@ REF_MC_M_ROW_TREES_S = 4.619
 REF_MC_LOGLOSS = 0.830193
 REF_RK_M_ROW_TREES_S = 1.635
 REF_RK_NDCG10 = 0.613977
+# Reference CLI `task=predict` on the 1M-row binary bench set with the
+# 100-tree model, file->file (data parse + predict + result write), 1
+# core, idle host — measured by tools/measure_ref_parity.py's predict
+# block.  None until the next idle-host session records it; the bench
+# emits our side regardless so the comparison lands the moment the
+# constant does.
+REF_PREDICT_M_ROWS_S = None
 
 
 def timed_per_rep(make_reps, r1, r2):
@@ -213,6 +220,43 @@ def measure_hist_and_roofline(ds, N, schedule=None):
         pass_ms[slots] = timed_per_rep(
             hist_make_for(slots, "bf16" if deep else "bf16x2"), 4, 16) * 1e3
 
+    # the int8sr precision variant (hist_dtype_deep="int8sr",
+    # ops/quantize.py): price the quantized pass at the two buckets the
+    # grower's gate makes eligible — the sustained K bucket and the
+    # 16-slot ramp bucket — INCLUDING the stochastic-rounding quantization
+    # itself (the honest per-pass cost the gate decision rides on)
+    quant_fields = {}
+    try:
+        from lightgbmv1_tpu.ops.histogram import hist_wave_quant
+
+        key0 = jax.random.PRNGKey(0)
+
+        def quant_make_for(slots):
+            label = jnp.asarray(
+                rng.randint(0, slots, size=N).astype(np.int32))
+
+            def make(r):
+                @jax.jit
+                def reps():
+                    def body(c, i):
+                        g = g3 * (1.0 + 1e-6 * i.astype(jnp.float32))
+                        h, sc = hist_wave_quant(
+                            binned, g, label, slots, B,
+                            jax.random.fold_in(key0, i), method=method)
+                        return c + h.sum() * sc[0, 0], None
+                    s, _ = lax.scan(body, jnp.float32(0), jnp.arange(r))
+                    return s
+                return reps
+            return make
+
+        quant_fields["hist_ms_per_pass_int8sr"] = round(
+            timed_per_rep(quant_make_for(K), 4, 16) * 1e3, 2)
+        if 16 in BUCKETS and 16 != K:
+            quant_fields["hist_ms_per_pass_s16_int8sr"] = round(
+                timed_per_rep(quant_make_for(16), 4, 16) * 1e3, 2)
+    except Exception as e:  # noqa: BLE001 — variant row must not kill hist
+        quant_fields["int8sr_error"] = f"{type(e).__name__}: {e}"[:200]
+
     # the roofline fraction grades the KERNEL at full bf16x2 (2 MXU
     # passes), independent of the training-time deep-precision policy
     per_pass = timed_per_rep(hist_make_for(K, "bf16x2"), 4, 16)
@@ -256,6 +300,7 @@ def measure_hist_and_roofline(ds, N, schedule=None):
         "device_matmul_peak_tf_s": round(peak_tfs, 2),
         "hist_roofline_frac": round(hist_tfs / peak_tfs, 4),
     }
+    out.update(quant_fields)
     for s in BUCKETS[:-1]:   # ramp buckets exist only when bucketing is on
         out[f"hist_ms_per_pass_s{s}"] = round(pass_ms[s], 2)
     if schedule:
@@ -390,6 +435,100 @@ def measure_phases(ds, N, gb_lw, schedule, hist_fields, n_valid,
         "phase_other_ms": round(other, 2),
         "phase_total_measured_ms": round(per_iter_ms, 2),
     }
+
+
+def measure_predict(gb_lw, X):
+    """Prediction throughput, file->file (VERDICT r5 #6) — the role of the
+    reference CLI's ``task=predict`` (src/application/predictor.hpp):
+    parse the data file, predict every row with the trained ensemble,
+    write the result file.  Two engines are timed on the SAME model and
+    file:
+
+    * the native C++ bulk predictor (lightgbmv1_tpu/native/predictor.cpp —
+      per-row tree walks, OMP threads), reached through Booster.predict's
+      big-batch routing, and
+    * the device batch walk (models/tree.ensemble_predict_raw: all trees'
+      level-vectorized decisions on the accelerator), one dispatch for the
+      whole batch.
+
+    Pure-compute rates are emitted next to the file->file rates so parse/
+    format cost (shared with the reference CLI) is attributable."""
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+
+    from lightgbmv1_tpu.basic import Booster, _objective_string
+    from lightgbmv1_tpu.io.model_text import model_to_string
+    from lightgbmv1_tpu.models.tree import (ensemble_predict_raw,
+                                            host_trees_to_stacked)
+
+    trees = gb_lw.materialize_host_trees()
+    ds = gb_lw.train_set
+    model_str = model_to_string(
+        trees, objective_string=_objective_string(gb_lw.config), num_class=1,
+        num_tree_per_iteration=1, feature_names=list(ds.feature_names),
+        feature_infos=ds.feature_infos())
+    booster = Booster(model_str=model_str)
+
+    work = tempfile.mkdtemp(prefix="predbench_")
+    data_path = os.path.join(work, "pred_data.tsv")
+    n = X.shape[0]
+    # data file written once, outside every timed window (both engines and
+    # the reference CLI read the same bytes)
+    np.savetxt(data_path, X, fmt="%.6g", delimiter="\t")
+
+    def file_to_file(predict_rows):
+        from lightgbmv1_tpu.native import parse_dense_file
+
+        t0 = time.time()
+        Xp = parse_dense_file(data_path, False, "\t")
+        if Xp is None:
+            Xp = np.loadtxt(data_path, delimiter="\t")
+        t_parse = time.time()
+        p = predict_rows(Xp)
+        t_pred = time.time()
+        out_path = os.path.join(work, "pred_out.txt")
+        with open(out_path, "w") as fh:
+            fh.write("\n".join(f"{v:.18g}" for v in np.asarray(p).ravel()))
+            fh.write("\n")
+        t1 = time.time()
+        return t1 - t0, t_pred - t_parse
+
+    fields = {"predict_rows": int(n), "predict_n_trees": len(trees)}
+
+    # ---- native C++ predictor --------------------------------------------
+    booster.predict(X[:256])            # warm: compile/caches outside timing
+    wall, compute = file_to_file(lambda Xp: booster.predict(Xp))
+    fields["predict_M_rows_per_s"] = round(n / wall / 1e6, 3)
+    fields["predict_native_compute_M_rows_per_s"] = round(
+        n / compute / 1e6, 3)
+
+    # ---- device batch walk ------------------------------------------------
+    # host trees carry the REAL thresholds the raw-feature walk needs
+    # (training-time device trees are bin-space only)
+    stacked = host_trees_to_stacked(trees)
+
+    @jax.jit
+    def device_predict(xb):
+        return jax.nn.sigmoid(ensemble_predict_raw(stacked, xb))
+
+    warm = jax.device_get(device_predict(jnp.asarray(X[:256], jnp.float32)))
+    del warm
+    # same scan length as the timed call — a different batch would recompile
+    jax.device_get(device_predict(jnp.asarray(X, jnp.float32)))
+    wall_d, compute_d = file_to_file(
+        lambda Xp: jax.device_get(
+            device_predict(jnp.asarray(Xp, jnp.float32))))
+    fields["predict_device_M_rows_per_s"] = round(n / wall_d / 1e6, 3)
+    fields["predict_device_compute_M_rows_per_s"] = round(
+        n / compute_d / 1e6, 3)
+
+    if REF_PREDICT_M_ROWS_S:
+        fields["predict_ref_cpp_M_rows_per_s"] = REF_PREDICT_M_ROWS_S
+        fields["predict_vs_ref_same_host"] = round(
+            fields["predict_M_rows_per_s"] / REF_PREDICT_M_ROWS_S, 4)
+    return fields
 
 
 def main():
@@ -581,6 +720,41 @@ def main():
         except Exception as e:  # noqa: BLE001
             extra["dart_error"] = f"{type(e).__name__}: {e}"[:200]
 
+        # GOSS and RF fused-scan rows (VERDICT r5 #7): both modes ride the
+        # same lax.scan single-dispatch block as plain GBDT (PERF.md
+        # "boosting-mode dispatch costs") — these rows put a measured
+        # number behind that claim at the bench shapes
+        for bname, bover in (
+                ("goss", {"boosting": "goss"}),
+                ("rf", {"boosting": "rf", "bagging_fraction": 0.63,
+                        "bagging_freq": 1})):
+            try:
+                cfg_b = Config.from_dict({
+                    "objective": "binary", "num_leaves": 255, "max_bin": 63,
+                    "learning_rate": 0.1, "min_data_in_leaf": 20,
+                    "verbosity": -1, "tree_growth": "leafwise", **bover})
+                gbb = create_boosting(cfg_b, ds)
+                gbb.train_iters(TREES)
+                jax.device_get(gbb._train_scores.score)
+                b_dt = 1e30
+                for _ in range(3):
+                    t0 = time.time()
+                    gbb.train_iters(TREES)
+                    jax.device_get(gbb._train_scores.score)
+                    b_dt = min(b_dt, time.time() - t0)
+                extra[f"{bname}_M_row_trees_per_s"] = round(
+                    N * TREES / b_dt / 1e6, 3)
+            except Exception as e:  # noqa: BLE001
+                extra[f"{bname}_error"] = f"{type(e).__name__}: {e}"[:200]
+
+        # prediction benchmark row (VERDICT r5 #6): native C++ predictor +
+        # device batch walk, file->file on the bench set with the 100-tree
+        # leaf-wise model (gb_lw has >= AUC_ITERS trees by this point)
+        try:
+            extra.update(measure_predict(gb_lw, X))
+        except Exception as e:  # noqa: BLE001
+            extra["predict_error"] = f"{type(e).__name__}: {e}"[:200]
+
         # ---- parity set beyond binary (VERDICT r4 missing #1): the
         # reference publishes multiclass and ranking rows in
         # docs/Experiments.rst:113-151; golden tests prove these families
@@ -602,29 +776,31 @@ def main():
                                             reference=dsm)
             gbm = create_boosting(cfg_mc, dsm)
             gbm.add_valid(dsmv, "test")
-            # warm-up block has the SAME scan length as the timed block —
+            # warm-up block has the SAME scan length as the timed blocks —
             # a different length would recompile inside the timed window
             BLK = MC_IT // 2
             gbm.train_iters(BLK)
             jax.device_get(gbm._train_scores.score)
-            t0 = time.time()
-            gbm.train_iters(BLK)
-            jax.device_get(gbm._train_scores.score)
-            mc_dt = time.time() - t0
+            gbm.train_iters(BLK)          # to MC_IT trees for the quality
+            jax.device_get(gbm._train_scores.score)   # read (ref parity)
             mll = None   # quality read at exactly MC_IT trees (ref parity)
             for (_, name, value, _) in gbm.eval_valid():
                 if name == "multi_logloss":
                     mll = float(value)
-            # tunnel drift can randomly halve a single window (measured
-            # 2x swings minutes apart): best-of-3 like the binary block,
-            # with the extra blocks AFTER the quality eval
-            for _ in range(2):
-                t0 = time.time()
+            # throughput from ONE LONG window (the binary block's 500-iter
+            # methodology applied here): the old best-of-3 25-iter windows
+            # recorded 2x tunnel-drift swings minutes apart — a 100-iter
+            # wall of scanned single-dispatch blocks amortizes the drift
+            # the way the stable 500-iter binary number does
+            MC_WIN = 4
+            t0 = time.time()
+            for _ in range(MC_WIN):
                 gbm.train_iters(BLK)
-                jax.device_get(gbm._train_scores.score)
-                mc_dt = min(mc_dt, time.time() - t0)
-            mc_mrt = MC_N * BLK * MC_CLS / mc_dt / 1e6
+            jax.device_get(gbm._train_scores.score)
+            mc_dt = time.time() - t0
+            mc_mrt = MC_N * BLK * MC_WIN * MC_CLS / mc_dt / 1e6
             extra["multiclass_M_row_trees_per_s"] = round(mc_mrt, 3)
+            extra["multiclass_window_iters"] = BLK * MC_WIN
             extra["multiclass_logloss"] = (round(mll, 5)
                                            if mll is not None else None)
             # reference C++ on THIS host, same data/config (recorded by
@@ -653,22 +829,25 @@ def main():
                                             config=cfg_rk, reference=dsr)
             gbr = create_boosting(cfg_rk, dsr)
             gbr.add_valid(dsrv, "test")
-            # same-scan-length warm-up, then three timed blocks
+            # same-scan-length warm-up, then ONE LONG window (see the
+            # multiclass block: the old best-of-3 short windows drifted 2x)
             BLKR = RK_IT // 4
-            gbr.train_iters(BLKR)
+            for _ in range(4):            # warm + reach RK_IT trees for the
+                gbr.train_iters(BLKR)     # quality read (ref parity)
             jax.device_get(gbr._train_scores.score)
-            rk_dt = 1e30   # best single block of three (tunnel drift)
-            for _ in range(3):
-                t0 = time.time()
-                gbr.train_iters(BLKR)
-                jax.device_get(gbr._train_scores.score)
-                rk_dt = min(rk_dt, time.time() - t0)
-            rk_mrt = RK_Q * RK_D * BLKR / rk_dt / 1e6
             ndcg = None
             for (_, name, value, _) in gbr.eval_valid():
                 if "ndcg" in name:
                     ndcg = float(value)
+            RK_WIN = 6
+            t0 = time.time()
+            for _ in range(RK_WIN):
+                gbr.train_iters(BLKR)
+            jax.device_get(gbr._train_scores.score)
+            rk_dt = time.time() - t0
+            rk_mrt = RK_Q * RK_D * BLKR * RK_WIN / rk_dt / 1e6
             extra["rank_M_row_trees_per_s"] = round(rk_mrt, 3)
+            extra["rank_window_iters"] = BLKR * RK_WIN
             extra["rank_ndcg10"] = round(ndcg, 5) if ndcg is not None else None
             if REF_RK_M_ROW_TREES_S:
                 extra["rank_ref_cpp_M_row_trees_per_s"] = REF_RK_M_ROW_TREES_S

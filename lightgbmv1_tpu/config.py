@@ -328,6 +328,14 @@ class Config:
     # the root pass — where per-bin sums are large and precision-critical
     # — always use hist_dtype.  The TPU analog of the reference's
     # fp32-hist-GPU-parity precedent (docs/GPU-Performance.rst:133-160).
+    # "int8sr" (OPT-IN until a device AUC-parity capture lands,
+    # tools/precision_expt.py): stochastic-rounded int8 histograms
+    # (ops/quantize.py) on the int8 MXU path — unbiased per-bin sums at
+    # 2x bf16 throughput, extended to BOTH the sustained bucket and the
+    # 16-slot ramp bucket of a K>16 wave; rounding is a deterministic
+    # counter-based PRNG keyed per (iteration, round), bit-reproducible
+    # given `seed`.  Plain "int8" (round-to-nearest) was measured and
+    # rejected at -0.007 AUC@500 (PERF.md round 5).
     hist_dtype_deep: str = ""
     # fused per-round bookkeeping in the wave grower: the frontier /
     # tree-assembly state lives in two packed tables written with ONE
@@ -458,6 +466,11 @@ class Config:
                 self.hist_method = "scatter"
             elif self.force_row_wise:
                 self.hist_method = "onehot"
+        if self.hist_dtype_deep not in (
+                "", "f32", "bf16", "bf16x2", "int8", "int8sr"):
+            raise ValueError(
+                f"hist_dtype_deep={self.hist_dtype_deep!r}: expected one of "
+                "f32 | bf16 | bf16x2 | int8 | int8sr (or empty for auto)")
         if self.gpu_use_dp and not self.hist_dtype_deep:
             # the double-precision request covers deep wave rounds too —
             # but an EXPLICIT hist_dtype_deep wins (the trainer documents

@@ -46,6 +46,17 @@ collective per ROUND instead of per split: the data-parallel learner wraps
 ReduceScatter of histograms, data_parallel_tree_learner.cpp:155-173), the
 feature-/voting-parallel learners substitute ``split_fn``.
 
+Quantized rounds (round 7): with ``hist_dtype_deep="int8sr"`` the
+sustained bucket and the 16-slot ramp bucket of a K>16 wave run a
+stochastic-rounded int8 histogram pass (ops/quantize.py + the int8 MXU
+path of ops/hist_pallas.py); the pass returns INTEGER histograms plus
+per-slot scales, and dequantization is folded into the smaller-child
+subtraction (``subtract_child_hists(slot_scale=...)``) or handed to the
+split scan (``find_best_split(hist_scale=...)``) — the histogram never
+takes a separate dequantize round-trip.  Rounding is keyed per
+(iteration, round) by folding the tree key with the round's leaf count,
+so grown trees are bit-reproducible given the seed.
+
 Round bookkeeping (round 6): the per-leaf frontier state and the tree
 arrays under construction live behind a store codec.  The default
 ``_PackedStore`` keeps them in two packed f32 tables committed with one
@@ -234,7 +245,8 @@ class WaveState(NamedTuple):
     done: jax.Array           # () bool
 
 
-def subtract_child_hists(h_slot, leaf_hist, leafs, order_c, sm_left):
+def subtract_child_hists(h_slot, leaf_hist, leafs, order_c, sm_left,
+                         slot_scale=None):
     """Smaller-child + parent-subtraction child histograms of one wave
     round (reference BeforeFindBestSplit smaller-leaf trick +
     FeatureHistogram::Subtract): ``h_slot`` holds the measured smaller
@@ -242,8 +254,17 @@ def subtract_child_hists(h_slot, leaf_hist, leafs, order_c, sm_left):
     histogram minus the smaller.  Returns the rank-order interleaved
     ``(2K, F, B, 3)`` child stack plus the separate left/right halves.
     Module-level so tools/phase_attrib.py can time exactly the ops the
-    grower's round body runs."""
+    grower's round body runs.
+
+    ``slot_scale`` (K, 3): when the round's histogram pass ran quantized
+    (stochastic-rounded int8, ops/quantize.py), ``h_slot`` carries exact
+    integer counts and the per-slot dequantization is folded HERE — one
+    broadcast multiply fused into the gather/subtract pipeline the round
+    already pays, so the kernel never writes a dequantized copy and the
+    quantized histogram is read from HBM exactly once."""
     h_small = h_slot[order_c]              # slot-order -> rank-order
+    if slot_scale is not None:
+        h_small = h_small * slot_scale[order_c][:, None, None, :]
     h_parent = leaf_hist[leafs]
     smL = sm_left[:, None, None, None]
     h_left = jnp.where(smL, h_small, h_parent - h_small)
@@ -607,6 +628,7 @@ def make_wave_grower(
     wave_size: int = 32,
     fused_bookkeeping: bool = True,
     hist_wave_fn: Callable = None,
+    hist_wave_quant_fn: Callable = None,
     split_fn: Callable = None,
     sums_fn: Callable = None,
     bins_of_fn: Callable = None,
@@ -619,6 +641,19 @@ def make_wave_grower(
     ``deep=True`` marks a sustained (largest-bucket) round of a big wave —
     the implementation may drop to the configured cheaper histogram dtype
     there (config.hist_dtype_deep).
+    ``hist_wave_quant_fn(binned, g3, label, nslots, key) ->
+    ((nslots, F, B, 3), (nslots, 3))`` — optional stochastic-rounded
+    quantized pass (hist_dtype_deep="int8sr"): integer histogram plus
+    per-slot dequant scales (all-ones when the implementation already
+    dequantized, e.g. the data-parallel dequantize-then-psum wrapper).
+    Eligible rounds — the sustained largest bucket (the existing deep
+    gate) AND the 16-slot ramp bucket of a K>16 wave (VERDICT r5 priced
+    ramp rounds at 11.7 ms vs 7.7 deep: the 16-slot bucket is the next
+    harvest) — route here with a per-round fold-in of the tree key, so
+    the rounding stream is deterministic per (iteration, round).  The
+    root pass and the small (<=4 slot) ramp buckets NEVER quantize:
+    their per-bin sums are large and precision-critical, and their cost
+    is dispatch-dominated anyway.
     ``split_fn(hist, parent, mask, key, uid, constraint, depth,
     parent_output) -> SplitResult`` — vmapped over the 2K children.
     ``sums_fn(g3) -> (3,)`` — root totals (psum over the row axis when
@@ -649,14 +684,20 @@ def make_wave_grower(
     store = (_PackedStore if fused_bookkeeping else _FieldStore)(
         L, L1, W, use_mc, use_cat)
 
+    # the default split accepts a per-child hist_scale (dequantize-aware
+    # scan, ops/split.py); custom split_fns (EFB bundle decode, feature-/
+    # voting-parallel collectives) keep their narrower signature and get
+    # pre-dequantized histograms instead
+    default_split = split_fn is None
     if split_fn is None:
         def split_fn(hist, parent, mask, key, uid, constraint, depth,
-                     parent_output):
+                     parent_output, hist_scale=None):
             rk = jax.random.fold_in(key, uid + 1_000_003 + params.extra_seed) \
                 if params.extra_trees else None
             return find_best_split(hist, parent, meta, mask, params,
                                    constraint, depth, monotone_penalty,
-                                   parent_output, rk, None)
+                                   parent_output, rk, None,
+                                   hist_scale=hist_scale)
 
     if sums_fn is None:
         def sums_fn(g3):
@@ -693,6 +734,15 @@ def make_wave_grower(
         # is the slot-count-independent in-VMEM one-hot build).  Selection
         # is by the replicated n_split, so row shards stay in lockstep.
         slot_buckets = slot_buckets_for(K, N)
+        # Quantized-pass eligibility (hist_dtype_deep="int8sr"): the
+        # sustained largest bucket (the depth-adaptive deep gate) and the
+        # 16-slot ramp bucket of a K>16 wave.  Root (the nslots=1 call
+        # below) and the <=4-slot ramp buckets never quantize.
+        quant_buckets = ()
+        if hist_wave_quant_fn is not None and len(slot_buckets) > 1:
+            quant_buckets = tuple(
+                S for S in slot_buckets
+                if (S == K and K >= 32) or (S == 16 and S < K))
 
         leaf_id0 = jnp.zeros(N, jnp.int32)
         hist0 = hist_wave_fn(binned, g3, leaf_id0, 1, deep=False)[0]
@@ -794,6 +844,14 @@ def make_wave_grower(
             lsums, rsums = rd["lsums"], rd["rsums"]           # (K, 3)
             sm_left = lsums[:, 2] <= rsums[:, 2]              # (K,) smaller
             order_c = jnp.clip(order, 0, K - 1)
+            # per-round rounding key for the quantized pass: the per-tree
+            # key (unique per iteration x class) folded with the round's
+            # leaf count, which strictly increases every round — the
+            # (iteration, round) legs of the counter-based PRNG contract
+            # (ops/quantize.py); the row block is the third leg, drawn
+            # inside sr_quantize_g3
+            rkey = (jax.random.fold_in(key, 8_000_011 + st.num_leaves)
+                    if quant_buckets else None)
 
             # ---- decision + labeling + histogram, sliced to S slots -------
             # One vectorized (S, N) decision pass (the analog of K
@@ -874,17 +932,26 @@ def make_wave_grower(
                 # bucketing off (small N) there ARE no separate ramp
                 # variants — everything stays full precision
                 deep = S == K and K >= 32 and len(slot_buckets) > 1
-                if use_sub:
-                    h = hist_wave_fn(binned, g3, label, S,    # (S, F, B, 3)
-                                     deep=deep)
+                nsl = S if use_sub else 2 * S
+                if S in quant_buckets:
+                    # stochastic-rounded int8 pass: integer histogram +
+                    # per-slot dequant scales, rounding stream keyed per
+                    # (tree, round)
+                    h, hsc = hist_wave_quant_fn(binned, g3, label, nsl,
+                                                rkey)
                 else:
-                    h = hist_wave_fn(binned, g3, label, 2 * S, deep=deep)
+                    h = hist_wave_fn(binned, g3, label, nsl, deep=deep)
+                    hsc = jnp.ones((nsl, 3), jnp.float32)
                 full = 2 * K if not use_sub else K
                 if h.shape[0] < full:   # pad to the bucket-invariant width
                     h = jnp.concatenate(
                         [h, jnp.zeros((full - h.shape[0],) + h.shape[1:],
                                       h.dtype)], axis=0)
-                return (h, leaf_id) + tuple(vl_new)
+                    # padded slots dequantize as identity
+                    hsc = jnp.concatenate(
+                        [hsc, jnp.ones((full - hsc.shape[0], 3), hsc.dtype)],
+                        axis=0)
+                return (h, hsc, leaf_id) + tuple(vl_new)
 
             if len(slot_buckets) > 1:
                 s_idx = jnp.zeros((), jnp.int32)
@@ -894,17 +961,31 @@ def make_wave_grower(
                     s_idx, [lambda S=S: round_pass(S) for S in slot_buckets])
             else:
                 outs = round_pass(slot_buckets[0])
-            h_slot, leaf_id = outs[0], outs[1]
-            new_vlids = tuple(outs[2:])
+            h_slot, hscale, leaf_id = outs[0], outs[1], outs[2]
+            new_vlids = tuple(outs[3:])
 
+            cscale = None                   # per-child dequant (quant rounds)
             if use_sub:
                 # ---- smaller-child histograms + subtraction --------------
+                # quant rounds fold the per-slot dequantization into the
+                # subtraction pass (slot_scale); non-quant rounds carry
+                # all-ones scales and skip the multiply entirely
                 hist, h_left, h_right = subtract_child_hists(
-                    h_slot, st.leaf_hist, leafs, order_c, sm_left)
+                    h_slot, st.leaf_hist, leafs, order_c, sm_left,
+                    slot_scale=hscale if quant_buckets else None)
             else:
                 ch_idx = jnp.stack([2 * order_c, 2 * order_c + 1],
                                    axis=1).reshape(2 * K)
                 hist = h_slot[ch_idx]              # slot-order -> rank-order
+                if quant_buckets:
+                    # children come straight from the (possibly quantized)
+                    # pass: hand the split scan the integer histograms +
+                    # per-child scales (dequantize-aware scan) when the
+                    # default split runs, else dequantize here
+                    cscale = hscale[ch_idx]                       # (2K, 3)
+                    if not default_split:
+                        hist = hist * cscale[:, None, None, :]
+                        cscale = None
 
             # ---- children metadata --------------------------------------
             cleafs = jnp.stack([leafs, nls], axis=1).reshape(2 * K)
@@ -1005,10 +1086,18 @@ def make_wave_grower(
                 cut_lo = jnp.where(iscats, pbox[kio, feats, 0], thrs + 1)
                 box_r = pbox.at[kio, feats, 0].set(cut_lo)
             # ---- batched split finding over the 2K children ---------------
-            res = jax.vmap(
-                lambda h, p, m, u, c, dd, po: split_fn(h, p, m, key, u, c,
-                                                       dd, po)
-            )(hist, csums, cmask, cuids, cconstr, cdepth, couts)
+            if cscale is not None:
+                # dequantize-aware scan: integer histograms + per-child
+                # scales go straight into the gain cumsum (ops/split.py)
+                res = jax.vmap(
+                    lambda h, hs, p, m, u, c, dd, po: split_fn(
+                        h, p, m, key, u, c, dd, po, hist_scale=hs)
+                )(hist, cscale, csums, cmask, cuids, cconstr, cdepth, couts)
+            else:
+                res = jax.vmap(
+                    lambda h, p, m, u, c, dd, po: split_fn(h, p, m, key, u,
+                                                           c, dd, po)
+                )(hist, csums, cmask, cuids, cconstr, cdepth, couts)
             cgain = jnp.where(depth_ok, res.gain, -jnp.inf)
             cvalid = jnp.stack([valid, valid], axis=1).reshape(2 * K)
             cidx = jnp.where(cvalid, cleafs, L + 1)           # drop slot

@@ -285,6 +285,57 @@ def stack_trees(trees: List[TreeArrays]) -> TreeArrays:
     return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *trees)
 
 
+def host_trees_to_stacked(trees, num_leaves: int = 0) -> TreeArrays:
+    """Pad HostTrees (ragged per-tree arrays, REAL thresholds filled) back
+    to a fixed-size stacked ``TreeArrays`` for the device batch walk
+    (``ensemble_predict_raw``).
+
+    The training-time ``_device_trees`` carry bin-space thresholds only
+    (``threshold`` is zeros until ``_fill_real_thresholds`` runs on the
+    host copy), so deployment prediction on RAW features must route
+    through the host trees — this is the bridge back to the device."""
+    L = num_leaves or max(max(t.num_leaves, 2) for t in trees)
+    L1 = max(L - 1, 1)
+    W = max((t.cat_bitset.shape[1] if t.cat_bitset.ndim == 2
+             and t.cat_bitset.shape[0] else 1) for t in trees)
+
+    def pad(a, n, fill, dtype):
+        out = np.full(n, fill, dtype)
+        out[: len(a)] = a
+        return out
+
+    def pad2(a, n, w):
+        out = np.zeros((n, w), np.uint32)
+        if a.ndim == 2 and a.shape[0]:
+            out[: a.shape[0], : a.shape[1]] = a
+        return out
+
+    arrs = []
+    for t in trees:
+        arrs.append(TreeArrays(
+            num_leaves=np.int32(t.num_leaves),
+            split_feature=pad(t.split_feature, L1, 0, np.int32),
+            threshold_bin=pad(t.threshold_bin, L1, 0, np.int32),
+            threshold=pad(t.threshold, L1, 0.0, np.float32),
+            default_left=pad(t.default_left, L1, False, bool),
+            missing_type=pad(t.missing_type, L1, 0, np.int32),
+            left_child=pad(t.left_child, L1, -1, np.int32),
+            right_child=pad(t.right_child, L1, -2, np.int32),
+            split_gain=pad(t.split_gain, L1, 0.0, np.float32),
+            internal_value=pad(t.internal_value, L1, 0.0, np.float32),
+            internal_weight=pad(t.internal_weight, L1, 0.0, np.float32),
+            internal_count=pad(t.internal_count, L1, 0, np.float32),
+            leaf_value=pad(t.leaf_value, L, 0.0, np.float32),
+            leaf_weight=pad(t.leaf_weight, L, 0.0, np.float32),
+            leaf_count=pad(t.leaf_count, L, 0, np.float32),
+            leaf_parent=pad(t.leaf_parent, L, -1, np.int32),
+            is_cat=pad(t.is_cat, L1, False, bool),
+            cat_bitset=pad2(t.cat_bitset, L1, W),
+        ))
+    return stack_trees([jax.tree_util.tree_map(jnp.asarray, a)
+                        for a in arrs])
+
+
 def ensemble_predict_raw(stacked: TreeArrays, X: jax.Array) -> jax.Array:
     """Sum of all stacked trees' raw predictions for each row."""
 

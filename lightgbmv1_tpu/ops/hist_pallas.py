@@ -23,6 +23,18 @@ TPUs have no atomics; the design maps the OpenCL structure onto the MXU:
     - ``int8``  — per-tile-quantized gradients on the int8 MXU path (2×
       bf16 throughput; counts are exact via a power-of-two scale). The
       TPU analog of LightGBM's quantized-histogram training.
+    - ``int8sr``— PRE-quantized gradients (ops/quantize.sr_quantize_g3:
+      stochastic rounding, deterministic counter-based PRNG) on the same
+      int8 MXU path with hierarchical widening: int8 multiplicands →
+      int32 MXU accumulators → exact integer f32 across row tiles.  The
+      kernel does NO scale math at all — neither the per-tile amax
+      reduction of ``int8`` nor the per-chunk dequant multiply — and
+      emits the RAW integer histogram; the caller holds the scales and
+      dequantization is folded into the consumer (the split scan /
+      smaller-child subtraction), so the histogram write stream carries
+      no extra pass.  Integer accumulation in f32 is exact to 2^24
+      (±127 per row ⇒ exact beyond 130k rows per (leaf, bin) cell —
+      far past any real bin occupancy at bench shapes).
     - ``bf16``  — single bf16 pass (the GPU learner's single-precision
       default, gpu_tree_learner.h:79).
     - ``bf16x2``— hi/lo-split bf16, ~fp32 accuracy at 2 MXU passes.
@@ -105,7 +117,13 @@ def _kernel(iota_ref, bins_ref, g3_ref, leaf_ref, out_ref, *, lpad, num_bins,
 
     # VPU constraints on this target: vector compare/select only in i32/f32;
     # narrow dtypes appear only via a final astype feeding the MXU.
-    if precision == "int8":
+    if precision == "int8sr":
+        # rows arrive PRE-quantized to exact integers in [-127, 127]
+        # (ops/quantize.sr_quantize_g3); the leaf mask runs in f32 and the
+        # int8 cast is the final op feeding the MXU — no scale math here
+        lg_parts = [jnp.where(loh, rep(g3, lpad, 0), 0.0).astype(jnp.int8)]
+        scale_rep = None
+    elif precision == "int8":
         amax = jnp.max(jnp.abs(g3[:2]), axis=1, keepdims=True)       # (2, 1)
         inv = jnp.where(amax > 0, 127.0 / amax, 0.0)
         scale = jnp.where(amax > 0, amax / 127.0, 0.0)
@@ -148,11 +166,14 @@ def _kernel(iota_ref, bins_ref, g3_ref, leaf_ref, out_ref, *, lpad, num_bins,
         # convert, not a select pass — the one-hot build is the
         # slot-count-independent floor of the whole pass, so every VPU op
         # here is measurable in the roofline fraction
-        if precision == "int8":
+        if precision in ("int8", "int8sr"):
             oh = oh_cmp.astype(jnp.int8)
             acc = lax.dot_general(lg_parts[0], oh, (((1,), (0,)), ((), ())),
                                   preferred_element_type=jnp.int32)
-            out_ref[0, :, sl] += acc.astype(jnp.float32) * scale_rep
+            upd = acc.astype(jnp.float32)
+            if scale_rep is not None:       # int8sr stays in integer units
+                upd = upd * scale_rep
+            out_ref[0, :, sl] += upd
         elif precision in ("bf16", "bf16x2"):
             oh = oh_cmp.astype(jnp.bfloat16)
             if len(lg_parts) > 1:

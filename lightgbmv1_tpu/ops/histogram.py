@@ -234,6 +234,44 @@ def hist_wave(
                          packed=packed, num_features=num_features)[:nslots]
 
 
+def hist_wave_quant(
+    binned: jax.Array,
+    g3: jax.Array,
+    label: jax.Array,
+    nslots: int,
+    num_bins: int,
+    key: jax.Array,
+    method: str = "scatter",
+    packed: bool = False,
+    num_features: int = 0,
+):
+    """Stochastic-rounded int8 wave histogram: quantize the gradient rows
+    (ops/quantize.sr_quantize_g3 — deterministic counter-based rounding
+    keyed by ``key``) and accumulate the INTEGER histogram.
+
+    Returns ``(hist_q, scales)``: ``hist_q`` (nslots, F, B, 3) holds exact
+    integer sums of the quantized rows, ``scales`` (nslots, 3) the per-slot
+    dequantization multipliers.  The caller keeps the histogram in integer
+    units as long as possible — the wave grower folds dequantization into
+    the smaller-child subtraction, and ops/split.py's gain scan accepts
+    ``hist_scale`` to dequantize after its (exact, integer) cumsum.
+
+    On the ``pallas`` method this runs the int8 MXU path (one pass, 2x
+    bf16 throughput, int8→int32 hierarchical widening); ``scatter`` and
+    ``onehot`` accumulate the same integer rows exactly in f32, so every
+    method produces the identical integer histogram (the property the
+    oracle test pins, tests/test_int8sr.py)."""
+    from .quantize import sr_quantize_g3
+
+    with jax.named_scope("lgbm.hist_q"):
+        q3, scales = sr_quantize_g3(g3, label, nslots, key)
+        prec = "int8sr" if method == "pallas" else "f32"
+        h = hist_wave(binned, q3, label, nslots, num_bins, method=method,
+                      precision=prec, packed=packed,
+                      num_features=num_features)
+        return h, scales
+
+
 def default_hist_method(config_method: str = "auto",
                         bin_dtype=None) -> str:
     """Pick the histogram implementation.

@@ -1,0 +1,98 @@
+"""Stochastic-rounded gradient quantization for the histogram pass.
+
+The round-5 precision experiment (tools/precision_expt.py, PERF.md) showed
+plain int8 histograms recover the int8 MXU's 2x-bf16 throughput but lose
+0.007 AUC at 500 iterations: round-to-nearest quantization of gradients is
+BIASED per bin, and the bias compounds over the boosting recursion.  The
+fix with real-world lineage is *stochastic rounding* — LightGBM's own
+quantized-training work ("Quantized Training of Gradient Boosting Decision
+Trees", Shi et al., NeurIPS 2022) rounds gradients up or down with
+probability proportional to the fractional part, which makes every
+quantized per-bin SUM an unbiased estimator of the fp32 sum:
+
+    E[floor(x + U)] = x   for U ~ Uniform[0, 1)
+
+so the split finder sees zero-mean noise instead of systematic drift.
+
+Determinism contract: the rounding stream is a **counter-based PRNG**
+(``jax.random`` threefry) keyed by fold-ins of (iteration, round) — the
+grower folds its per-tree key (already unique per (iteration, class)) with
+the round's leaf count, and this module draws the whole row block from
+that key in one counter-indexed sweep.  Results are bit-reproducible given
+the seed on every backend, and the NumPy reference in
+tests/test_int8sr.py reproduces the quantization bit-for-bit from the
+same uniforms.
+
+Scale placement: the interface carries **per-slot scales** ``(nslots, 3)``
+so a per-leaf refinement can drop in, but the implementation uses one
+per-pass scale (the global |grad| / |hess| max over the pass's rows):
+a per-slot segment-max is a scatter, and scatters measured ~8 ms at bench
+shapes on this device (tools/microbench_gather.py) — more than the whole
+deep histogram pass the quantization is trying to speed up.
+
+Counts stay EXACT: the count/weight channel is quantized with a
+power-of-two scale (deterministic round-to-nearest, exact for unit
+weights), preserving the repo-wide "counts are exact" guarantee that
+min_data_in_leaf gating relies on (ops/histogram.py module docstring).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+INT8_QMAX = 127.0
+
+
+@functools.partial(jax.jit, static_argnames=("nslots",))
+def sr_quantize_g3(g3: jax.Array, label: jax.Array, nslots: int,
+                   key: jax.Array):
+    """Quantize ``g3`` (N, 3) [grad, hess, count] to int8-ranged integers
+    with stochastic rounding on the grad/hess channels.
+
+    Returns ``(q3, scales)``:
+
+    * ``q3`` (N, 3) float32 holding exact integers in [-127, 127] — kept
+      in f32 because the TPU VPU has no int8 vector select (the kernel's
+      leaf-mask ``where`` runs in f32 and the int8 cast is the final op
+      feeding the MXU, ops/hist_pallas.py).
+    * ``scales`` (nslots, 3) float32 — dequantization multipliers per
+      slot: real histogram = integer histogram * scales.  Currently every
+      slot carries the same per-pass scale (see module docstring).
+
+    ``label`` is accepted (and unused by the global-scale implementation)
+    so a per-slot scale can be introduced without touching call sites.
+    """
+    del label  # per-pass scales; see module docstring
+    g = g3[:, :2].astype(jnp.float32)
+    amax = jnp.max(jnp.abs(g), axis=0)                       # (2,)
+    inv = jnp.where(amax > 0, INT8_QMAX / amax, 0.0)
+    scale = jnp.where(amax > 0, amax / INT8_QMAX, 0.0)
+    u = jax.random.uniform(key, g.shape, dtype=jnp.float32)  # [0, 1)
+    q = jnp.clip(jnp.floor(g * inv[None, :] + u), -INT8_QMAX, INT8_QMAX)
+
+    # count channel: power-of-two scale, deterministic rounding => exact
+    # integer counts for unit weights (inv_c = 64, the historical
+    # _COUNT_SCALE) and safe for weighted rows
+    c = g3[:, 2].astype(jnp.float32)
+    cmax = jnp.max(jnp.abs(c))
+    inv_c = jnp.where(
+        cmax > 0,
+        jnp.minimum(jnp.exp2(jnp.floor(jnp.log2(INT8_QMAX / cmax))), 64.0),
+        1.0)
+    qc = jnp.round(c * inv_c)
+
+    q3 = jnp.concatenate([q, qc[:, None]], axis=1)
+    scales = jnp.concatenate(
+        [jnp.broadcast_to(scale[None, :], (nslots, 2)),
+         jnp.full((nslots, 1), 1.0, jnp.float32) / inv_c], axis=1)
+    return q3, scales
+
+
+def dequantize_hist(hist_q: jax.Array, scales: jax.Array) -> jax.Array:
+    """(S, F, B, 3) integer histogram * (S, 3) per-slot scales -> real
+    units.  One fused broadcast multiply — the explicit form of the
+    dequantization the split scan / subtraction pass otherwise folds in."""
+    return hist_q * scales[:, None, None, :]

@@ -386,16 +386,20 @@ def find_best_split(
     parent_output=0.0,        # this leaf's current output (path smoothing)
     rand_key: Optional[jax.Array] = None,    # extra_trees threshold sampling
     cegb_penalty: Optional[jax.Array] = None,  # (F,) CEGB gain penalty
+    hist_scale: Optional[jax.Array] = None,  # (3,) dequant multipliers when
+                              # ``hist`` carries QUANTIZED integer counts
 ) -> SplitResult:
     with jax.named_scope("lgbm.split"):
         return _find_best_split(hist, parent_sum, meta, feature_mask, params,
                                 constraint, depth, monotone_penalty,
-                                parent_output, rand_key, cegb_penalty)
+                                parent_output, rand_key, cegb_penalty,
+                                hist_scale)
 
 
 def _find_best_split(
     hist, parent_sum, meta, feature_mask, params, constraint=None, depth=0,
     monotone_penalty=0.0, parent_output=0.0, rand_key=None, cegb_penalty=None,
+    hist_scale=None,
 ) -> SplitResult:
     F, B, _ = hist.shape
     total_g, total_h, total_c = parent_sum[0], parent_sum[1], parent_sum[2]
@@ -405,7 +409,18 @@ def _find_best_split(
     if constraint is None:
         constraint = jnp.asarray(NO_CONSTRAINT, jnp.float32)
 
+    # Dequantize-aware scan (stochastic-rounded int8 histograms,
+    # ops/quantize.py): ``hist`` holds exact integer counts and
+    # ``hist_scale`` the per-channel dequant multipliers.  The cumsum runs
+    # in the INTEGER domain — exact, no f32 summation-order noise — and
+    # ONE broadcast multiply dequantizes the prefix sums; the same scale
+    # lands on the nan/zero missing-mass rows below.  The histogram is
+    # consumed straight from HBM in quantized form: no separate
+    # dequantization pass ever writes a real-valued copy back.
     cum = jnp.cumsum(hist, axis=1)                    # (F, B, 3) inclusive
+    if hist_scale is not None:
+        cum = cum * hist_scale[None, None, :]
+        hist = hist * hist_scale[None, None, :]       # point reads below
     t_idx = lax.broadcasted_iota(jnp.int32, (F, B), 1)
     nb = meta.num_bins[:, None]                       # (F, 1)
 

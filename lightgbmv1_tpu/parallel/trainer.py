@@ -47,14 +47,30 @@ from ..config import Config
 from ..models.grower import make_leafwise_grower
 from ..models.grower_wave import make_wave_grower
 from ..models.tree import TreeArrays
-from ..ops.histogram import default_hist_method, hist_one_leaf, hist_wave
+from ..ops.histogram import (default_hist_method, hist_one_leaf, hist_wave,
+                             hist_wave_quant)
 from ..ops.split import FeatureMeta, SplitParams, SplitResult, find_best_split
 from ..utils.log import log_fatal, log_info, log_warning
 
 try:  # jax >= 0.6 exposes shard_map at top level
-    shard_map = jax.shard_map
+    _shard_map = jax.shard_map
 except AttributeError:  # pragma: no cover - older jax
-    from jax.experimental.shard_map import shard_map  # type: ignore
+    from jax.experimental.shard_map import shard_map as _shard_map  # type: ignore
+
+
+def shard_map(*args, **kwargs):
+    """shard_map across jax versions: new jax spells the replication check
+    ``check_vma``, jax <= 0.4.x spells it ``check_rep`` — map the call
+    rather than pinning a version (the container and the device driver
+    run different jax releases)."""
+    try:
+        return _shard_map(*args, **kwargs)
+    except TypeError:
+        if "check_vma" not in kwargs:
+            raise
+        kwargs = dict(kwargs)
+        kwargs["check_rep"] = kwargs.pop("check_vma")
+        return _shard_map(*args, **kwargs)
 
 
 def _make_mesh(num_shards: int, axis: str) -> Mesh:
@@ -290,12 +306,32 @@ def build_trainer(
     # overrides (set hist_dtype_deep=bf16x2 to force full precision).
     deep_precision = config.hist_dtype_deep or (
         "bf16" if precision == "bf16x2" else precision)
+    # hist_dtype_deep="int8sr": stochastic-rounded int8 histograms
+    # (ops/quantize.py) — eligible wave rounds route to a separate
+    # quantized pass (hist_wave_quant_fn below) instead of the plain deep
+    # dtype; any residual deep=True call keeps full precision.  The mode
+    # is structurally incompatible with gpu_use_dp (an explicit request
+    # for the HIGHEST histogram precision): dp wins, with a warning.
+    use_int8sr = deep_precision == "int8sr"
+    if use_int8sr and config.gpu_use_dp:
+        log_warning("hist_dtype_deep=int8sr conflicts with gpu_use_dp "
+                    "(double-precision histograms requested); int8sr "
+                    "disabled, deep rounds run f32")
+        use_int8sr = False
+        deep_precision = "f32"
+    elif use_int8sr:
+        deep_precision = precision
 
     def local_wave(binned, g3, label, nslots, deep=False):
         return hist_wave(binned, g3, label, nslots, Bh,
                          method=method,
                          precision=deep_precision if deep else precision,
                          packed=packed, num_features=F)
+
+    def local_wave_quant(binned, g3, label, nslots, key):
+        return hist_wave_quant(binned, g3, label, nslots, Bh, key,
+                               method=method, packed=packed,
+                               num_features=F)
 
     # EFB: split search + decisions speak ORIGINAL features; only the
     # histogram pass runs over bundle columns
@@ -416,6 +452,9 @@ def build_trainer(
             # wave-batched best-first: the leaf-wise default schedule
             # (models/grower_wave.py)
             grow = make_wave_grower(hist_wave_fn=local_wave,
+                                    hist_wave_quant_fn=(
+                                        local_wave_quant if use_int8sr
+                                        else None),
                                     split_fn=split_local,
                                     bins_of_fn=bins_feat_fn, **wave_common)
         else:
@@ -513,6 +552,9 @@ def build_trainer(
             # the selective histogram reduce across all 2K children of a
             # round — same PV-Tree semantics, one collective round-trip
             grow = make_wave_grower(hist_wave_fn=local_wave,
+                                    hist_wave_quant_fn=(
+                                        local_wave_quant if use_int8sr
+                                        else None),
                                     split_fn=split_fn, sums_fn=sums_fn,
                                     bins_of_fn=bins_feat_fn, **wave_common)
         else:
@@ -597,7 +639,20 @@ def build_trainer(
                 return lax.psum(
                     local_wave(binned, g3, label, nslots, deep), "data")
 
+            def wave_quant_fn(binned, g3, label, nslots, key):
+                # each shard quantizes with its LOCAL per-pass scales
+                # (unbiasedness is per-row, so the psum of dequantized
+                # shard histograms stays an unbiased estimator); the
+                # psum therefore runs on dequantized values and the
+                # grower sees identity scales
+                h, sc = local_wave_quant(binned, g3, label, nslots, key)
+                h = lax.psum(h * sc[:, None, None, :], "data")
+                return h, jnp.ones_like(sc)
+
             grow = make_wave_grower(hist_wave_fn=wave_fn, sums_fn=sums_fn,
+                                    hist_wave_quant_fn=(
+                                        wave_quant_fn if use_int8sr
+                                        else None),
                                     split_fn=split_local,
                                     bins_of_fn=bins_feat_fn, **wave_common)
         else:
@@ -676,6 +731,18 @@ def build_trainer(
             full = jnp.zeros((nslots, F_pad, B, 3), jnp.float32)
             return lax.dynamic_update_slice(full, h, (0, lo, 0, 0))
 
+        def hist_wave_quant_fp(binned, g3, label, nslots, key):
+            # g3/label/key are replicated, so every shard derives the SAME
+            # per-pass scales — the feature-block histograms compose into
+            # one consistently-quantized full-width array (zeros outside
+            # the shard dequantize to zero)
+            lo = lax.axis_index("feature") * F_loc
+            block = lax.dynamic_slice(binned, (lo, 0), (F_loc, N))
+            h, sc = hist_wave_quant(block, g3, label, nslots, B, key,
+                                    method=method)
+            full = jnp.zeros((nslots, F_pad, B, 3), jnp.float32)
+            return lax.dynamic_update_slice(full, h, (0, lo, 0, 0)), sc
+
         def split_fn(hist, parent, mask, key, uid, constraint, depth,
                      parent_output, cegb_pen=None):
             # search only this device's features, then Allreduce-max over
@@ -729,7 +796,10 @@ def build_trainer(
                 cegb_coupled=coupled_fp, **fp_kwargs)
         elif use_wave:
             grow = make_wave_grower(
-                hist_wave_fn=hist_wave_fp, split_fn=split_fn,
+                hist_wave_fn=hist_wave_fp,
+                hist_wave_quant_fn=(hist_wave_quant_fp if use_int8sr
+                                    else None),
+                split_fn=split_fn,
                 wave_size=wave_size, **fp_kwargs)
         else:
             grow = make_leafwise_grower(

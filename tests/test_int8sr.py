@@ -1,0 +1,338 @@
+"""Stochastic-rounded int8 histogram pipeline (hist_dtype_deep="int8sr").
+
+Three test families, matching the mode's three contracts:
+
+* ORACLE — the quantization and the quantized kernel are pinned
+  bit-for-bit against a NumPy stochastic-rounding reference fed the SAME
+  counter-based uniforms (jax.random is deterministic per backend given
+  the key, so the reference reproduces the device arithmetic exactly).
+* UNBIASEDNESS — the statistical property that makes SR different from
+  the rejected round-to-nearest int8 mode: the mean of SR-quantized sums
+  over rounding seeds converges to the fp32 sum.
+* GATE — int8sr runs only where the grower's eligibility says (the
+  sustained bucket and the 16-slot ramp bucket), never on the root or
+  <=4-slot ramp passes, and never when gpu_use_dp is set.
+"""
+
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from lightgbmv1_tpu.ops.histogram import (
+    hist_leaves_scatter,
+    hist_wave,
+    hist_wave_quant,
+)
+from lightgbmv1_tpu.ops.quantize import INT8_QMAX, dequantize_hist, sr_quantize_g3
+
+_PALLAS_INTERPRET = jax.default_backend() != "tpu"
+
+
+def make_inputs(rng, N=2000, F=5, B=16, S=4):
+    binned = jnp.asarray(rng.randint(0, B, size=(F, N)).astype(np.uint8))
+    g3 = jnp.asarray(rng.randn(N, 3).astype(np.float32))
+    g3 = g3.at[:, 2].set(1.0)
+    label = jnp.asarray(rng.randint(0, S + 1, size=N).astype(np.int32))
+    return binned, g3, label
+
+
+def numpy_sr_quantize(g3, key, nslots):
+    """NumPy mirror of sr_quantize_g3: same uniforms, f32 arithmetic."""
+    g3 = np.asarray(g3, np.float32)
+    u = np.asarray(jax.random.uniform(key, (g3.shape[0], 2),
+                                      dtype=jnp.float32))
+    g = g3[:, :2]
+    amax = np.abs(g).max(axis=0)
+    inv = np.where(amax > 0, np.float32(INT8_QMAX) / amax, 0.0).astype(np.float32)
+    scale = np.where(amax > 0, amax / np.float32(INT8_QMAX), 0.0).astype(np.float32)
+    q = np.clip(np.floor(g * inv[None, :] + u), -INT8_QMAX, INT8_QMAX)
+    c = g3[:, 2]
+    cmax = np.abs(c).max()
+    inv_c = (min(2.0 ** np.floor(np.log2(np.float32(INT8_QMAX) / cmax)), 64.0)
+             if cmax > 0 else 1.0)
+    qc = np.round(c * np.float32(inv_c))
+    q3 = np.concatenate([q, qc[:, None]], axis=1).astype(np.float32)
+    scales = np.concatenate(
+        [np.broadcast_to(scale[None, :], (nslots, 2)),
+         np.full((nslots, 1), 1.0 / inv_c, np.float32)], axis=1)
+    return q3, scales
+
+
+# ---------------------------------------------------------------------------
+# Oracle: bit-reproducible quantization + kernel accumulation
+# ---------------------------------------------------------------------------
+
+
+def test_sr_quantize_matches_numpy_reference(rng):
+    _, g3, label = make_inputs(rng)
+    key = jax.random.PRNGKey(123)
+    q3, sc = sr_quantize_g3(g3, label, 4, key)
+    q3_ref, sc_ref = numpy_sr_quantize(g3, key, 4)
+    np.testing.assert_array_equal(np.asarray(q3), q3_ref)
+    np.testing.assert_array_equal(np.asarray(sc), sc_ref)
+    # quantized values are exact int8-ranged integers
+    q = np.asarray(q3)
+    np.testing.assert_array_equal(q, np.round(q))
+    assert np.abs(q).max() <= INT8_QMAX
+
+
+def test_int8sr_kernel_matches_numpy_oracle(rng):
+    """The full quantized pipeline (quantize -> pallas int8 MXU kernel) is
+    pinned bit-exactly against NumPy accumulation of the SR-quantized rows
+    at a fixed seed — the CompareHistograms analog for the int8sr path."""
+    from lightgbmv1_tpu.ops.hist_pallas import hist_leaves_pallas
+
+    N, F, B, S = 1777, 6, 32, 5   # non-divisible N exercises row padding
+    binned, g3, label = make_inputs(rng, N=N, F=F, B=B, S=S)
+    key = jax.random.PRNGKey(3)
+    q3_ref, sc_ref = numpy_sr_quantize(g3, key, S)
+    bn, lb = np.asarray(binned), np.asarray(label)
+    expect = np.zeros((S, F, B, 3), np.float64)
+    for n in range(N):
+        if lb[n] < S:
+            for f in range(F):
+                expect[lb[n], f, bn[f, n]] += q3_ref[n]
+
+    q3, _ = sr_quantize_g3(g3, label, S, key)
+    got = np.asarray(hist_leaves_pallas(
+        binned, q3, label, S + 1, B, precision="int8sr",
+        interpret=_PALLAS_INTERPRET))[:S]
+    np.testing.assert_array_equal(got, expect)   # exact integer sums
+
+
+def test_hist_wave_quant_method_equivalence(rng):
+    """Every histogram implementation accumulates the same quantized rows
+    to the IDENTICAL integer histogram (scatter is the oracle)."""
+    binned, g3, label = make_inputs(rng, N=1500, F=4, B=16, S=4)
+    key = jax.random.PRNGKey(11)
+    h_s, sc_s = hist_wave_quant(binned, g3, label, 4, 16, key,
+                                method="scatter")
+    h_o, sc_o = hist_wave_quant(binned, g3, label, 4, 16, key,
+                                method="onehot")
+    np.testing.assert_array_equal(np.asarray(h_s), np.asarray(h_o))
+    np.testing.assert_array_equal(np.asarray(sc_s), np.asarray(sc_o))
+    import functools
+
+    import lightgbmv1_tpu.ops.hist_pallas as hp
+    orig = hp.hist_leaves_pallas
+    hp.hist_leaves_pallas = functools.partial(orig,
+                                              interpret=_PALLAS_INTERPRET)
+    try:
+        h_p, _ = hist_wave_quant(binned, g3, label, 4, 16, key,
+                                 method="pallas")
+    finally:
+        hp.hist_leaves_pallas = orig
+    np.testing.assert_array_equal(np.asarray(h_s), np.asarray(h_p))
+
+
+def test_int8sr_counts_stay_exact(rng):
+    """The count channel keeps the repo-wide exactness guarantee
+    (min_data_in_leaf gating relies on it): power-of-two scale,
+    deterministic rounding."""
+    binned, g3, label = make_inputs(rng, N=3000, F=3, B=8, S=4)
+    key = jax.random.PRNGKey(0)
+    h_q, sc = hist_wave_quant(binned, g3, label, 4, 8, key, method="scatter")
+    ref = hist_wave(binned, g3, label, 4, 8, method="scatter")
+    deq = dequantize_hist(h_q, sc)
+    np.testing.assert_array_equal(np.asarray(deq[..., 2]),
+                                  np.asarray(ref[..., 2]))
+
+
+# ---------------------------------------------------------------------------
+# Unbiasedness
+# ---------------------------------------------------------------------------
+
+
+def test_sr_sums_unbiased(rng):
+    """mean over rounding seeds of the SR-quantized dequantized sum ->
+    the fp32 sum (the property round-to-nearest int8 lacks, which cost it
+    0.007 AUC in the round-5 experiment).  300 seeds bring the standard
+    error well under the tolerance."""
+    g3 = jnp.asarray(rng.randn(4000, 3).astype(np.float32))
+    label = jnp.zeros(4000, jnp.int32)
+
+    @jax.jit
+    def one(seed):
+        q3, sc = sr_quantize_g3(g3, label, 1, jax.random.PRNGKey(seed))
+        return jnp.sum(q3[:, :2] * sc[0, :2][None, :], axis=0)
+
+    sums = np.asarray(jax.vmap(one)(jnp.arange(300)))     # (300, 2)
+    target = np.asarray(g3[:, :2].sum(axis=0))
+    err = np.abs(sums.mean(axis=0) - target)
+    # per-row SR noise std is scale * sqrt(1/12); the mean of 300 seeds
+    # over 4000 rows has std ~ scale * sqrt(4000/12/300) ~ 0.03
+    assert (err < 0.15).all(), (sums.mean(axis=0), target)
+    # ...and individual draws really are noisy (SR, not round-to-nearest)
+    assert sums.std(axis=0).min() > 0
+
+
+def test_sr_beats_round_to_nearest_bias():
+    """Construct the adversarial case for round-to-nearest: many rows
+    whose scaled gradient has the same small fractional part.  RTN drops
+    the fraction from every row (bias grows linearly in N); SR keeps the
+    sum unbiased."""
+    n = 4096
+    g = np.full(n, 0.30, np.float32)      # scaled value 0.30*127/0.9...
+    g[0] = 0.9                            # sets amax -> step 0.9/127
+    g3 = jnp.asarray(np.stack([g, g, np.ones_like(g)], axis=1))
+    label = jnp.zeros(n, jnp.int32)
+    target = float(g3[:, 0].sum())
+
+    def sr_err(seed):
+        q3, sc = sr_quantize_g3(g3, label, 1, jax.random.PRNGKey(seed))
+        return float(jnp.sum(q3[:, 0]) * sc[0, 0]) - target
+
+    # round-to-nearest of the same scaled values
+    scale = 0.9 / 127.0
+    rtn = float(np.round(g / scale).sum() * scale) - target
+    sr_mean = np.mean([sr_err(s) for s in range(50)])
+    assert abs(sr_mean) < abs(rtn) / 5, (sr_mean, rtn)
+
+
+# ---------------------------------------------------------------------------
+# Dequantize-aware split scan
+# ---------------------------------------------------------------------------
+
+
+def test_split_hist_scale_matches_dequantized(rng):
+    """find_best_split(hist_q, hist_scale=sc) must pick the same split as
+    find_best_split(hist_q * sc): the integer-domain cumsum + one multiply
+    is algebraically the scaled cumsum."""
+    from lightgbmv1_tpu.config import Config
+    from lightgbmv1_tpu.io.dataset import BinnedDataset
+    from lightgbmv1_tpu.ops.split import (SplitParams, find_best_split,
+                                          make_feature_meta)
+
+    X = rng.randn(800, 5)
+    y = (X[:, 0] > 0).astype(float)
+    ds = BinnedDataset.from_numpy(
+        X, label=y, config=Config.from_dict({"objective": "binary",
+                                             "verbosity": -1}))
+    meta = make_feature_meta(ds)
+    params = SplitParams(min_data_in_leaf=5.0)
+    B = int(ds.num_bins.max())
+    binned = jnp.asarray(ds.train_matrix)
+    g3 = jnp.asarray(rng.randn(800, 3).astype(np.float32))
+    g3 = g3.at[:, 2].set(1.0)
+    label = jnp.zeros(800, jnp.int32)
+    h_q, sc = hist_wave_quant(binned, g3, label, 1, B,
+                              jax.random.PRNGKey(5), method="scatter")
+    hist_q, scale = h_q[0], sc[0]
+    parent = jnp.sum(hist_q[0] * scale[None, :], axis=0)
+    mask = jnp.ones(5, bool)
+    r_scaled = find_best_split(hist_q * scale[None, None, :], parent, meta,
+                               mask, params)
+    r_quant = find_best_split(hist_q, parent, meta, mask, params,
+                              hist_scale=scale)
+    assert int(r_scaled.feature) == int(r_quant.feature)
+    assert int(r_scaled.threshold_bin) == int(r_quant.threshold_bin)
+    np.testing.assert_allclose(float(r_scaled.gain), float(r_quant.gain),
+                               rtol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# Gate: where int8sr may and may not run
+# ---------------------------------------------------------------------------
+
+
+def _spy_quant_calls(monkeypatch):
+    """Record every nslots the trainer's quantized pass is TRACED for —
+    the eligibility gate is structural (quant branches exist only for
+    eligible buckets), so trace-time capture pins it exactly."""
+    import lightgbmv1_tpu.parallel.trainer as T
+    calls = []
+    orig = T.hist_wave_quant
+
+    def spy(binned, g3, label, nslots, num_bins, key, **kw):
+        calls.append(int(nslots))
+        return orig(binned, g3, label, nslots, num_bins, key, **kw)
+
+    monkeypatch.setattr(T, "hist_wave_quant", spy)
+    return calls
+
+
+def _train_int8sr(extra=None, rounds=3):
+    import lightgbmv1_tpu as lgb
+
+    rng = np.random.RandomState(0)
+    X = rng.randn(4000, 8)
+    y = (X[:, 0] * 1.5 - X[:, 1] + 0.3 * rng.randn(4000) > 0).astype(float)
+    params = {"objective": "binary", "num_leaves": 127,
+              "leafwise_wave_size": 63, "min_data_in_leaf": 5,
+              "verbosity": -1, "seed": 7, "hist_dtype_deep": "int8sr"}
+    params.update(extra or {})
+    bst = lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=rounds)
+    return bst, X, y
+
+
+def test_gate_sustained_and_s16_only(monkeypatch):
+    """int8sr runs on the sustained bucket (K) and the 16-slot ramp bucket
+    ONLY — never the root pass (nslots=1) or the 4-slot ramp bucket."""
+    import lightgbmv1_tpu.models.grower_wave as gw
+
+    monkeypatch.setattr(gw, "_BUCKET_MIN_N", 1)
+    calls = _spy_quant_calls(monkeypatch)
+    bst, X, y = _train_int8sr()
+    assert np.isfinite(bst.predict(X)).all()
+    assert set(calls) == {16, 63}, calls
+
+
+def test_gate_never_under_gpu_use_dp(monkeypatch):
+    """gpu_use_dp asks for the HIGHEST histogram precision; int8sr must
+    not activate under it (trainer disables the mode with a warning)."""
+    import lightgbmv1_tpu.models.grower_wave as gw
+
+    monkeypatch.setattr(gw, "_BUCKET_MIN_N", 1)
+    calls = _spy_quant_calls(monkeypatch)
+    bst, X, y = _train_int8sr({"gpu_use_dp": True}, rounds=2)
+    assert np.isfinite(bst.predict(X)).all()
+    assert calls == [], calls
+
+
+def test_gate_inactive_on_small_waves(monkeypatch):
+    """K < 32 has no sustained bucket by the deep-precision policy, and
+    K <= 16 has no 16-slot ramp bucket either: no quantized pass exists."""
+    import lightgbmv1_tpu.models.grower_wave as gw
+
+    monkeypatch.setattr(gw, "_BUCKET_MIN_N", 1)
+    calls = _spy_quant_calls(monkeypatch)
+    bst, X, y = _train_int8sr({"num_leaves": 31, "leafwise_wave_size": 8},
+                              rounds=2)
+    assert np.isfinite(bst.predict(X)).all()
+    assert calls == [], calls
+
+
+def test_int8sr_bit_reproducible(monkeypatch):
+    """Same seed -> bit-identical predictions (the counter-based PRNG
+    contract: rounding keyed by (iteration, round), no device state)."""
+    import lightgbmv1_tpu.models.grower_wave as gw
+
+    monkeypatch.setattr(gw, "_BUCKET_MIN_N", 1)
+    a, X, y = _train_int8sr()
+    b, _, _ = _train_int8sr()
+    np.testing.assert_array_equal(a.predict(X), b.predict(X))
+
+
+def test_int8sr_quality_sane(monkeypatch):
+    """Trains to a sane AUC in the quantized mode (quality parity at 500
+    iters is the DEVICE experiment, tools/precision_expt.py; this pins
+    'not broken' on CPU)."""
+    import lightgbmv1_tpu.models.grower_wave as gw
+
+    sys.path.insert(0, "tests")
+    from sklearn_free_auc import auc_score
+
+    monkeypatch.setattr(gw, "_BUCKET_MIN_N", 1)
+    bst, X, y = _train_int8sr(rounds=8)
+    assert auc_score(y, bst.predict(X)) > 0.97
+
+
+def test_config_rejects_unknown_deep_dtype():
+    from lightgbmv1_tpu.config import Config
+
+    with pytest.raises(ValueError, match="hist_dtype_deep"):
+        Config.from_dict({"objective": "binary",
+                          "hist_dtype_deep": "int4sr"})

@@ -1,0 +1,49 @@
+"""PERF.md is GENERATED output of tools/perf_report.py (VERDICT r5 #2):
+every number greps to a BENCH field, and this test makes hand-editing the
+file (the round-4/round-5 stale-quote failure mode) a test failure."""
+
+import os
+import re
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+
+def test_perf_md_matches_generator_output():
+    import perf_report
+
+    with open(os.path.join(REPO, "PERF.md")) as fh:
+        on_disk = fh.read()
+    m = re.search(r"from `(BENCH_r\d+\.json)`", on_disk.splitlines()[0])
+    assert m, "PERF.md must name its source BENCH record in the header"
+    name = m.group(1)
+    rec = perf_report.load(os.path.join(REPO, name))
+    # same prev-record resolution as the CLI
+    import glob
+    recs = sorted(glob.glob(os.path.join(REPO, "BENCH_r*.json")))
+    names = [os.path.basename(r) for r in recs]
+    i = names.index(name)
+    prev = perf_report.load(recs[i - 1]) if i > 0 else None
+    prev_name = names[i - 1] if i > 0 else None
+    regenerated = perf_report.generate(rec, name, prev, prev_name)
+    assert on_disk.strip() == regenerated.strip(), (
+        "PERF.md diverged from tools/perf_report.py output — regenerate "
+        "with `python tools/perf_report.py` instead of hand-editing")
+
+
+def test_headline_numbers_grep_to_record():
+    import json
+
+    import perf_report
+
+    with open(os.path.join(REPO, "PERF.md")) as fh:
+        on_disk = fh.read()
+    name = re.search(r"from `(BENCH_r\d+\.json)`",
+                     on_disk.splitlines()[0]).group(1)
+    with open(os.path.join(REPO, name)) as fh:
+        rec = json.load(fh).get("parsed", {})
+    for key in ("value", "vs_baseline", "tpu_500iter_wall_s"):
+        if rec.get(key) is not None:
+            assert perf_report.fmt(rec[key], 4).rstrip("x") in on_disk \
+                or f"{rec[key]}" in on_disk, key

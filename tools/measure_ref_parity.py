@@ -21,7 +21,7 @@ import time
 import numpy as np
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
-from bench import make_multiclass_data, make_rank_data  # noqa: E402
+from bench import make_data, make_multiclass_data, make_rank_data  # noqa: E402
 
 BIN = os.environ.get("REF_LGBM", "/tmp/refbuild/lightgbm")
 WORK = "/tmp/ref_parity"
@@ -93,6 +93,36 @@ def main():
     mrt = RK_Q * RK_D * RK_IT / train_s / 1e6
     print(f"REF_RK_M_ROW_TREES_S = {mrt:.3f}   # {train_s:.1f}s train")
     print(f"REF_RK_NDCG10 = {nd[-1] if nd else None}")
+
+    # ---- prediction (must mirror bench.py's measure_predict block) -------
+    # reference CLI task=predict, file->file, on the 1M-row binary bench
+    # set with a 100-tree model trained at the bench config (VERDICT r5
+    # #6).  Prediction wall is PROCESS wall: the CLI's parse + predict +
+    # result write is exactly what bench.py times for our engines.
+    PR_N, PR_IT = 1_000_000, 100
+    Xb, yb = make_data(PR_N, 0)
+    tr = os.path.join(WORK, "bin.train.tsv")
+    if not os.path.exists(tr):
+        write_tsv(tr, Xb, yb)
+    model = os.path.join(WORK, "bin.model.txt")
+    train_s, _ = run_conf("bin_train", [
+        "task = train", "objective = binary", f"data = {tr}",
+        "num_leaves = 255", "max_bin = 63", "learning_rate = 0.1",
+        "min_data_in_leaf = 20", f"num_iterations = {PR_IT}",
+        f"metric_freq = {PR_IT}", "num_threads = 1", "verbosity = 1",
+        f"output_model = {model}",
+    ])
+    t0 = time.time()
+    out = subprocess.run([BIN, "task=predict",
+                          f"data={tr}", f"input_model={model}",
+                          f"output_result={os.path.join(WORK, 'bin.pred')}",
+                          "num_threads=1", "verbosity=1"],
+                         cwd=WORK, capture_output=True, text=True,
+                         timeout=3600)
+    wall = time.time() - t0
+    print(f"REF_PREDICT_M_ROWS_S = {PR_N / wall / 1e6:.3f}"
+          f"   # {wall:.1f}s file->file, {PR_IT} trees"
+          + ("" if out.returncode == 0 else "  [predict rc != 0 — CHECK]"))
 
 
 if __name__ == "__main__":
