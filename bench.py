@@ -885,6 +885,25 @@ def main():
         except Exception as e:  # noqa: BLE001
             extra["northstar_error"] = f"{type(e).__name__}: {e}"[:200]
 
+    # Cross-chip comm pricing (analytic, parallel/cluster.py — the same
+    # single-source formula the trainer logs and dryrun_multichip
+    # records): the BENCH shape's per-round comm table at the MULTICHIP
+    # smoke pod width (D=8), so the record carries bench-shape byte
+    # figures next to the smoke-shape ones PERF.md renders.  Purely
+    # shape+dtype arithmetic — no device needed, so it runs on the CPU
+    # fallback too.
+    try:
+        from lightgbmv1_tpu.models.grower_wave import auto_wave_size
+        from lightgbmv1_tpu.parallel.cluster import comm_table_per_round
+
+        K_comm = auto_wave_size(cfg_lw.num_leaves)
+        extra["comm_bytes_per_round_d8"] = {
+            mode: comm_table_per_round("data", mode, k=K_comm, F=28, B=64,
+                                       ndev=8)
+            for mode in ("reduce_scatter", "allreduce")}
+    except Exception as e:  # noqa: BLE001
+        extra["comm_error"] = f"{type(e).__name__}: {e}"[:200]
+
     baseline = 10.5e6 * 500 / 130.094 / 1e6   # reference CPU HIGGS throughput
     print(json.dumps({
         # headline = leaf-wise (the reference's own growth policy), bf16

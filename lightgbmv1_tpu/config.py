@@ -346,6 +346,16 @@ class Config:
     # are bit-identical either way on the exact-fp32 scatter path
     # (tests/test_phase_attrib.py pins this).
     fused_bookkeeping: bool = True
+    # Cross-chip collective of the row-sharded (data/voting) learners:
+    # "reduce_scatter" (default) maps the reference's ReduceScatter of
+    # histogram blocks faithfully — each device reduces and KEEPS only its
+    # F/D feature slice, finds its local best split there, and only packed
+    # SplitInfo crosses chips (Allreduce-max, the SyncUpGlobalBestSplit
+    # analog), cutting histogram comm bytes ~D-fold per round;
+    # "allreduce" keeps the PR-2-era full-histogram lax.psum (every chip
+    # materializes every feature's bins) — retained as the parity pin and
+    # for A/B measurement (tools/dryrun_multichip records both).
+    data_parallel_collective: str = "reduce_scatter"
     num_shards: int = 0            # devices for data-parallel (0 = all available)
     profile_dir: str = ""          # write a jax.profiler device trace of
                                    # training here; hist/split/partition
@@ -466,6 +476,12 @@ class Config:
                 self.hist_method = "scatter"
             elif self.force_row_wise:
                 self.hist_method = "onehot"
+        if self.data_parallel_collective not in (
+                "reduce_scatter", "allreduce"):
+            raise ValueError(
+                f"data_parallel_collective="
+                f"{self.data_parallel_collective!r}: expected "
+                "reduce_scatter | allreduce")
         if self.hist_dtype_deep not in (
                 "", "f32", "bf16", "bf16x2", "int8", "int8sr"):
             raise ValueError(

@@ -96,6 +96,13 @@ from .tree import TreeArrays, empty_tree
 # full-wave path).  Lowered by tests to exercise the bucketed branches.
 _BUCKET_MIN_N = 1 << 16
 
+# Smaller-child + subtraction mode is skipped when the (L, F, B, 3)
+# per-leaf histogram state would exceed this cap (wide-F configs fall back
+# to the pool-free 2K-slot pass).  Module-level so tests can force the
+# pool-free path on small shapes (e.g. the integer-domain voting
+# collective only exists there, tests/test_parallel.py).
+_SUB_STATE_CAP_BYTES = 512 * (1 << 20)
+
 
 def replay_wave_schedule(trees, K: int):
     """Per-round split counts of the wave policy, replayed EXACTLY from
@@ -685,10 +692,16 @@ def make_wave_grower(
         L, L1, W, use_mc, use_cat)
 
     # the default split accepts a per-child hist_scale (dequantize-aware
-    # scan, ops/split.py); custom split_fns (EFB bundle decode, feature-/
-    # voting-parallel collectives) keep their narrower signature and get
-    # pre-dequantized histograms instead
+    # scan, ops/split.py), as do custom split_fns that declare
+    # ``accepts_hist_scale = True`` (the sharded data-/voting-parallel
+    # collectives, parallel/trainer.py — keeping the histogram integer
+    # until AFTER their cross-chip reduce is the point of the int8sr
+    # integer-domain collective); other custom split_fns (EFB bundle
+    # decode, feature-parallel all_gather) keep their narrower signature
+    # and get pre-dequantized histograms instead
     default_split = split_fn is None
+    takes_scale = default_split or getattr(split_fn, "accepts_hist_scale",
+                                           False)
     if split_fn is None:
         def split_fn(hist, parent, mask, key, uid, constraint, depth,
                      parent_output, hist_scale=None):
@@ -752,7 +765,7 @@ def make_wave_grower(
         # smaller-leaf trick, serial_tree_learner.cpp:274-314), deriving
         # the larger child from the per-leaf histogram state.  Skipped
         # when that state would exceed 512 MB (wide-F configs).
-        use_sub = (L * int(np.prod(hist0.shape)) * 4) <= 512 * (1 << 20)
+        use_sub = (L * int(np.prod(hist0.shape)) * 4) <= _SUB_STATE_CAP_BYTES
         root_sum = sums_fn(g3)
         mask0 = _node_feature_mask(key, 0, base_mask, feature_fraction_bynode)
         mask0 = mask0 & allowed_features(jnp.zeros(F, bool))
@@ -981,9 +994,9 @@ def make_wave_grower(
                     # children come straight from the (possibly quantized)
                     # pass: hand the split scan the integer histograms +
                     # per-child scales (dequantize-aware scan) when the
-                    # default split runs, else dequantize here
+                    # split accepts them, else dequantize here
                     cscale = hscale[ch_idx]                       # (2K, 3)
-                    if not default_split:
+                    if not takes_scale:
                         hist = hist * cscale[:, None, None, :]
                         cscale = None
 

@@ -244,10 +244,16 @@ def hist_wave_quant(
     method: str = "scatter",
     packed: bool = False,
     num_features: int = 0,
+    axis_name=None,
 ):
     """Stochastic-rounded int8 wave histogram: quantize the gradient rows
     (ops/quantize.sr_quantize_g3 — deterministic counter-based rounding
     keyed by ``key``) and accumulate the INTEGER histogram.
+
+    ``axis_name`` (row-sharded learners): pmax the quantization range
+    across the named mesh axis so every shard's integer histogram shares
+    one scale and the cross-chip reduction can run on raw int32 partials
+    (see sr_quantize_g3).
 
     Returns ``(hist_q, scales)``: ``hist_q`` (nslots, F, B, 3) holds exact
     integer sums of the quantized rows, ``scales`` (nslots, 3) the per-slot
@@ -264,7 +270,8 @@ def hist_wave_quant(
     from .quantize import sr_quantize_g3
 
     with jax.named_scope("lgbm.hist_q"):
-        q3, scales = sr_quantize_g3(g3, label, nslots, key)
+        q3, scales = sr_quantize_g3(g3, label, nslots, key,
+                                    axis_name=axis_name)
         prec = "int8sr" if method == "pallas" else "f32"
         h = hist_wave(binned, q3, label, nslots, num_bins, method=method,
                       precision=prec, packed=packed,

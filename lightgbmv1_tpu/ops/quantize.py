@@ -46,9 +46,9 @@ import jax.numpy as jnp
 INT8_QMAX = 127.0
 
 
-@functools.partial(jax.jit, static_argnames=("nslots",))
+@functools.partial(jax.jit, static_argnames=("nslots", "axis_name"))
 def sr_quantize_g3(g3: jax.Array, label: jax.Array, nslots: int,
-                   key: jax.Array):
+                   key: jax.Array, axis_name=None):
     """Quantize ``g3`` (N, 3) [grad, hess, count] to int8-ranged integers
     with stochastic rounding on the grad/hess channels.
 
@@ -64,10 +64,24 @@ def sr_quantize_g3(g3: jax.Array, label: jax.Array, nslots: int,
 
     ``label`` is accepted (and unused by the global-scale implementation)
     so a per-slot scale can be introduced without touching call sites.
+
+    ``axis_name``: when the rows are a SHARD of a mesh axis (data/voting
+    parallel learners), pass its name — the quantization range is then
+    pmax'd across shards so every shard quantizes against the IDENTICAL
+    scale.  That is what lets the cross-chip histogram reduction run in
+    the raw INTEGER domain (int32 through lax.psum_scatter/psum,
+    parallel/trainer.py) with one shared dequantization folded into the
+    split scan; per-shard scales would make the integer partials
+    incommensurable.  SR unbiasedness holds for any scale, so the global
+    scale (>= each local amax) changes nothing statistically.
     """
+    from jax import lax as _lax
+
     del label  # per-pass scales; see module docstring
     g = g3[:, :2].astype(jnp.float32)
     amax = jnp.max(jnp.abs(g), axis=0)                       # (2,)
+    if axis_name is not None:
+        amax = _lax.pmax(amax, axis_name)
     inv = jnp.where(amax > 0, INT8_QMAX / amax, 0.0)
     scale = jnp.where(amax > 0, amax / INT8_QMAX, 0.0)
     u = jax.random.uniform(key, g.shape, dtype=jnp.float32)  # [0, 1)
@@ -78,6 +92,8 @@ def sr_quantize_g3(g3: jax.Array, label: jax.Array, nslots: int,
     # _COUNT_SCALE) and safe for weighted rows
     c = g3[:, 2].astype(jnp.float32)
     cmax = jnp.max(jnp.abs(c))
+    if axis_name is not None:
+        cmax = _lax.pmax(cmax, axis_name)
     inv_c = jnp.where(
         cmax > 0,
         jnp.minimum(jnp.exp2(jnp.floor(jnp.log2(INT8_QMAX / cmax))), 64.0),

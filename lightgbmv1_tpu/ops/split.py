@@ -36,6 +36,31 @@ from ..io.binning import MISSING_NAN, MISSING_NONE, MISSING_ZERO
 
 NEG_INF = -jnp.inf
 
+# Near-tie tolerance of the split argmax (relative to the gain scale).
+# Distributed histograms are f32 reductions whose summation ORDER differs
+# between the serial sum, lax.psum and lax.psum_scatter; candidate gains
+# therefore carry reduction-order noise of a few f32 ulps of the LEAF GAIN
+# terms they are differences of (the shift/parent-gain magnitude — the
+# final gain itself can be arbitrarily small through cancellation).
+# Candidates within ``TIE_RTOL * (|shift| + |best|)`` of the best are
+# treated as TIED and resolved by the deterministic preference order
+# (reference scan-order within a feature, lowest feature id across
+# features), which makes the chosen split invariant to reduction order and
+# device count — the fix for the psum-summation-order near-tie threshold
+# flips tests/test_parallel.py[data] exposed.  The band is ~30 f32 ulps:
+# far below any gain gap the reference itself could distinguish, so the
+# golden-parity fixtures are unaffected.
+TIE_RTOL = 4e-6
+
+
+def tie_tol(best_gain, scale):
+    """Absolute gain tolerance under which two split candidates count as
+    tied.  ``scale`` is the leaf-gain magnitude the candidate gains were
+    differenced against (the parent-gain shift); ``best_gain`` may be
+    -inf (no candidate), which contributes nothing."""
+    b = jnp.where(jnp.isfinite(best_gain), jnp.abs(best_gain), 0.0)
+    return TIE_RTOL * (jnp.abs(scale) + b)
+
 
 class SplitParams(NamedTuple):
     """Static-ish regularization parameters (traced scalars are fine too)."""
@@ -546,11 +571,18 @@ def _find_best_split(
     gains_f = jnp.concatenate([gains[0], gains[1]], axis=1)   # (F, 2B)
     pref_f = jnp.concatenate([pref_a, pref_b], axis=1)        # (F, 2B)
     fbest = gains_f.max(axis=1)                               # (F,)
-    sel_f = jnp.argmax(jnp.where(gains_f == fbest[:, None], pref_f, -1),
-                       axis=1)                                # (F,)
-    feature = jnp.argmax(fbest).astype(jnp.int32)   # first max = min feature
-    best_gain = fbest[feature]
+    # near-tie band (tie_tol above): every candidate within the band of
+    # its feature's best competes on the deterministic preference order
+    # alone, so reduction-order ulp noise cannot flip the pick
+    tol_f = tie_tol(fbest, shift)                             # (F,)
+    sel_f = jnp.argmax(
+        jnp.where(gains_f >= (fbest - tol_f)[:, None], pref_f, -1),
+        axis=1)                                               # (F,)
+    gbest = jnp.max(fbest)
+    feature = jnp.argmax(fbest >= gbest - tie_tol(gbest, shift)) \
+        .astype(jnp.int32)                   # first in band = min feature
     sel = sel_f[feature]
+    best_gain = gains_f[feature, sel]
     direction = (sel // B).astype(jnp.int32)
     threshold = (sel % B).astype(jnp.int32)
 

@@ -125,3 +125,135 @@ def init_cluster(
         f"Cluster initialized: process {jax.process_index()} of "
         f"{jax.process_count()}, {jax.local_device_count()} local / "
         f"{jax.device_count()} global devices")
+
+
+def make_mesh(num_shards: int, axis: str):
+    """One-axis device mesh for the distributed learners (trainer.py).
+    ``num_shards == 0`` spans every visible device — the reference's
+    ``num_machines`` world-size role, with XLA's ICI/DCN collectives in
+    place of the socket/MPI linkers."""
+    import numpy as np
+    import jax
+    from jax.sharding import Mesh
+
+    devices = jax.devices()
+    n = num_shards if num_shards > 0 else len(devices)
+    if n > len(devices):
+        log_fatal(f"num_shards={n} exceeds available devices "
+                  f"({len(devices)})")
+    return Mesh(np.array(devices[:n]), (axis,))
+
+
+# ---------------------------------------------------------------------------
+# Analytic comm accounting (the measurement role the reference's Network
+# layer plays implicitly through its buffer sizes, src/network/network.cpp).
+#
+# Convention: every figure is the OUTPUT PAYLOAD a collective materializes
+# per device — the array bytes each chip must end up holding, computed
+# exactly from shapes + dtypes.  This is the quantity the learner design
+# controls (an allreduced histogram lands F*B*3 values on every chip; a
+# reduce-scattered one lands F/D of that) and is proportional to, not equal
+# to, the wire traffic of any particular ring/tree lowering.  The trainer
+# logs a table per learner at build time, tools/dryrun_multichip records it
+# into the MULTICHIP record, and tools/perf_report.py renders it in
+# PERF.md's "Cross-chip comms" section.
+# ---------------------------------------------------------------------------
+
+HIST_CH = 3             # [sum_grad, sum_hess, count] channels per bin
+F32 = 4                 # bytes; int32 (the int8sr integer domain) matches
+
+
+def split_pack_floats(num_bins: int) -> int:
+    """f32 words of one packed SplitInfo on the wire (trainer._pack_split):
+    [gain, feature, threshold, default_left, is_cat] + left/right (3,)
+    sums + the categorical bitset words."""
+    return 11 + (-(-num_bins // 32))
+
+
+def collective_bytes(n_elems: int, ndev: int, kind: str,
+                     itemsize: int = F32) -> int:
+    """Payload bytes per device of one collective over ``ndev`` devices."""
+    if ndev <= 1:
+        return 0
+    if kind == "psum":                # allreduce: full array everywhere
+        return n_elems * itemsize
+    if kind == "psum_scatter":        # each device keeps its 1/D slice
+        return (n_elems // ndev) * itemsize
+    if kind == "all_gather":          # per-device contribution times D
+        return n_elems * ndev * itemsize
+    raise ValueError(f"unknown collective kind: {kind}")
+
+
+def comm_table_per_round(learner: str, collective: str, *, k: float,
+                         F: int, B: int, ndev: int,
+                         sel_k: Optional[int] = None,
+                         int8sr: bool = False) -> dict:
+    """Per-ROUND comm bytes of one wave round with ``k`` splits (smaller-
+    child subtraction: k histogram slots cross the wire, 2k children are
+    searched), by collective:
+
+    * ``hist_bytes``       — the histogram reduction (psum of
+      (k, F, B, 3) under "allreduce"; psum_scatter of the F-padded array
+      under "reduce_scatter", where each chip keeps ceil(F/D) features).
+    * ``split_sync_bytes`` — the SplitInfo sync: 2k children x an
+      all_gather of one packed SplitInfo per device ("reduce_scatter" and
+      the feature-parallel learner; zero under "allreduce", where split
+      selection is replicated).
+    * ``vote_bytes``       — voting learner only: the GlobalVoting psum
+      of (F,) vote counts per child.
+    * ``g3_bytes_per_tree``— the root grad/hess/count totals psum, once
+      per tree (not per round).
+
+    ``int8sr`` flags rounds whose histograms cross as raw int32
+    (ops/quantize.py global-scale quantization) — same 4-byte elements,
+    recorded in ``hist_dtype`` because integer summation is also
+    reduction-order exact.
+    """
+    F_pad = -(-F // ndev) * ndev
+    spf = split_pack_floats(B)
+    sync = collective_bytes(int(round(2 * k)) * spf, ndev, "all_gather")
+    out = {"g3_bytes_per_tree": collective_bytes(HIST_CH, ndev, "psum"),
+           "hist_dtype": "int32" if int8sr else "float32"}
+    if learner == "feature":
+        # histograms are feature-local by construction; only SplitInfo
+        # crosses chips (SyncUpGlobalBestSplit)
+        out.update(hist_bytes=0, split_sync_bytes=sync)
+    elif learner == "voting":
+        nsel = sel_k if sel_k is not None else F
+        vote = collective_bytes(int(round(2 * k)) * F, ndev, "psum")
+        if collective == "reduce_scatter":
+            nsel_pad = -(-nsel // ndev) * ndev
+            hist = collective_bytes(
+                int(round(2 * k)) * nsel_pad * B * HIST_CH, ndev,
+                "psum_scatter")
+            out.update(hist_bytes=hist, split_sync_bytes=sync,
+                       vote_bytes=vote)
+        else:
+            hist = collective_bytes(
+                int(round(2 * k)) * nsel * B * HIST_CH, ndev, "psum")
+            out.update(hist_bytes=hist, split_sync_bytes=0,
+                       vote_bytes=vote)
+    elif collective == "reduce_scatter":
+        hist = collective_bytes(
+            int(round(k)) * F_pad * B * HIST_CH, ndev, "psum_scatter")
+        out.update(hist_bytes=hist, split_sync_bytes=sync)
+    else:
+        hist = collective_bytes(
+            int(round(k)) * F * B * HIST_CH, ndev, "psum")
+        out.update(hist_bytes=hist, split_sync_bytes=0)
+    out["total_bytes"] = (out["hist_bytes"] + out["split_sync_bytes"]
+                          + out.get("vote_bytes", 0))
+    return out
+
+
+def comm_guard_ok(rs_hist_bytes: float, allreduce_hist_bytes: float,
+                  ndev: int) -> bool:
+    """The comm-bytes regression guard (tools/dryrun_multichip -> MULTICHIP
+    record ``comm_ok``): the reduce-scatter histogram path must beat the
+    recorded allreduce bytes by essentially the full D-fold —
+    ``rs <= allreduce / (D * 0.9)`` — so a silent fallback to a
+    full-width reduction (or an accidental allgather of the scattered
+    slices) trips the guard instead of hiding in the record."""
+    if ndev <= 1:
+        return True
+    return rs_hist_bytes <= allreduce_hist_bytes / (ndev * 0.9)

@@ -6,11 +6,23 @@ stack:
 * ``tree_learner=data``  — DataParallelTreeLearner
   (reference: src/treelearner/data_parallel_tree_learner.cpp): rows are
   sharded over the ``data`` mesh axis; each device builds local histograms
-  and a ``lax.psum`` replaces the ReduceScatter+allgather of histogram
-  blocks (``FindBestSplits`` :155-173, ``HistogramSumReducer`` bin.h:44-57).
-  The root grad/hess Allreduce (:126-151) becomes ``psum`` of the g3 totals.
-  Split selection runs replicated on every device — deterministic, so no
-  ``SyncUpGlobalBestSplit`` message exchange is needed at all.
+  and — faithfully to the reference now — a ``lax.psum_scatter`` over the
+  feature axis replaces its ReduceScatter of histogram blocks
+  (``FindBestSplits`` :155-173, ``HistogramSumReducer`` bin.h:44-57): each
+  device reduces and KEEPS only its ``F/D`` feature slice, searches its
+  local best split there, and an all_gather + deterministic-tie-break
+  argmax over packed SplitInfo (``SyncUpGlobalBestSplit``,
+  parallel_tree_learner.h:190-213) elects the winner — so only split
+  metadata, never histograms, crosses chips after the reduce, cutting
+  histogram comm payload ~D-fold per round.  Under
+  ``hist_dtype_deep=int8sr`` the reduce runs on raw int32 histograms
+  (global-scale quantization, ops/quantize.py) and dequantization folds
+  into the now-local split scan.  ``config.data_parallel_collective=
+  "allreduce"`` keeps the previous full-histogram ``lax.psum`` (split
+  selection replicated, no split sync) as the parity pin; both paths grow
+  identical trees thanks to the reduction-order-invariant tie-break
+  (ops/split.py tie_tol).  The root grad/hess Allreduce (:126-151) stays a
+  ``psum`` of the g3 totals either way.
 * ``tree_learner=feature`` — FeatureParallelTreeLearner
   (reference: src/treelearner/feature_parallel_tree_learner.cpp): every
   device holds all rows (data replicated) but builds histograms and searches
@@ -24,7 +36,15 @@ stack:
   global top-2k winners are selected by a vote psum (``GlobalVoting``
   :152-180), and only those features' histograms are reduced across shards
   (``CopyLocalHistogram``) — comm drops from O(F·B) to O(2k·B) per split.
-  With ``top_k >= num_features`` it is exactly the data-parallel learner.
+  The selective reduce rides the same sharded primitive as the data
+  learner: under ``data_parallel_collective=reduce_scatter`` the selected
+  features' histograms are psum_scattered so each chip keeps 2k/D of them
+  and syncs only SplitInfo, and under int8sr the reduce sums the RAW
+  quantized integers with one dequantize after the collective (the
+  selective reduce honors the integer domain — previously only the data
+  branch did; its wire dtype stays f32 because the op is shared with
+  full-precision rounds, but the summed values are exact integers).  With
+  ``top_k >= num_features`` it is exactly the data-parallel learner.
 
 The socket/MPI ``Network``/``Linkers`` machinery of the reference
 (src/network/) has no equivalent here by design: XLA emits the collectives
@@ -49,8 +69,10 @@ from ..models.grower_wave import make_wave_grower
 from ..models.tree import TreeArrays
 from ..ops.histogram import (default_hist_method, hist_one_leaf, hist_wave,
                              hist_wave_quant)
-from ..ops.split import FeatureMeta, SplitParams, SplitResult, find_best_split
+from ..ops.split import (FeatureMeta, SplitParams, SplitResult,
+                         find_best_split, leaf_gain, tie_tol)
 from ..utils.log import log_fatal, log_info, log_warning
+from .cluster import comm_table_per_round, make_mesh
 
 try:  # jax >= 0.6 exposes shard_map at top level
     _shard_map = jax.shard_map
@@ -74,11 +96,7 @@ def shard_map(*args, **kwargs):
 
 
 def _make_mesh(num_shards: int, axis: str) -> Mesh:
-    devices = jax.devices()
-    n = num_shards if num_shards > 0 else len(devices)
-    if n > len(devices):
-        log_fatal(f"num_shards={n} exceeds available devices ({len(devices)})")
-    return Mesh(np.array(devices[:n]), (axis,))
+    return make_mesh(num_shards, axis)   # parallel/cluster.py (topology home)
 
 
 def _pack_split(res: SplitResult) -> jnp.ndarray:
@@ -106,6 +124,29 @@ def _unpack_split(v: jnp.ndarray) -> SplitResult:
         is_cat=v[4] > 0.5,
         cat_bitset=lax.bitcast_convert_type(v[11:], jnp.uint32),
     )
+
+
+def _sync_best_split(local: SplitResult, parent_sum, params: SplitParams,
+                     axis: str) -> SplitResult:
+    """Elect the global best split from per-shard locals — the reference's
+    ``SyncUpGlobalBestSplit`` Allreduce-max over serialized SplitInfo
+    (parallel_tree_learner.h:190-213), shared by the feature-parallel,
+    reduce-scatter data-parallel and sharded voting learners.
+
+    The winner must be DEVICE-COUNT-INVARIANT: gains carry f32
+    reduction-order noise, so candidates within ``tie_tol`` of the best
+    (ops/split.py — the same band the per-shard search used internally)
+    are tied and the LOWEST FEATURE ID wins, matching the serial search's
+    first-feature-in-band rule exactly (SplitInfo::operator> tie-break,
+    split_info.hpp:147-152)."""
+    packed = _pack_split(local)
+    allp = lax.all_gather(packed, axis)            # (ndev, 11 + W)
+    g = allp[:, 0]
+    m = jnp.max(g)
+    scale = leaf_gain(parent_sum[0], parent_sum[1], params)
+    in_band = g >= m - tie_tol(m, scale)
+    feat = jnp.where(in_band, allp[:, 1], jnp.inf)
+    return _unpack_split(allp[jnp.argmin(feat)])
 
 
 def parse_interaction_constraints(spec, num_features: int):
@@ -328,10 +369,13 @@ def build_trainer(
                          precision=deep_precision if deep else precision,
                          packed=packed, num_features=F)
 
-    def local_wave_quant(binned, g3, label, nslots, key):
+    def local_wave_quant(binned, g3, label, nslots, key, axis_name=None):
+        # axis_name: row-sharded learners pass their mesh axis so the
+        # quantization scale is pmax'd globally and shard histograms are
+        # summable in the raw integer domain (ops/quantize.py)
         return hist_wave_quant(binned, g3, label, nslots, Bh, key,
                                method=method, packed=packed,
-                               num_features=F)
+                               num_features=F, axis_name=axis_name)
 
     # EFB: split search + decisions speak ORIGINAL features; only the
     # histogram pass runs over bundle columns
@@ -507,8 +551,18 @@ def build_trainer(
         )
         top_k = max(1, min(config.top_k, F))
         sel_k = min(2 * top_k, F)
+        use_rs = (config.data_parallel_collective == "reduce_scatter"
+                  and ndev > 1)
+        sel_pad = -(-sel_k // ndev) * ndev
+        sel_loc = sel_pad // ndev
         log_info(f"Voting-parallel training over {ndev} devices "
-                 f"(top_k={top_k}, {sel_k} features reduced per split)")
+                 f"(top_k={top_k}, {sel_k} features reduced per split, "
+                 f"{config.data_parallel_collective} selective reduce)")
+        log_info("comm/round (analytic, K=%d wave): %s" % (wave_size,
+                 comm_table_per_round(
+                     "voting", config.data_parallel_collective, k=wave_size,
+                     F=F, B=B, ndev=ndev, sel_k=sel_k,
+                     int8sr=use_int8sr)))
 
         def hist_fn(binned, g3, leaf_id, target):
             # local histogram only — the reduce happens per-split in split_fn
@@ -518,11 +572,26 @@ def build_trainer(
         def sums_fn(g3):
             return lax.psum(g3.sum(axis=0), "data")
 
+        def voting_wave_quant(binned, g3, label, nslots, key):
+            # global (pmax'd) scales: the selective reduce in split_fn can
+            # then sum the RAW integer histograms across shards (the
+            # int8sr integer-domain contract the data learner follows)
+            return local_wave_quant(binned, g3, label, nslots, key,
+                                    axis_name="data")
+
         def split_fn(local_hist, parent, mask, key, uid, constraint, depth,
-                     parent_output, cegb_pen=None):
+                     parent_output, cegb_pen=None, hist_scale=None):
+            # ``hist_scale`` non-None marks a quantized round whose
+            # histogram is still raw integers (wave grower hands custom
+            # split_fns the integer stack when accepts_hist_scale is set):
+            # votes are computed on a locally-dequantized view (no comm),
+            # while the cross-shard selective reduce below sums the raw
+            # integer values and dequantizes only after the collective
+            hist_f = (local_hist if hist_scale is None
+                      else local_hist * hist_scale[None, None, :])
             # local parent stats: any feature's bin sums cover the shard rows
-            local_parent = local_hist[0].sum(axis=0)
-            gains = per_feature_best_gain(local_hist, local_parent, meta,
+            local_parent = hist_f[0].sum(axis=0)
+            gains = per_feature_best_gain(hist_f, local_parent, meta,
                                           mask, params, parent_output)
             if cegb_pen is not None:
                 # CEGB must influence WHICH features win the vote, not just
@@ -536,16 +605,50 @@ def build_trainer(
             # tie-break deterministically by feature index
             order_score = votes * (F + 1) - jnp.arange(F, dtype=jnp.float32)
             _, selected = lax.top_k(order_score, sel_k)   # (sel_k,)
-            # reduce ONLY the selected features' histograms
-            hist_sel = lax.psum(local_hist[selected], "data")  # (sel_k, B, 3)
-            full = jnp.zeros((F, B, 3), jnp.float32).at[selected].set(hist_sel)
-            sel_mask = jnp.zeros(F, bool).at[selected].set(True)
             rk = jax.random.fold_in(key, uid + 1_000_003 + params.extra_seed) \
                 if params.extra_trees else None
+            # int8sr integer domain: quantized rounds reduce the RAW
+            # integer values and the one dequantize multiply runs AFTER
+            # the reduce (find_best_split's hist_scale fold) on the
+            # reduced slice only.  Unlike the data learner's per-bucket
+            # wrapper, this collective is shared by quantized and
+            # full-precision rounds (hist_scale is identity on the
+            # latter), so the wire dtype stays f32 — integer sums are
+            # still exact (|values| << 2^24) and reduction-order-free.
+            wire = local_hist[selected]                   # (sel_k, B, 3)
+            if use_rs:
+                # CopyLocalHistogram via the sharded primitive: each chip
+                # reduces+keeps sel_k/D of the voted features, searches
+                # them, and only SplitInfo crosses chips
+                wire = jnp.pad(wire, ((0, sel_pad - sel_k), (0, 0), (0, 0)))
+                sl = lax.psum_scatter(wire, "data", scatter_dimension=0,
+                                      tiled=True)         # (sel_loc, B, 3)
+                sl = sl.astype(jnp.float32)
+                lo = lax.axis_index("data") * sel_loc
+                sel_p = jnp.pad(selected, (0, sel_pad - sel_k),
+                                constant_values=F)        # F = drop slot
+                mine = lax.dynamic_slice(sel_p, (lo,), (sel_loc,))
+                full = jnp.zeros((F, B, 3), jnp.float32) \
+                    .at[mine].set(sl, mode="drop")
+                sel_mask = jnp.zeros(F, bool).at[mine].set(True, mode="drop")
+                local = find_best_split(full, parent, meta, mask & sel_mask,
+                                        params, constraint, depth,
+                                        config.monotone_penalty,
+                                        parent_output, rk, cegb_pen,
+                                        hist_scale=hist_scale)
+                return _sync_best_split(local, parent, params, "data")
+            hist_sel = lax.psum(wire, "data").astype(jnp.float32)
+            full = jnp.zeros((F, B, 3), jnp.float32).at[selected].set(hist_sel)
+            sel_mask = jnp.zeros(F, bool).at[selected].set(True)
             return find_best_split(full, parent, meta, mask & sel_mask,
                                    params, constraint, depth,
                                    config.monotone_penalty, parent_output,
-                                   rk, cegb_pen)
+                                   rk, cegb_pen, hist_scale=hist_scale)
+
+        # the wave grower must hand quantized rounds' INTEGER histograms
+        # through (bundle-space hists would mix units in expand, so EFB
+        # keeps the pre-dequantized path)
+        split_fn.accepts_hist_scale = bundle is None
 
         if use_wave:
             # the wave grower's vmapped split_fn batches the vote psum and
@@ -553,7 +656,7 @@ def build_trainer(
             # round — same PV-Tree semantics, one collective round-trip
             grow = make_wave_grower(hist_wave_fn=local_wave,
                                     hist_wave_quant_fn=(
-                                        local_wave_quant if use_int8sr
+                                        voting_wave_quant if use_int8sr
                                         else None),
                                     split_fn=split_fn, sums_fn=sums_fn,
                                     bins_of_fn=bins_feat_fn, **wave_common)
@@ -608,56 +711,148 @@ def build_trainer(
                     lambda idx: jnp.asarray(binned_p[idx]))
             else:
                 binned_dev = jax.device_put(jnp.asarray(binned_p), sharding)
+        collective = config.data_parallel_collective
+        if forced is not None and collective == "reduce_scatter":
+            # forced splits read left/right sums straight off the leaf
+            # histogram (models/grower.forced_split_stats) — a shard-
+            # resident slice cannot serve a forced feature outside the
+            # shard, so the full-histogram path carries them
+            log_warning("forcedsplits_filename requires full histograms "
+                        "on every shard; data_parallel_collective falls "
+                        "back to allreduce")
+            collective = "allreduce"
+        use_rs = collective == "reduce_scatter" and ndev > 1
+        # the HISTOGRAM column axis being sharded: bundle columns under
+        # EFB, original features otherwise (4-bit packed histograms are
+        # already unpacked to F columns by the pallas kernel)
+        FH = binned_np.shape[0] if bundle is not None else F
+        FH_pad = -(-FH // ndev) * ndev
+        FH_loc = FH_pad // ndev
         log_info(f"Data-parallel training over {ndev} devices "
                  f"({N_pad // ndev} rows/device, "
-                 f"{jax.process_count()} processes"
+                 f"{jax.process_count()} processes, {collective} collective"
                  + (", process-sharded storage" if row_sharded else "")
                  + ")")
+        log_info("comm/round (analytic, K=%d wave): %s" % (wave_size,
+                 comm_table_per_round("data", collective, k=wave_size,
+                                      F=FH, B=Bh, ndev=ndev,
+                                      int8sr=use_int8sr)))
+
+        def _scatter_keep(h, int_domain=False):
+            """The reference's ReduceScatter of histogram blocks
+            (data_parallel_tree_learner.cpp:155-173): reduce over the
+            row shards, each device KEEPING only its FH_loc-column
+            feature slice.  The slice is placed at its offset of a
+            zeros-elsewhere full-width array so every downstream shape
+            (leaf_hist state, subtraction, split scan) is unchanged; the
+            allgather the old psum implied is replaced by the SplitInfo
+            sync in _split_sharded.  ``int_domain``: quantized rounds
+            cross the wire as raw int32 (exact, order-invariant sums;
+            ops/quantize.py global scales make shard partials
+            commensurable)."""
+            nb = h.ndim - 3                   # leading slot axes (0 or 1)
+            hp = jnp.pad(h, [(0, 0)] * nb
+                         + [(0, FH_pad - FH), (0, 0), (0, 0)])
+            if int_domain:
+                hp = hp.astype(jnp.int32)
+            sl = lax.psum_scatter(hp, "data", scatter_dimension=nb,
+                                  tiled=True)
+            lo = lax.axis_index("data") * FH_loc
+            full = jnp.zeros(hp.shape, jnp.float32)
+            full = lax.dynamic_update_slice(
+                full, sl.astype(jnp.float32), (0,) * nb + (lo, 0, 0))
+            return full[..., :FH, :, :] if FH_pad > FH else full
+
+        if bundle is not None:
+            _shard_col = bundle.bundle_of            # (F,) hist column
+        else:
+            _shard_col = jnp.arange(F, dtype=jnp.int32)
+
+        def _split_sharded(hist, parent, mask, key, uid, constraint, depth,
+                           parent_output, cegb_pen=None, hist_scale=None):
+            """Local best split over this shard's feature slice + the
+            SplitInfo sync — FindBestSplitsFromHistograms restricted to
+            OWN features, as the reference data-parallel learner does
+            after its ReduceScatter (data_parallel_tree_learner.cpp:
+            175-199)."""
+            lo = lax.axis_index("data") * FH_loc
+            in_shard = (_shard_col >= lo) & (_shard_col < lo + FH_loc)
+            if bundle is not None:
+                from ..io.bundle import expand_bundle_hist
+
+                # zeroed out-of-shard bundle columns expand to garbage
+                # zero-bin fixes — masked out by in_shard below
+                hist = expand_bundle_hist(hist, parent, bundle, B)
+            rk = jax.random.fold_in(key, uid + 1_000_003 + params.extra_seed) \
+                if params.extra_trees else None
+            local = find_best_split(hist, parent, meta, mask & in_shard,
+                                    params, constraint, depth,
+                                    config.monotone_penalty, parent_output,
+                                    rk, cegb_pen, hist_scale=hist_scale)
+            return _sync_best_split(local, parent, params, "data")
+
+        # integer histograms cannot cross expand_bundle_hist (its zero-bin
+        # fix mixes real-unit parent sums in), so EFB keeps the grower's
+        # pre-dequantized path; the collective still moved int32
+        _split_sharded.accepts_hist_scale = bundle is None
 
         def hist_fn(binned, g3, leaf_id, target):
-            # local histogram + Allreduce — the reference's
-            # ReduceScatter(HistogramSumReducer) + implicit allgather
-            return lax.psum(local_hist(binned, g3, leaf_id, target), "data")
+            h = local_hist(binned, g3, leaf_id, target)
+            return _scatter_keep(h) if use_rs else lax.psum(h, "data")
 
         def sums_fn(g3):
             return lax.psum(g3.sum(axis=0), "data")
 
+        split_dp = _split_sharded if use_rs else split_local
+
         if levelwise:
             def frontier_fn(binned, g3, leaf_id, L_level):
-                return lax.psum(
-                    local_frontier(binned, g3, leaf_id, L_level), "data")
+                h = local_frontier(binned, g3, leaf_id, L_level)
+                return _scatter_keep(h) if use_rs else lax.psum(h, "data")
 
             grow = make_levelwise_grower(
                 hist_frontier_fn=frontier_fn, sums_fn=sums_fn,
-                split_fn=split_local, bins_of_fn=bins_feat_fn,
+                split_fn=split_dp, bins_of_fn=bins_feat_fn,
                 forced_splits=forced, **common)
         elif use_wave and forced is None:
-            # one histogram Allreduce per ROUND (up to 2K child histograms
-            # batched in a single psum) instead of one per split — the wave
+            # one histogram collective per ROUND (up to 2K child
+            # histograms batched) instead of one per split — the wave
             # schedule's distributed dividend
             def wave_fn(binned, g3, label, nslots, deep=False):
-                return lax.psum(
-                    local_wave(binned, g3, label, nslots, deep), "data")
+                h = local_wave(binned, g3, label, nslots, deep)
+                return _scatter_keep(h) if use_rs else lax.psum(h, "data")
 
-            def wave_quant_fn(binned, g3, label, nslots, key):
-                # each shard quantizes with its LOCAL per-pass scales
-                # (unbiasedness is per-row, so the psum of dequantized
-                # shard histograms stays an unbiased estimator); the
-                # psum therefore runs on dequantized values and the
-                # grower sees identity scales
-                h, sc = local_wave_quant(binned, g3, label, nslots, key)
-                h = lax.psum(h * sc[:, None, None, :], "data")
-                return h, jnp.ones_like(sc)
+            if use_rs:
+                def wave_quant_fn(binned, g3, label, nslots, key):
+                    # GLOBAL (pmax'd) scales make the shard partials one
+                    # integer system: the collective reduces raw int32
+                    # and the single dequantize multiply happens at the
+                    # consumer (subtraction pass / split scan hist_scale)
+                    # — the quantized pipeline's cross-chip contract
+                    h, sc = local_wave_quant(binned, g3, label, nslots,
+                                             key, axis_name="data")
+                    return _scatter_keep(h, int_domain=True), sc
+            else:
+                def wave_quant_fn(binned, g3, label, nslots, key):
+                    # legacy allreduce: each shard quantizes with its
+                    # LOCAL per-pass scales (unbiasedness is per-row, so
+                    # the psum of dequantized shard histograms stays an
+                    # unbiased estimator); the psum therefore runs on
+                    # dequantized f32 and the grower sees identity scales
+                    h, sc = local_wave_quant(binned, g3, label, nslots,
+                                             key)
+                    h = lax.psum(h * sc[:, None, None, :], "data")
+                    return h, jnp.ones_like(sc)
 
             grow = make_wave_grower(hist_wave_fn=wave_fn, sums_fn=sums_fn,
                                     hist_wave_quant_fn=(
                                         wave_quant_fn if use_int8sr
                                         else None),
-                                    split_fn=split_local,
+                                    split_fn=split_dp,
                                     bins_of_fn=bins_feat_fn, **wave_common)
         else:
             grow = make_leafwise_grower(hist_fn=hist_fn, sums_fn=sums_fn,
-                                        split_fn=split_local,
+                                        split_fn=split_dp,
                                         bins_of_fn=bins_feat_fn,
                                         forced_splits=forced,
                                         **lw_pool, **common)
@@ -711,6 +906,9 @@ def build_trainer(
         )
         log_info(f"Feature-parallel training over {ndev} devices "
                  f"({F_loc} features/device)")
+        log_info("comm/round (analytic, K=%d wave): %s" % (wave_size,
+                 comm_table_per_round("feature", "allreduce", k=wave_size,
+                                      F=F, B=B, ndev=ndev)))
 
         def hist_fn(binned, g3, leaf_id, target):
             # build histograms only for this device's feature block, placed
@@ -746,7 +944,8 @@ def build_trainer(
         def split_fn(hist, parent, mask, key, uid, constraint, depth,
                      parent_output, cegb_pen=None):
             # search only this device's features, then Allreduce-max over
-            # packed SplitInfo (reference SyncUpGlobalBestSplit)
+            # packed SplitInfo (reference SyncUpGlobalBestSplit) with the
+            # reduction-order-invariant tie-break (_sync_best_split)
             lo = lax.axis_index("feature") * F_loc
             in_shard = (
                 lax.broadcasted_iota(jnp.int32, (F_pad, 1), 0)[:, 0] >= lo
@@ -759,10 +958,7 @@ def build_trainer(
                                     params, constraint, depth,
                                     config.monotone_penalty, parent_output,
                                     rk, cegb_pen)
-            packed = _pack_split(local)
-            allp = lax.all_gather(packed, "feature")        # (ndev, 10)
-            best = jnp.argmax(allp[:, 0])
-            return _unpack_split(allp[best])
+            return _sync_best_split(local, parent, params, "feature")
 
         coupled_fp = _cegb_coupled(config, F)
         if coupled_fp is not None:

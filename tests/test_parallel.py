@@ -215,3 +215,139 @@ def test_voting_levelwise_falls_back_to_data():
     par = _train({"objective": "binary", "tree_learner": "voting",
                   "tree_growth": "levelwise"}, X, y, 2)
     assert par.num_trees() == 2
+
+
+# ---------------------------------------------------------------------------
+# Reduce-scatter collective (feature-sharded split search) — PR 3
+# ---------------------------------------------------------------------------
+
+
+def test_collective_knob_validated():
+    with pytest.raises(ValueError, match="data_parallel_collective"):
+        Config.from_dict({"objective": "binary",
+                          "data_parallel_collective": "ring"})
+
+
+@pytest.mark.parametrize("shards", [2, 8])
+def test_reduce_scatter_vs_allreduce_vs_serial_bit_identical(shards):
+    """The three paths sum histograms in different orders (serial sum /
+    psum / psum_scatter); the tie_tol band in the split argmax makes the
+    chosen trees invariant to that — bit-identical structure across
+    collectives and device counts."""
+    X, y = make_binary_problem(1100, f=7)
+    serial = _train({"objective": "binary"}, X, y)
+    rs = _train({"objective": "binary", "tree_learner": "data",
+                 "num_shards": shards}, X, y)
+    ar = _train({"objective": "binary", "tree_learner": "data",
+                 "num_shards": shards,
+                 "data_parallel_collective": "allreduce"}, X, y)
+    s_sig, r_sig, a_sig = (_tree_signature(g) for g in (serial, rs, ar))
+    for s, r, a in zip(s_sig, r_sig, a_sig):
+        assert s[:3] == r[:3] == a[:3]      # leaves, features, thresholds
+        np.testing.assert_allclose(s[3], r[3], rtol=1e-3, atol=1e-5)
+        np.testing.assert_allclose(s[3], a[3], rtol=1e-3, atol=1e-5)
+
+
+def test_reduce_scatter_feature_count_not_divisible():
+    """F % D != 0: the feature axis is padded to the shard grid and the
+    trailing shards own padding-only slices (their local best is -inf and
+    the SplitInfo sync ignores them)."""
+    X, y = make_binary_problem(900, f=11)    # 11 % 8 != 0
+    serial = _train({"objective": "binary"}, X, y, 3)
+    par = _train({"objective": "binary", "tree_learner": "data"}, X, y, 3)
+    assert [s[:3] for s in _tree_signature(serial)] == \
+        [p[:3] for p in _tree_signature(par)]
+    np.testing.assert_allclose(
+        serial.raw_train_scores(), par.raw_train_scores(), rtol=1e-3,
+        atol=1e-5)
+
+
+def test_reduce_scatter_levelwise_matches_serial():
+    """The level-wise grower rides the same psum_scatter + SplitInfo-sync
+    wrappers as the wave grower."""
+    X, y = make_binary_problem(900, f=6)
+    serial = _train({"objective": "binary", "tree_growth": "levelwise"},
+                    X, y, 3)
+    par = _train({"objective": "binary", "tree_growth": "levelwise",
+                  "tree_learner": "data"}, X, y, 3)
+    assert [s[:3] for s in _tree_signature(serial)] == \
+        [p[:3] for p in _tree_signature(par)]
+
+
+def _train_int8sr_parallel(over, X, y, rounds=3):
+    cfg = {"objective": "binary", "num_leaves": 64,
+           "leafwise_wave_size": 32, "min_data_in_leaf": 5, "seed": 7,
+           "hist_dtype_deep": "int8sr", **over}
+    return _train(cfg, X, y, rounds)
+
+
+def test_int8sr_reduce_scatter_round_trains(monkeypatch):
+    """An int8sr quantized round under the reduce-scatter collective:
+    global (pmax'd) scales + raw int32 partial histograms through
+    psum_scatter, dequantization folded into the local split scan.  Same
+    seed -> bit-identical runs (counter-based rounding); quality tracks
+    the serial int8sr run."""
+    import lightgbmv1_tpu.models.grower_wave as gw
+
+    monkeypatch.setattr(gw, "_BUCKET_MIN_N", 1)
+    X, y = make_binary_problem(2000, f=8)
+    a = _train_int8sr_parallel({"tree_learner": "data"}, X, y)
+    b = _train_int8sr_parallel({"tree_learner": "data"}, X, y)
+    np.testing.assert_array_equal(a.raw_train_scores(),
+                                  b.raw_train_scores())
+    serial = _train_int8sr_parallel({}, X, y)
+    acc_p = (((a.raw_train_scores()[:, 0]) > 0) == (y > 0.5)).mean()
+    acc_s = (((serial.raw_train_scores()[:, 0]) > 0) == (y > 0.5)).mean()
+    assert acc_p > 0.9 and abs(acc_p - acc_s) < 0.05
+
+
+def test_int8sr_collective_moves_int32(monkeypatch):
+    """The acceptance bar of the integer-domain pipeline: quantized
+    rounds' reduce-scatter ops carry i32 elements (f32 would mean the
+    PR-2-era dequantize-before-collective fallback snuck back)."""
+    import re
+
+    import jax
+    import jax.numpy as jnp
+
+    import lightgbmv1_tpu.models.grower_wave as gw
+    from lightgbmv1_tpu.io.dataset import BinnedDataset
+    from lightgbmv1_tpu.models.gbdt import create_boosting
+
+    monkeypatch.setattr(gw, "_BUCKET_MIN_N", 1)
+    X, y = make_binary_problem(800, f=6)
+    cfg = Config.from_dict({
+        "objective": "binary", "verbosity": -1, "min_data_in_leaf": 5,
+        "tree_learner": "data", "num_leaves": 64,
+        "leafwise_wave_size": 32, "hist_dtype_deep": "int8sr"})
+    ds = BinnedDataset.from_numpy(X, label=y, config=cfg)
+    gb = create_boosting(cfg, ds)
+    txt = gb._grow.lower(
+        gb._grow_binned, jnp.zeros((800, 3), jnp.float32),
+        jnp.ones(6, bool), jax.random.PRNGKey(0),
+        jnp.zeros(6, bool)).as_text()
+    dtypes = set()
+    for m in re.finditer('"stablehlo.reduce_scatter"', txt):
+        dtypes.update(re.findall(r"tensor<[0-9x]*([a-z][0-9]+)>",
+                                 txt[m.start():m.start() + 400]))
+    assert "i32" in dtypes, dtypes
+
+
+def test_int8sr_voting_selective_reduce_integer_domain(monkeypatch):
+    """Satellite: the voting learner's selective reduce honors the int8sr
+    integer domain.  Forcing the pool-free (no-subtraction) wave path
+    hands split_fn the raw integer histograms; with global scales the
+    voting and data learners then reduce the IDENTICAL integer system, so
+    with top_k >= F their trees must agree exactly."""
+    import lightgbmv1_tpu.models.grower_wave as gw
+
+    monkeypatch.setattr(gw, "_BUCKET_MIN_N", 1)
+    monkeypatch.setattr(gw, "_SUB_STATE_CAP_BYTES", 0)
+    X, y = make_binary_problem(2000, f=8)
+    vote = _train_int8sr_parallel({"tree_learner": "voting", "top_k": 8},
+                                  X, y)
+    data = _train_int8sr_parallel({"tree_learner": "data"}, X, y)
+    v_sig, d_sig = _tree_signature(vote), _tree_signature(data)
+    for v, d in zip(v_sig, d_sig):
+        assert v[:3] == d[:3]
+        np.testing.assert_allclose(v[3], d[3], rtol=1e-3, atol=1e-5)

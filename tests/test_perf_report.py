@@ -47,3 +47,48 @@ def test_headline_numbers_grep_to_record():
         if rec.get(key) is not None:
             assert perf_report.fmt(rec[key], 4).rstrip("x") in on_disk \
                 or f"{rec[key]}" in on_disk, key
+
+
+def test_comm_guard_and_table():
+    """The comm-bytes regression guard (PR 3): reduce-scatter histogram
+    bytes must beat allreduce by ~D; a silent fallback to a full-width
+    reduction (or an allgather of the scattered slices) must trip it."""
+    sys.path.insert(0, REPO)
+    from lightgbmv1_tpu.parallel.cluster import (comm_guard_ok,
+                                                 comm_table_per_round)
+
+    D, F, B, K = 8, 16, 64, 16
+    rs = comm_table_per_round("data", "reduce_scatter", k=K, F=F, B=B,
+                              ndev=D)
+    ar = comm_table_per_round("data", "allreduce", k=K, F=F, B=B, ndev=D)
+    assert rs["hist_bytes"] * D == ar["hist_bytes"]   # exact D-fold (F%D==0)
+    assert ar["split_sync_bytes"] == 0                # replicated selection
+    assert rs["split_sync_bytes"] > 0                 # SplitInfo sync
+    assert comm_guard_ok(rs["hist_bytes"], ar["hist_bytes"], D)
+    assert not comm_guard_ok(ar["hist_bytes"], ar["hist_bytes"], D)
+    assert not comm_guard_ok(ar["hist_bytes"] // 2, ar["hist_bytes"], D)
+    # non-divisible F pads the shard grid: bytes quantize UP, never down
+    rs11 = comm_table_per_round("data", "reduce_scatter", k=K, F=11, B=B,
+                                ndev=D)
+    assert rs11["hist_bytes"] == rs["hist_bytes"]     # 11 -> padded to 16
+    # feature-parallel never reduces histograms; voting reduces 2k
+    # children of the selected set
+    assert comm_table_per_round("feature", "allreduce", k=K, F=F, B=B,
+                                ndev=D)["hist_bytes"] == 0
+    vt = comm_table_per_round("voting", "reduce_scatter", k=K, F=F, B=B,
+                              ndev=D, sel_k=F)
+    assert vt["vote_bytes"] > 0
+
+
+def test_comm_section_renders_in_perf_md():
+    """PERF.md (generated output) must carry the Cross-chip comms section
+    and its figures must grep to the analytic formula."""
+    sys.path.insert(0, REPO)
+    from lightgbmv1_tpu.parallel.cluster import comm_table_per_round
+
+    with open(os.path.join(REPO, "PERF.md")) as fh:
+        txt = fh.read()
+    assert "## Cross-chip comms" in txt
+    rs = comm_table_per_round("data", "reduce_scatter", k=16, F=16, B=64,
+                              ndev=8)
+    assert str(rs["hist_bytes"]) in txt

@@ -157,3 +157,73 @@ def test_leaf_output_l1_l2():
     p2 = SplitParams(max_delta_step=0.1)
     out2 = float(leaf_output(jnp.asarray(5.0), jnp.asarray(1.0), p2))
     np.testing.assert_allclose(out2, -0.1)
+
+
+# ---------------------------------------------------------------------------
+# Deterministic near-tie resolution (reduction-order invariance, PR 3)
+# ---------------------------------------------------------------------------
+
+
+def test_exact_tie_prefers_lower_feature():
+    """Two features with IDENTICAL histograms (an exact gain tie): the
+    split must land on the lower feature id, invariant to how the
+    histogram was reduced (SplitInfo::operator> tie-break)."""
+    B = 8
+    hist_f = np.zeros((B, 3), np.float32)
+    hist_f[:, 0] = [-4, -3, -2, -1, 1, 2, 3, 4]
+    hist_f[:, 1] = 1.0
+    hist_f[:, 2] = 10.0
+    hist = np.stack([hist_f, hist_f, hist_f])         # 3 identical features
+    parent = hist[0].sum(axis=0)
+    meta = make_meta([B, B, B])
+    params = SplitParams(min_data_in_leaf=0)
+    res = find_best_split(jnp.asarray(hist), jnp.asarray(parent), meta,
+                          jnp.ones(3, bool), params)
+    assert float(res.gain) > 0
+    assert int(res.feature) == 0
+
+
+def test_near_tie_within_tolerance_is_order_invariant():
+    """Perturb the tied copy by less than the tie_tol band (the magnitude
+    of psum-vs-serial f32 summation-order noise): the pick must STILL be
+    the lower feature, in either perturbation direction — the fix for the
+    psum near-tie threshold flips tests/test_parallel.py[data] pinned."""
+    from lightgbmv1_tpu.ops.split import TIE_RTOL
+
+    B = 8
+    hist_f = np.zeros((B, 3), np.float32)
+    hist_f[:, 0] = [-4, -3, -2, -1, 1, 2, 3, 4]
+    hist_f[:, 1] = 1.0
+    hist_f[:, 2] = 10.0
+    for sign in (+1.0, -1.0):
+        bumped = hist_f.copy()
+        # ~2 ulp-scale relative bump on the gradient channel — well inside
+        # the tie band, the size of a reduction-order flip
+        bumped[:, 0] *= 1.0 + sign * 0.05 * TIE_RTOL
+        hist = np.stack([hist_f, bumped])
+        parent = hist[0].sum(axis=0)
+        meta = make_meta([B, B])
+        params = SplitParams(min_data_in_leaf=0)
+        res = find_best_split(jnp.asarray(hist), jnp.asarray(parent), meta,
+                              jnp.ones(2, bool), params)
+        assert int(res.feature) == 0, sign
+
+
+def test_genuinely_distinct_gains_not_tied():
+    """A gain gap far above the band must still pick the strictly better
+    feature even when it has the HIGHER id (the tolerance must not bleed
+    into real decisions — the golden-parity guarantee)."""
+    B = 8
+    weak = np.zeros((B, 3), np.float32)
+    weak[:, 0] = [-1, 1, -1, 1, -1, 1, -1, 1]
+    weak[:, 1] = 1.0
+    weak[:, 2] = 10.0
+    strong = weak.copy()
+    strong[:, 0] = [-4, -3, -2, -1, 1, 2, 3, 4]
+    hist = np.stack([weak, strong])
+    parent = hist[0].sum(axis=0)
+    meta = make_meta([B, B])
+    params = SplitParams(min_data_in_leaf=0)
+    res = find_best_split(jnp.asarray(hist), jnp.asarray(parent), meta,
+                          jnp.ones(2, bool), params)
+    assert int(res.feature) == 1

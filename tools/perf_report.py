@@ -16,15 +16,106 @@ r04->r05 roofline-denominator drift).
 import glob
 import json
 import os
+import re
 import sys
 
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# The dryrun smoke shape (tools/dryrun_multichip, __graft_entry__.py):
+# the analytic comm table below is computed at exactly these constants so
+# every figure greps to a formula input, not a hand-typed number.
+SMOKE = dict(ndev=8, F=16, B=64, K=16, top_k=20)
 
 
 def load(path):
     with open(path) as fh:
         rec = json.load(fh)
     return rec.get("parsed", rec)
+
+
+def load_multichip(root=ROOT):
+    """Newest MULTICHIP_r*.json whose captured tail carries the dryrun
+    PARITY record (older captures were liveness-only).  Returns
+    ``(name, parsed record or None)``."""
+    recs = sorted(glob.glob(os.path.join(root, "MULTICHIP_r*.json")))
+    for path in reversed(recs):
+        try:
+            with open(path) as fh:
+                rec = json.load(fh)
+        except ValueError:
+            continue
+        m = re.search(r"dryrun_multichip PARITY (\{.*\})",
+                      rec.get("tail", ""))
+        if m:
+            try:
+                return os.path.basename(path), json.loads(m.group(1))
+            except ValueError:
+                continue
+    return (os.path.basename(recs[-1]) if recs else None), None
+
+
+def comm_section(w, mc_name, mc):
+    """Cross-chip comms: the analytic per-round byte table of every
+    learner at the dryrun smoke shape (single source of truth:
+    lightgbmv1_tpu.parallel.cluster.comm_table_per_round — the same
+    function the trainer logs at build time and dryrun_multichip records),
+    plus the measured-record guard when a MULTICHIP capture carries it."""
+    try:
+        if ROOT not in sys.path:
+            sys.path.insert(0, ROOT)
+        from lightgbmv1_tpu.parallel.cluster import comm_table_per_round
+    except Exception as e:  # noqa: BLE001 — report generation must not die
+        w(f"(comm table unavailable: {type(e).__name__})")
+        w("")
+        return
+    w("## Cross-chip comms (per sustained wave round, analytic)")
+    w("")
+    w(f"Output-payload bytes per device per K={SMOKE['K']}-split round at "
+      f"the dryrun smoke shape (D={SMOKE['ndev']}, F={SMOKE['F']}, "
+      f"B={SMOKE['B']}; parallel/cluster.py comm_table_per_round — the "
+      "trainer logs the same table at build time):")
+    w("")
+    w("| learner / collective | histogram | split sync | votes | total |")
+    w("|---|---|---|---|---|")
+    rows = (
+        ("data / reduce_scatter", "data", "reduce_scatter", None),
+        ("data / allreduce (parity pin)", "data", "allreduce", None),
+        ("voting / reduce_scatter", "voting", "reduce_scatter",
+         min(2 * SMOKE["top_k"], SMOKE["F"])),
+        ("feature", "feature", "allreduce", None),
+    )
+    for label, learner, coll, sel_k in rows:
+        t = comm_table_per_round(learner, coll, k=SMOKE["K"],
+                                 F=SMOKE["F"], B=SMOKE["B"],
+                                 ndev=SMOKE["ndev"], sel_k=sel_k)
+        w(f"| {label} | {t['hist_bytes']} | {t['split_sync_bytes']} | "
+          f"{t.get('vote_bytes', '—')} | {t['total_bytes']} |")
+    w("")
+    w("The reduce-scatter path keeps F/D features per chip and syncs only "
+      "packed SplitInfo (the reference's ReduceScatter + "
+      "SyncUpGlobalBestSplit mapping); int8sr rounds move raw int32 "
+      "through the histogram collective (ops/quantize.py global scales).")
+    w("")
+    if mc and mc.get("comm_bytes_per_round"):
+        w(f"Measured-record table (`{mc_name}`, replayed wave schedule, "
+          f"mean-k rounds, D={mc.get('n_devices')}):")
+        w("")
+        w("| learner | histogram | split sync | total | dtype |")
+        w("|---|---|---|---|---|")
+        for name, t in mc["comm_bytes_per_round"].items():
+            w(f"| {name} | {t.get('hist_bytes')} | "
+              f"{t.get('split_sync_bytes')} | {t.get('total_bytes')} | "
+              f"{t.get('hist_dtype')} |")
+        w("")
+        w(f"Comm guard `comm_ok={mc.get('comm_ok')}` (reduce-scatter "
+          "histogram bytes must be <= allreduce / (D*0.9); "
+          "cluster.comm_guard_ok — the dryrun asserts it, this report "
+          "surfaces it).")
+    else:
+        w("No MULTICHIP capture with a PARITY record yet — the next "
+          "driver run of tools/dryrun_multichip records the measured "
+          "table and the `comm_ok` guard into the MULTICHIP record.")
+    w("")
 
 
 def fmt(v, nd=2):
@@ -219,6 +310,9 @@ def generate(rec, name, prev=None, prev_name=None):
             w(f"| reference CLI task=predict | "
               f"{get(rec, 'predict_ref_cpp_M_rows_per_s', 3)} | — |")
         w("")
+
+    mc_name, mc = load_multichip()
+    comm_section(w, mc_name, mc)
 
     w("## Provenance")
     w("")
