@@ -592,14 +592,7 @@ class Booster:
                              else len(trees) // K)
         trees = trees[start_iteration * K: (start_iteration + num_iteration) * K]
 
-        if pred_leaf:
-            out = np.stack([t.predict_leaf_index(X) for t in trees], axis=1)
-            return out
-        if pred_contrib:
-            return self._predict_contrib(X, trees, K)
-
         n = X.shape[0]
-        raw = np.zeros((n, K), dtype=np.float64)
         es = bool(kwargs.get("pred_early_stop",
                              self.params.get("pred_early_stop", False)))
         es_freq = int(kwargs.get("pred_early_stop_freq",
@@ -607,7 +600,38 @@ class Booster:
         es_margin = float(kwargs.get(
             "pred_early_stop_margin",
             self.params.get("pred_early_stop_margin", 10.0)))
-        if es and not raw_score:
+
+        # device inference engine (models/predict.py): depth-stepped
+        # all-trees walk / Pallas kernel / legacy scan pin, behind
+        # predict_method; contrib and prediction-early-stop stay host-side
+        method = str(kwargs.get("predict_method",
+                                self.params.get("predict_method", "auto")))
+        raw = None
+        if method in ("depthwise", "pallas", "scan") and trees \
+                and not pred_contrib and not (es and not raw_score):
+            bp = self._device_predictor(trees, K, start_iteration, method,
+                                        kwargs)
+            if bp is not None:
+                if pred_leaf:
+                    return bp.predict_leaf(X)
+                f64 = bool(kwargs.get(
+                    "predict_f64_scores",
+                    self.params.get("predict_f64_scores", False)))
+                raw = np.asarray(bp.predict_raw(X, f64_exact=f64),
+                                 np.float64)
+                if raw.shape[1] != K:   # scan pin returns (N, 1)
+                    raw = raw.reshape(n, K)
+
+        if pred_leaf:
+            out = np.stack([t.predict_leaf_index(X) for t in trees], axis=1)
+            return out
+        if pred_contrib:
+            return self._predict_contrib(X, trees, K)
+
+        if raw is not None:
+            pass
+        elif es and not raw_score:
+            raw = np.zeros((n, K), dtype=np.float64)
             # reference: PredictionEarlyStopInstance
             # (src/boosting/prediction_early_stop.cpp:75) — every freq trees,
             # rows whose decision margin exceeds the threshold stop
@@ -629,8 +653,11 @@ class Booster:
                         margin = part[:, K - 1] - part[:, K - 2]
                     active[idx[margin >= es_margin]] = False
         else:
+            raw = np.zeros((n, K), dtype=np.float64)
             native = None
-            if n * len(trees) >= _NATIVE_PREDICT_MIN_WORK:
+            if method == "native" or (
+                    method != "host"
+                    and n * len(trees) >= _NATIVE_PREDICT_MIN_WORK):
                 # native C++ predictor (the reference Predictor role,
                 # predictor.hpp:29-160): per-row walks over flattened
                 # arrays, threaded; ~10x the vectorized numpy walk
@@ -684,6 +711,40 @@ class Booster:
             return None
         nt = int(self.params.get("num_threads", 0) or 0)
         return predict_ensemble(X, pack, num_threads=nt)
+
+    def _device_predictor(self, trees, K, start_iteration, method, kwargs):
+        """Device inference engine (models/predict.BatchPredictor), cached
+        per (slice start, tree count, model version, method) — the same
+        key discipline as the native pack cache: any ensemble mutation
+        (update/rollback/DART drop-rescale) moves ``model_version`` and
+        drops the predictor, its serving tables and its compiled-walk
+        cache wholesale.  None -> host fallback (e.g. categorical model
+        without raw category sets, scan with K>1)."""
+        key = (start_iteration, len(trees),
+               self._gbdt.model_version if self._gbdt is not None else -1,
+               method)
+        cached = getattr(self, "_device_pred_cache", None)
+        if cached is not None and cached[0] == key:
+            return cached[1]
+        from .models.predict import BatchPredictor
+
+        def p(name, dflt):
+            return kwargs.get(name, self.params.get(name, dflt))
+
+        try:
+            bp = BatchPredictor(
+                trees, K, self.num_feature(), method=method,
+                prebin=str(p("predict_prebin", "auto")),
+                num_shards=int(p("predict_num_shards", 0)),
+                bucket_min=int(p("predict_bucket_min", 256)),
+                chunk_rows=int(p("predict_chunk_rows", 131072)),
+            )
+        except Exception as e:  # noqa: BLE001 — host fallback
+            log_warning(f"device predict unavailable "
+                        f"({type(e).__name__}: {e}); using the host path")
+            bp = None
+        self._device_pred_cache = (key, bp)
+        return bp
 
     def refit(self, data, label, decay_rate: float = 0.9) -> "Booster":
         """Refit the existing model's leaf values on new data

@@ -173,14 +173,25 @@ def run_train(config: Config) -> Booster:
 
 
 def run_predict(config: Config) -> None:
-    """reference: Application::Predict → Predictor, predictor.hpp:29-160."""
+    """reference: Application::Predict → Predictor, predictor.hpp:29-160.
+
+    The file->file window decomposes into parse / predict / write; with a
+    device ``predict_method`` the predict leg streams through the batched
+    inference engine (models/predict.py) — prebinned serving codes and
+    double-buffered host->device chunks, so H2D of chunk i+1 overlaps the
+    walk of chunk i.  Component times are logged so the split matches
+    bench.py's measure_predict fields."""
     if not config.input_model:
         log_fatal("No model file: set input_model=<file>")
     if not config.data:
         log_fatal("No prediction data: set data=<file>")
-    booster = Booster(model_file=config.input_model)
+    # the Config rides into Booster.params so predict_method /
+    # predict_prebin / bucket knobs reach the predict routing
+    booster = Booster(params=_config_to_params(config),
+                      model_file=config.input_model)
     log_info("Finished initializing prediction, total used "
              f"{booster.current_iteration()} iterations")
+    t0 = time.time()
     # honor the same loader options as training (header/label/ignore cols)
     df = load_data_file(
         config.data,
@@ -194,6 +205,7 @@ def run_predict(config: Config) -> None:
     X = df.X
     if X.shape[1] == booster.num_feature() + 1:
         X = X[:, 1:]   # prediction files may still carry the label column
+    t_parse = time.time()
     out = booster.predict(
         X,
         raw_score=config.predict_raw_score,
@@ -207,11 +219,16 @@ def run_predict(config: Config) -> None:
         pred_early_stop_margin=config.pred_early_stop_margin,
         predict_disable_shape_check=config.predict_disable_shape_check,
     )
+    t_pred = time.time()
     out = np.asarray(out)
     if out.ndim == 1:
         out = out[:, None]
     fmt = "%d" if config.predict_leaf_index else "%.18g"
     np.savetxt(config.output_result, out, fmt=fmt, delimiter="\t")
+    t1 = time.time()
+    log_info(f"Prediction window: parse {t_parse - t0:.3f}s, predict "
+             f"{t_pred - t_parse:.3f}s ({config.predict_method}), write "
+             f"{t1 - t_pred:.3f}s ({X.shape[0]} rows)")
     log_info("Finished prediction")
 
 

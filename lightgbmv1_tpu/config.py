@@ -357,6 +357,31 @@ class Config:
     # for A/B measurement (tools/dryrun_multichip records both).
     data_parallel_collective: str = "reduce_scatter"
     num_shards: int = 0            # devices for data-parallel (0 = all available)
+    # -- serving (models/predict.py batched inference engine) ----------
+    # prediction engine: "auto" keeps the host routing (native C++ bulk
+    # predictor above the work threshold, vectorized numpy below);
+    # "native"/"host" force those; "depthwise" is the depth-stepped
+    # all-trees device walk; "pallas" pins the node tables in VMEM
+    # (ops/predict_pallas.py, falls back to depthwise if Mosaic cannot
+    # lower on the backend); "scan" is the legacy per-tree scan walk,
+    # kept as the bit-parity pin.
+    predict_method: str = "auto"
+    # prebinned serving codes (uint8/uint16) for the device walks: "auto"
+    # = on whenever the ensemble's thresholds admit an EXACT serving
+    # binning (models/predict.build_serving_binner), else the raw-f32
+    # walk; "on"/"off" force it (on falls back with a warning when
+    # exactness is impossible)
+    predict_prebin: str = "auto"
+    predict_bucket_min: int = 256   # smallest power-of-two row bucket of
+                                    # the predictor's compile cache
+    predict_chunk_rows: int = 131072  # streaming chunk: bounds device
+                                    # memory and double-buffers H2D
+    predict_num_shards: int = 0     # >1: rows sharded over the mesh
+                                    # (parallel/cluster.make_mesh)
+    # reconstruct raw scores host-side in float64 from device leaf
+    # indices (bit-identical to the native C++ predictor); default off —
+    # the on-device f32 sum is the fast serving path
+    predict_f64_scores: bool = False
     profile_dir: str = ""          # write a jax.profiler device trace of
                                    # training here; hist/split/partition
                                    # phases carry lgbm.* named scopes (the
@@ -482,6 +507,15 @@ class Config:
                 f"data_parallel_collective="
                 f"{self.data_parallel_collective!r}: expected "
                 "reduce_scatter | allreduce")
+        if self.predict_method not in (
+                "auto", "native", "host", "depthwise", "pallas", "scan"):
+            raise ValueError(
+                f"predict_method={self.predict_method!r}: expected auto | "
+                "native | host | depthwise | pallas | scan")
+        if self.predict_prebin not in ("auto", "on", "off"):
+            raise ValueError(
+                f"predict_prebin={self.predict_prebin!r}: expected "
+                "auto | on | off")
         if self.hist_dtype_deep not in (
                 "", "f32", "bf16", "bf16x2", "int8", "int8sr"):
             raise ValueError(

@@ -121,6 +121,17 @@ def train(
             break
         if finished:
             break
+    if not keep_training_booster:
+        # reference engine.py:18 (keep_training_booster=False): hand back
+        # a prediction-only model — the training state (scores, histogram
+        # caches, device trees) is dropped and the returned Booster is the
+        # lean serving object (model text round-trip; the device
+        # predictor cache attaches to it on first predict)
+        serving = Booster(model_str=booster.model_to_string())
+        serving.params = dict(booster.params)
+        serving.best_iteration = booster.best_iteration
+        serving.best_score = booster.best_score
+        return serving
     return booster
 
 

@@ -24,7 +24,7 @@ import numpy as np
 
 from ..utils.log import log_fatal, log_warning
 from ..io.binning import MISSING_NAN, MISSING_NONE, MISSING_ZERO
-from ..models.tree import HostTree
+from ..models.tree import HostTree, validate_host_tree
 
 _K_CATEGORICAL_MASK = 1
 _K_DEFAULT_LEFT_MASK = 2
@@ -174,6 +174,13 @@ def _parse_tree_block(block: str) -> HostTree:
     t.internal_weight = arr("internal_weight", np.float64, n_nodes)
     t.internal_count = arr("internal_count", np.int64, n_nodes)
     t.threshold_bin = np.zeros(n_nodes, np.int32)  # not stored in text
+    # child-pointer structural validation (cycles, out-of-range children,
+    # reconvergence): a malformed model file used to HANG the predictor's
+    # ``while any(active)`` walks; fail the load instead
+    try:
+        validate_host_tree(t, index)
+    except ValueError as e:
+        log_fatal(f"Invalid model file: {e}")
     # reconstruct leaf_parent from children
     t.leaf_parent = np.full(n, -1, np.int32)
     for nd in range(n_nodes):

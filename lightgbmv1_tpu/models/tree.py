@@ -10,6 +10,11 @@ round-trips: internal nodes are numbered in split order; ``left_child`` /
 its current node index and a ``lax.while_loop`` advances all rows together
 (the reference's per-row ``Tree::Predict`` walk, tree.h:132, becomes a
 gather + select per level).
+
+Deployment-scale batched inference lives in ``models/predict.py`` (the
+depth-stepped all-trees walk, prebinned serving codes, predictor cache);
+this module keeps the single-tree training-time walks, the stacked-scan
+parity pin, and the shared host-side structure validators.
 """
 
 from __future__ import annotations
@@ -139,13 +144,19 @@ def tree_leaf_index_binned(
     zero_bins=None,           # (F,) int32 — zero-as-missing routing
 ) -> jax.Array:               # (N,) int32 leaf index per row
     N = binned.shape[1]
+    # Walks are BOUNDED by the node count: an acyclic root-to-leaf path
+    # visits each internal node at most once, so `n_nodes` steps always
+    # suffice; a malformed/cyclic model (caught at model-text load by
+    # validate_host_tree, but constructible via the array API) terminates
+    # instead of hanging the predictor.
+    max_steps = int(tree.split_feature.shape[0]) + 1
 
     def cond(state):
-        node, _ = state
-        return jnp.any(node >= 0)
+        node, it = state
+        return jnp.any(node >= 0) & (it < max_steps)
 
     def body(state):
-        node, _ = state
+        node, it = state
         active = node >= 0
         nd = jnp.maximum(node, 0)
         f = tree.split_feature[nd]
@@ -178,12 +189,12 @@ def tree_leaf_index_binned(
         go_left = jnp.where(tree.is_cat[nd], in_set, go_left)
         nxt = jnp.where(go_left, tree.left_child[nd], tree.right_child[nd])
         node = jnp.where(active, nxt, node)
-        return node, active
+        return node, it + 1
 
     node0 = jnp.where(tree.num_leaves > 1,
                       jnp.zeros(N, jnp.int32),
                       jnp.full(N, -1, jnp.int32))
-    node, _ = lax.while_loop(cond, body, (node0, jnp.ones(N, bool)))
+    node, _ = lax.while_loop(cond, body, (node0, jnp.asarray(0, jnp.int32)))
     return -node - 1   # ~node
 
 
@@ -240,11 +251,17 @@ def tree_predict_raw(tree: TreeArrays, X: jax.Array) -> jax.Array:
     walk (Booster.predict) or the binned path; raw categorical decisions
     need the raw->bin category dictionary, which lives host-side."""
     N = X.shape[0]
+    # bounded like tree_leaf_index_binned: a cyclic child graph must
+    # terminate (garbage scores beat a hung predictor; load-time
+    # validation is the correctness gate)
+    max_steps = int(tree.split_feature.shape[0]) + 1
 
     def cond(state):
-        return jnp.any(state >= 0)
+        node, it = state
+        return jnp.any(node >= 0) & (it < max_steps)
 
-    def body(node):
+    def body(state):
+        node, it = state
         active = node >= 0
         nd = jnp.maximum(node, 0)
         f = tree.split_feature[nd]
@@ -262,12 +279,12 @@ def tree_predict_raw(tree: TreeArrays, X: jax.Array) -> jax.Array:
         )
         go_left = jnp.where(is_missing, dl, v0 <= t)
         nxt = jnp.where(go_left, tree.left_child[nd], tree.right_child[nd])
-        return jnp.where(active, nxt, node)
+        return jnp.where(active, nxt, node), it + 1
 
     node0 = jnp.where(tree.num_leaves > 1,
                       jnp.zeros(N, jnp.int32),
                       jnp.full(N, -1, jnp.int32))
-    node = lax.while_loop(cond, body, node0)
+    node, _ = lax.while_loop(cond, body, (node0, jnp.asarray(0, jnp.int32)))
     return tree.leaf_value[-node - 1]
 
 
@@ -337,13 +354,103 @@ def host_trees_to_stacked(trees, num_leaves: int = 0) -> TreeArrays:
 
 
 def ensemble_predict_raw(stacked: TreeArrays, X: jax.Array) -> jax.Array:
-    """Sum of all stacked trees' raw predictions for each row."""
+    """Sum of all stacked trees' raw predictions for each row.
+
+    PARITY PIN: the sequential per-tree scan walk (one data-dependent
+    while-loop per tree).  Deployment prediction routes through the
+    depth-stepped all-trees walk (models/predict.serving_leaf_raw /
+    serving_leaf_binned); this path is kept as the bit-parity reference
+    and is reachable via ``predict_method=scan``."""
 
     def step(acc, tree):
         return acc + tree_predict_raw(tree, X), None
 
     out, _ = lax.scan(step, jnp.zeros(X.shape[0], jnp.float32), stacked)
     return out
+
+
+def leaves_to_scores(leaf_value: jax.Array, leaf: jax.Array,
+                     K: int) -> jax.Array:
+    """(N, T) leaf indices + (T, L) stacked leaf values -> (N, K) raw
+    scores, class k summing trees ``k, k+K, k+2K, ...`` (iteration-major
+    tree order, reference GBDT::PredictRaw)."""
+    N, T = leaf.shape
+    ti = jnp.arange(T, dtype=jnp.int32)[None, :]
+    vals = leaf_value[ti, leaf]                            # (N, T)
+    return vals.reshape(N, T // K, K).sum(axis=1)
+
+
+def validate_host_tree(t, index: int = -1) -> None:
+    """Child-pointer structural validation (cycle / out-of-range /
+    reconvergence / unreachable-leaf detection).  A malformed model file
+    previously HUNG the bounded-by-``any(active)`` while-loop walks; now
+    load fails loudly here and the device walks are step-bounded as
+    defense in depth.  Raises ``ValueError``."""
+    n = int(t.num_leaves)
+    where = f"tree {index}" if index >= 0 else "tree"
+    if n <= 1:
+        return
+    n_nodes = n - 1
+    lc = np.asarray(t.left_child)
+    rc = np.asarray(t.right_child)
+    if len(lc) < n_nodes or len(rc) < n_nodes:
+        raise ValueError(f"{where}: child arrays shorter than num_leaves-1")
+    seen = np.zeros(n_nodes, bool)
+    seen_leaf = np.zeros(n, bool)
+    seen[0] = True
+    stack = [0]
+    while stack:
+        nd = stack.pop()
+        for c in (int(lc[nd]), int(rc[nd])):
+            if c >= 0:
+                if c >= n_nodes:
+                    raise ValueError(
+                        f"{where}: child index {c} out of range "
+                        f"(num_leaves={n})")
+                if seen[c]:
+                    raise ValueError(
+                        f"{where}: node {c} reached twice — cyclic or "
+                        "reconvergent child pointers")
+                seen[c] = True
+                stack.append(c)
+            else:
+                leaf = -c - 1
+                if leaf >= n:
+                    raise ValueError(
+                        f"{where}: leaf index {leaf} out of range "
+                        f"(num_leaves={n})")
+                if seen_leaf[leaf]:
+                    raise ValueError(
+                        f"{where}: leaf {leaf} reached twice — malformed "
+                        "child pointers")
+                seen_leaf[leaf] = True
+    if not seen.all():
+        raise ValueError(f"{where}: unreachable internal nodes "
+                         f"{np.flatnonzero(~seen).tolist()}")
+    if not seen_leaf.all():
+        raise ValueError(f"{where}: unreachable leaves "
+                         f"{np.flatnonzero(~seen_leaf).tolist()}")
+
+
+def host_tree_depth(t) -> int:
+    """Max root-to-leaf decision count (edges).  Assumes a validated
+    tree; guards the level walk by the node count regardless."""
+    n = int(t.num_leaves)
+    if n <= 1:
+        return 0
+    n_nodes = n - 1
+    lc = np.asarray(t.left_child)
+    rc = np.asarray(t.right_child)
+    depth = 0
+    frontier = [0]
+    while frontier and depth <= n_nodes:
+        depth += 1
+        frontier = [c for nd in frontier for c in (int(lc[nd]), int(rc[nd]))
+                    if c >= 0]
+    if frontier:
+        raise ValueError("host_tree_depth: path longer than the node "
+                         "count — cyclic child pointers")
+    return depth
 
 
 # ---------------------------------------------------------------------------

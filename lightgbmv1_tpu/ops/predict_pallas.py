@@ -1,0 +1,115 @@
+"""Pallas TPU inference kernel — VMEM-pinned node tables.
+
+The XLA depth-stepped walk (models/predict.serving_leaf_binned) re-reads
+the stacked node tables from HBM on every one of its ``max_depth`` steps:
+each gather of (feature, threshold-bin, children) streams the (T, L1)
+tables again, and for deep ensembles the walk is table-bandwidth-bound,
+not row-bound.  This kernel pins ALL node tables (feature idx, serving
+threshold bin, children, zero-bin, missing routing) in VMEM once per row
+tile — for a 500-tree, 255-leaf model the full table set is ~3.5 MB,
+comfortably inside the ~16 MB VMEM budget — so the ``depth`` gather steps
+run entirely out of on-chip memory and HBM traffic drops to the prebinned
+code tile in + the leaf-index tile out.
+
+Scope: the PREBINNED, non-categorical serving path (where the table-pin
+pays; categorical ensembles ride the XLA walk).  The pure-XLA walk is the
+bit-parity pin: `tests/test_predict_engine.py` pins kernel-vs-XLA leaf
+equality (interpret mode on CPU), and `BatchPredictor` falls back to the
+XLA walk with a warning if Mosaic cannot lower the gathers on the local
+backend — `predict_method=pallas` is opt-in.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+
+from ..io.binning import MISSING_NAN, MISSING_ZERO
+
+
+def _kernel(nl_ref, feat_ref, tbin_ref, zbin_ref, dl_ref, mt_ref, lc_ref,
+            rc_ref, codes_ref, out_ref, *, n_steps, zero_code, nan_code):
+    """Grid: (row_tiles,).  All table refs hold the FULL (T, L1) arrays in
+    VMEM; ``codes_ref`` is this tile's (TILE, F) serving codes."""
+    T, L1 = feat_ref.shape
+    rows = codes_ref.shape[0]
+
+    codes = codes_ref[...].astype(jnp.int32)              # (TILE, F)
+    feat = feat_ref[...].reshape(-1)                      # (T*L1,)
+    tbin = tbin_ref[...].reshape(-1)
+    zbin = zbin_ref[...].reshape(-1)
+    dl = dl_ref[...].reshape(-1)
+    mt = mt_ref[...].reshape(-1)
+    lc = lc_ref[...].reshape(-1)
+    rc = rc_ref[...].reshape(-1)
+    t_off = lax.broadcasted_iota(jnp.int32, (rows, T), 1) * L1
+
+    def body(_, node):
+        nd = jnp.maximum(node, 0)
+        flat = nd + t_off                                  # (TILE, T)
+        f = jnp.take(feat, flat, axis=0)
+        b = jnp.take_along_axis(codes, f, axis=1)
+        is_nan = b == nan_code
+        is_zero = b == zero_code
+        b0 = jnp.where(is_nan | is_zero, jnp.take(zbin, flat, axis=0), b)
+        mtype = jnp.take(mt, flat, axis=0)
+        is_missing = jnp.where(
+            mtype == MISSING_NAN, is_nan,
+            jnp.where(mtype == MISSING_ZERO, is_nan | is_zero, False))
+        go_left = jnp.where(is_missing, jnp.take(dl, flat, axis=0) != 0,
+                            b0 <= jnp.take(tbin, flat, axis=0))
+        nxt = jnp.where(go_left, jnp.take(lc, flat, axis=0),
+                        jnp.take(rc, flat, axis=0))
+        return jnp.where(node >= 0, nxt, node)
+
+    node0 = jnp.where(nl_ref[...] > 1,
+                      jnp.zeros((rows, T), jnp.int32),
+                      jnp.full((rows, T), -1, jnp.int32))
+    node = lax.fori_loop(0, max(int(n_steps), 1), body, node0)
+    out_ref[...] = -node - 1
+
+
+def serving_leaf_pallas(arrays, codes, *, n_steps: int, zero_code: int,
+                        nan_code: int, interpret: bool = False,
+                        row_tile: int = 512):
+    """(N, F) serving codes -> (N, T) leaf indices, node tables pinned in
+    VMEM.  ``N`` must be a multiple of the row tile after the caller's
+    bucket padding (buckets are powers of two >= 256, so any power-of-two
+    tile <= N divides it)."""
+    N, _ = codes.shape
+    T, L1 = arrays.split_feature.shape
+    tile = min(row_tile, N)
+    while N % tile:
+        tile //= 2
+    grid = (N // tile,)
+
+    def full(a, dtype=jnp.int32):
+        return a.astype(dtype)
+
+    tables = (
+        full(arrays.num_leaves.reshape(1, T)),
+        full(arrays.split_feature),
+        full(arrays.threshold_bin),
+        full(arrays.zero_bin),
+        full(arrays.default_left),
+        full(arrays.missing_type),
+        full(arrays.left_child),
+        full(arrays.right_child),
+    )
+    table_specs = [pl.BlockSpec(t.shape, lambda i: (0, 0)) for t in tables]
+    kern = functools.partial(_kernel, n_steps=n_steps, zero_code=zero_code,
+                             nan_code=nan_code)
+    return pl.pallas_call(
+        kern,
+        grid=grid,
+        in_specs=table_specs + [
+            pl.BlockSpec((tile, codes.shape[1]), lambda i: (i, 0)),
+        ],
+        out_specs=pl.BlockSpec((tile, T), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((N, T), jnp.int32),
+        interpret=interpret,
+    )(*tables, codes)

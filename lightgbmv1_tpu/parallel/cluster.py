@@ -246,6 +246,23 @@ def comm_table_per_round(learner: str, collective: str, *, k: float,
     return out
 
 
+def predict_comm_table(n_rows: int, num_features: int, ndev: int, *,
+                       itemsize: int = 4, K: int = 1) -> dict:
+    """Per-device payloads of one row-sharded predict batch (the serving
+    analog of ``comm_table_per_round``): inference is embarrassingly
+    parallel — NO collective runs at all — so the only traffic is the H2D
+    of each chip's row shard (``itemsize`` 1 for uint8 serving codes, 2
+    for uint16, 4 for raw f32 — the prebinned path's 4x HBM shrink shows
+    up here) and the D2H of its (rows, K) scores.  Recorded into the
+    MULTICHIP record by tools/dryrun_multichip."""
+    rows = -(-int(n_rows) // max(int(ndev), 1))
+    return {
+        "h2d_bytes": rows * int(num_features) * int(itemsize),
+        "d2h_bytes": rows * int(K) * 4,
+        "collective_bytes": 0,
+    }
+
+
 def comm_guard_ok(rs_hist_bytes: float, allreduce_hist_bytes: float,
                   ndev: int) -> bool:
     """The comm-bytes regression guard (tools/dryrun_multichip -> MULTICHIP

@@ -99,6 +99,25 @@ def _make_mesh(num_shards: int, axis: str) -> Mesh:
     return make_mesh(num_shards, axis)   # parallel/cluster.py (topology home)
 
 
+def shard_rows(fn, mesh: Mesh, axis: str = "rows", n_replicated: int = 0):
+    """Row-shard a batch function over ``mesh``: the first
+    ``n_replicated`` arguments (model tables) are replicated on every
+    chip, the remaining arguments split on their leading (row) axis, and
+    outputs come back row-sharded.  No collective runs at all — this is
+    the embarrassingly-parallel serving layout (the reference's OMP
+    row-partitioned Predictor, predictor.hpp:105-135, mapped onto chips);
+    used by models/predict.BatchPredictor for sharded inference."""
+
+    def wrapped(*args):
+        in_specs = tuple([P()] * n_replicated
+                         + [P(axis)] * (len(args) - n_replicated))
+        sharded = shard_map(fn, mesh=mesh, in_specs=in_specs,
+                            out_specs=P(axis), check_vma=False)
+        return sharded(*args)
+
+    return wrapped
+
+
 def _pack_split(res: SplitResult) -> jnp.ndarray:
     """SplitInfo wire format for the cross-shard argmax (reference:
     SplitInfo::CopyTo, split_info.hpp — fixed-size serialization). The

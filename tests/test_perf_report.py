@@ -80,6 +80,41 @@ def test_comm_guard_and_table():
     assert vt["vote_bytes"] > 0
 
 
+def test_prediction_section_renders_split_fields():
+    """The Prediction section (PR 4) is generated from the BENCH predict_*
+    fields: the engine table (native / depth-stepped walk / scan pin),
+    the parse/prebin/H2D/walk/write component split, and the predict_ok
+    guard all grep to record fields."""
+    import perf_report
+
+    rec = {
+        "predict_rows": 1000000, "predict_n_trees": 100,
+        "predict_M_rows_per_s": 1.5,
+        "predict_native_compute_M_rows_per_s": 4.2,
+        "predict_device_M_rows_per_s": 2.5,
+        "predict_device_compute_M_rows_per_s": 61.25,
+        "predict_device_scan_M_rows_per_s": 7.125,
+        "predict_parse_ms": 900.5, "predict_prebin_ms": 120.25,
+        "predict_h2d_ms": 8.5, "predict_walk_ms": 16.75,
+        "predict_write_ms": 300.0, "predict_h2d_bytes_per_row": 28,
+        "predict_cache_retraces": 0,
+        "predict_parity_ok": True, "predict_ok": True,
+    }
+    lines = []
+    perf_report.prediction_section(lines.append, rec)
+    txt = "\n".join(lines)
+    assert "## Prediction" in txt
+    for needle in ("61.25", "7.125", "120.25", "16.75",
+                   "predict_ok=True", "depth-stepped", "parity pin",
+                   "0 retraces"):
+        assert needle in txt, needle
+    # a record with no predict capture renders the placeholder, never dies
+    lines = []
+    perf_report.prediction_section(lines.append, {})
+    txt = "\n".join(lines)
+    assert "No predict fields" in txt
+
+
 def test_comm_section_renders_in_perf_md():
     """PERF.md (generated output) must carry the Cross-chip comms section
     and its figures must grep to the analytic formula."""

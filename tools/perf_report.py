@@ -118,6 +118,61 @@ def comm_section(w, mc_name, mc):
     w("")
 
 
+def prediction_section(w, rec):
+    """Prediction: the serving-engine table (native C++ / depth-stepped
+    device walk / legacy scan pin) plus the component split of the device
+    file->file window (parse / prebin / H2D / walk / write) and the
+    ``predict_ok`` guard — every figure greps to a BENCH predict_* field
+    (bench.py measure_predict).  Renders a placeholder until the first
+    capture that carries the fields."""
+    w("## Prediction (file->file on the bench set)")
+    w("")
+    if rec.get("predict_M_rows_per_s") is None:
+        w("No predict fields in this record yet — the next driver capture "
+          "runs bench.py's measure_predict (native C++ predictor, the "
+          "depth-stepped all-trees device walk on prebinned serving "
+          "codes, and the legacy scan-walk parity pin) and this section "
+          "renders its parse/H2D/walk split and the `predict_ok` guard.")
+        w("")
+        return
+    w(f"{get(rec, 'predict_n_trees', 0)} trees, "
+      f"{get(rec, 'predict_rows', 0)} rows:")
+    w("")
+    w("| engine | M rows/s (file->file) | M rows/s (compute only) |")
+    w("|---|---|---|")
+    w(f"| native C++ predictor | {get(rec, 'predict_M_rows_per_s', 3)}"
+      f" | {get(rec, 'predict_native_compute_M_rows_per_s', 3)} |")
+    w(f"| device depth-stepped walk | "
+      f"{get(rec, 'predict_device_M_rows_per_s', 3)} | "
+      f"{get(rec, 'predict_device_compute_M_rows_per_s', 3)} |")
+    if rec.get("predict_device_scan_M_rows_per_s") is not None:
+        w(f"| device scan walk (parity pin) | — | "
+          f"{get(rec, 'predict_device_scan_M_rows_per_s', 3)} |")
+    if rec.get("predict_ref_cpp_M_rows_per_s"):
+        w(f"| reference CLI task=predict | "
+          f"{get(rec, 'predict_ref_cpp_M_rows_per_s', 3)} | — |")
+    w("")
+    if rec.get("predict_walk_ms") is not None:
+        w("Device window components (ms, chunk-sized batch): parse "
+          f"{get(rec, 'predict_parse_ms')} / prebin "
+          f"{get(rec, 'predict_prebin_ms')} / H2D "
+          f"{get(rec, 'predict_h2d_ms')} / walk "
+          f"{get(rec, 'predict_walk_ms')} / write "
+          f"{get(rec, 'predict_write_ms')}; "
+          f"{get(rec, 'predict_h2d_bytes_per_row', 0)} H2D bytes/row "
+          "(prebinned serving codes), "
+          f"{get(rec, 'predict_cache_retraces', 0)} retraces across "
+          "varied batch sizes (predictor cache).")
+        w("")
+    if rec.get("predict_ok") is not None:
+        w(f"Guard `predict_ok={rec.get('predict_ok')}`: node-exact leaf "
+          f"parity vs the host walk "
+          f"(`predict_parity_ok={rec.get('predict_parity_ok')}`) AND the "
+          "depth-stepped walk at >= 0.95x the scan-walk compute rate "
+          "(bench.py asserts the split; this report surfaces it).")
+        w("")
+
+
 def fmt(v, nd=2):
     if v is None:
         return "—"
@@ -294,22 +349,7 @@ def generate(rec, name, prev=None, prev_name=None):
           "tools/mc_gap_ab.py.)")
         w("")
 
-    if rec.get("predict_M_rows_per_s") is not None:
-        w("## Prediction (file->file on the bench set, "
-          f"{get(rec, 'predict_n_trees', 0)} trees, "
-          f"{get(rec, 'predict_rows', 0)} rows)")
-        w("")
-        w("| engine | M rows/s (file->file) | M rows/s (compute only) |")
-        w("|---|---|---|")
-        w(f"| native C++ predictor | {get(rec, 'predict_M_rows_per_s', 3)}"
-          f" | {get(rec, 'predict_native_compute_M_rows_per_s', 3)} |")
-        w(f"| device batch walk | "
-          f"{get(rec, 'predict_device_M_rows_per_s', 3)} | "
-          f"{get(rec, 'predict_device_compute_M_rows_per_s', 3)} |")
-        if rec.get("predict_ref_cpp_M_rows_per_s"):
-            w(f"| reference CLI task=predict | "
-              f"{get(rec, 'predict_ref_cpp_M_rows_per_s', 3)} | — |")
-        w("")
+    prediction_section(w, rec)
 
     mc_name, mc = load_multichip()
     comm_section(w, mc_name, mc)
