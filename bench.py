@@ -605,6 +605,109 @@ def measure_predict(gb_lw, X):
     return fields
 
 
+def measure_serve(gb_lw, X):
+    """Online-serving loadgen block (serve/ subsystem) — runs on EVERY
+    backend including the CPU fallback (the acceptance record is a CPU
+    loadgen run).  Two phases against an in-process server built from the
+    bench model:
+
+    * **live traffic + hot swap** — open-loop Poisson arrivals
+      (tools/loadgen.py) at a sustainable rate with a mid-run
+      ``publish()`` of a second model version; every response is checked
+      BIT-IDENTICAL to ``Booster.predict`` (host path, raw scores) of the
+      version tag it carries, across the swap.  ``serve_qps`` /
+      ``serve_p99_ms`` / ``serve_batch_occupancy`` come from this phase.
+    * **2x overload** — a deliberately small admission queue under an
+      offered row rate far above capacity: the bounded queue must SHED
+      (``serve_shed_frac`` > 0) while the backlog never exceeds the
+      configured depth (``serve_overload_queue_ok``) — explicit rejection,
+      not unbounded growth.
+
+    ``serve_ok`` = zero failed/incorrect responses in the live phase AND
+    both versions actually served across the swap AND the overload queue
+    stayed bounded."""
+    from lightgbmv1_tpu.basic import Booster, _objective_string
+    from lightgbmv1_tpu.io.model_text import model_to_string
+    from lightgbmv1_tpu.serve import ServeConfig, Server
+    from tools.loadgen import run_loadgen, serve_record_fields
+
+    trees = gb_lw.materialize_host_trees()
+    ds = gb_lw.train_set
+    model_str = model_to_string(
+        trees, objective_string=_objective_string(gb_lw.config), num_class=1,
+        num_tree_per_iteration=1, feature_names=list(ds.feature_names),
+        feature_infos=ds.feature_infos())
+    full = Booster(model_str=model_str)
+    n_half = max(len(trees) // 2, 1)
+    half = Booster(model_str=full.model_to_string(num_iteration=n_half))
+
+    pool = np.asarray(X[:8192], np.float64)
+    expected = {}   # version tag -> host raw scores over the pool
+
+    def publish(server, booster):
+        # expectation computed BEFORE the swap; check() waits out the
+        # tag-assignment window (see __graft_entry__.serve_smoke)
+        exp = np.asarray(booster.predict(
+            pool, raw_score=True, predict_method="host"), np.float64)
+        tag = server.publish(booster)
+        expected[tag] = exp
+        return tag
+
+    def check(start, n, res):
+        for _ in range(1000):
+            if res.version in expected:
+                break
+            time.sleep(0.001)
+        want = expected[res.version][start: start + n]
+        return np.array_equal(res.values[:, 0], want)
+
+    fields = {}
+    cfg = ServeConfig(max_batch_rows=256, max_batch_delay_ms=2.0,
+                      queue_depth_rows=4096, f64_scores=True,
+                      predictor_kwargs={"bucket_min": 64})
+    server = Server(config=cfg)
+    try:
+        publish(server, half)               # v1 serves the first half
+        server.submit(pool[:64])            # warm the client path
+        lg = run_loadgen(
+            server, pool, rate_qps=float(os.environ.get(
+                "SERVE_RATE_QPS", 400)), duration_s=4.0, rows_per_req=2,
+            n_threads=8, seed=5, swap_at_frac=0.3,
+            swap_fn=lambda: publish(server, full),
+            tail_requests_after_swap=100, check_fn=check)
+        fields.update(serve_record_fields(lg))
+        live_ok = (lg["error"] == 0 and lg["timeout"] == 0
+                   and lg["check_failures"] == 0 and lg["shed"] == 0
+                   and len(lg["versions_served"]) >= 2)
+        fields["serve_live_ok"] = live_ok
+    finally:
+        server.close()
+
+    # ---- bounded-queue overload probe ---------------------------------
+    over_cfg = ServeConfig(max_batch_rows=64, max_batch_delay_ms=1.0,
+                           queue_depth_rows=256, f64_scores=True,
+                           predictor_kwargs={"bucket_min": 64})
+    over = Server(full, config=over_cfg)
+    try:
+        over.submit(pool[:64])
+        lo = run_loadgen(over, pool, rate_qps=1500.0, duration_s=2.0,
+                         rows_per_req=32, n_threads=16, seed=6)
+        snap = lo["server_metrics"]
+        fields["serve_overload_shed_frac"] = lo["shed_frac"]
+        fields["serve_overload_queue_max"] = snap["queue_depth_max"]
+        queue_ok = snap["queue_depth_max"] <= over_cfg.queue_depth_rows
+        accounted = (lo["ok"] + lo["shed"] + lo["timeout"] + lo["error"]
+                     == lo["requests"])
+        fields["serve_overload_queue_ok"] = bool(queue_ok and accounted)
+        fields["serve_overload_shed_observed"] = lo["shed"] > 0
+    finally:
+        over.close()
+
+    fields["serve_ok"] = bool(fields.get("serve_live_ok")
+                              and fields.get("serve_overload_queue_ok"))
+    return fields
+
+
 def main():
     import jax
 
@@ -958,6 +1061,16 @@ def main():
             extra["vs_ref_500iter"] = round(ref_500_wall_s / wall500, 4)
         except Exception as e:  # noqa: BLE001
             extra["northstar_error"] = f"{type(e).__name__}: {e}"[:200]
+
+    # Online-serving loadgen block (serve/ subsystem): runs on every
+    # backend — the acceptance record for hot-swap-under-traffic and
+    # bounded-queue shedding is explicitly a CPU loadgen run; on device
+    # sessions the same block prices the micro-batched device walk.
+    try:
+        extra.update(measure_serve(gb_lw, X))
+    except Exception as e:  # noqa: BLE001 — partial records beat none
+        extra["serve_error"] = f"{type(e).__name__}: {e}"[:200]
+        extra["serve_ok"] = False
 
     # Cross-chip comm pricing (analytic, parallel/cluster.py — the same
     # single-source formula the trainer logs and dryrun_multichip

@@ -738,6 +738,7 @@ class Booster:
                 num_shards=int(p("predict_num_shards", 0)),
                 bucket_min=int(p("predict_bucket_min", 256)),
                 chunk_rows=int(p("predict_chunk_rows", 131072)),
+                cache_entries=int(p("predict_cache_entries", 64)),
             )
         except Exception as e:  # noqa: BLE001 — host fallback
             log_warning(f"device predict unavailable "

@@ -11,8 +11,12 @@ Usage matches the reference:
     python -m lightgbmv1_tpu config=train.conf [key=value ...]
 
 Tasks: ``train`` (default), ``predict`` / ``prediction``, ``refit``,
-``convert_model``.  The reference's example configs
-(``/root/reference/examples/*/train.conf``) run unmodified.
+``convert_model``, and ``serve`` (the online serving subsystem,
+``serve/``: deadline-aware micro-batching over the device inference
+engine behind a stdlib HTTP endpoint — no reference equivalent; the
+reference stops at the batch file->file Predictor).  The reference's
+example configs (``/root/reference/examples/*/train.conf``) run
+unmodified.
 """
 
 from __future__ import annotations
@@ -232,6 +236,43 @@ def run_predict(config: Config) -> None:
     log_info("Finished prediction")
 
 
+def run_serve(config: Config):
+    """Online serving (serve/ subsystem): load ``input_model``, publish it
+    into a warm :class:`~lightgbmv1_tpu.serve.Server`, and listen on the
+    stdlib HTTP front-end.  ``serve_duration_s>0`` bounds the run (CI /
+    driver smoke); 0 serves until interrupted.  Returns the
+    ``(server, http)`` pair so tests can drive it in-process."""
+    import time as _time
+
+    from .serve import ServeHTTP
+    from .serve.server import build_server
+
+    if not config.input_model:
+        log_fatal("No model file: set input_model=<file>")
+    booster = Booster(params=_config_to_params(config),
+                      model_file=config.input_model)
+    server = build_server(booster, config)
+    http = ServeHTTP(server, port=config.serve_http_port).start()
+    log_info(f"serve: HTTP listening on 127.0.0.1:{http.port} "
+             "(POST /predict, GET /metrics, GET /healthz)")
+    try:
+        if config.serve_duration_s > 0:
+            _time.sleep(config.serve_duration_s)
+        else:
+            while True:
+                _time.sleep(3600)
+    except KeyboardInterrupt:
+        log_info("serve: interrupted")
+    finally:
+        import json as _json
+
+        http.shutdown()
+        snap = server.metrics_snapshot()
+        server.close()
+        log_info("serve: final metrics " + _json.dumps(snap))
+    return server, http
+
+
 def run_refit(config: Config) -> None:
     """reference: Application::Run task=refit (application.h) —
     re-estimate the leaf values of input_model on new data."""
@@ -287,6 +328,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         run_train(config)
     elif task in ("predict", "prediction", "test"):
         run_predict(config)
+    elif task == "serve":
+        run_serve(config)
     elif task == "refit":
         run_refit(config)
     elif task == "convert_model":

@@ -376,12 +376,36 @@ class Config:
                                     # the predictor's compile cache
     predict_chunk_rows: int = 131072  # streaming chunk: bounds device
                                     # memory and double-buffers H2D
+    predict_cache_entries: int = 64  # LRU bound on the predictor's
+                                    # compiled-walk cache ((bucket, kind)
+                                    # keys; a long-running server seeing
+                                    # many batch shapes stays bounded)
     predict_num_shards: int = 0     # >1: rows sharded over the mesh
                                     # (parallel/cluster.make_mesh)
     # reconstruct raw scores host-side in float64 from device leaf
     # indices (bit-identical to the native C++ predictor); default off —
     # the on-device f32 sum is the fast serving path
     predict_f64_scores: bool = False
+    # -- online serving (serve/ subsystem; CLI task=serve) -------------
+    # micro-batcher policy: a batch dispatches when it FILLS
+    # serve_max_batch_rows (device occupancy) or when its oldest request
+    # has waited serve_max_batch_delay_ms (p99 latency) — the
+    # occupancy/latency trade as an explicit knob (serve/server.py)
+    serve_max_batch_rows: int = 1024
+    serve_max_batch_delay_ms: float = 2.0
+    # admission control: bounded request queue in ROWS; a submit that
+    # would exceed it is shed immediately (HTTP 503), never queued into
+    # unbounded memory growth
+    serve_queue_depth: int = 4096
+    serve_timeout_ms: float = 0.0   # per-request deadline in queue; 0=off
+    # overload degradation: >0 serves backlogged periods from a
+    # truncated-tree predictor of this many trees (rounded down to an
+    # iteration boundary); answers are flagged `degraded`
+    serve_degrade_trees: int = 0
+    serve_http_port: int = 8080     # task=serve HTTP listener; 0 = pick
+                                    # an ephemeral port (logged)
+    serve_duration_s: float = 0.0   # task=serve runs this long (0 = until
+                                    # interrupted); bounded runs for CI
     profile_dir: str = ""          # write a jax.profiler device trace of
                                    # training here; hist/split/partition
                                    # phases carry lgbm.* named scopes (the
@@ -516,6 +540,18 @@ class Config:
             raise ValueError(
                 f"predict_prebin={self.predict_prebin!r}: expected "
                 "auto | on | off")
+        if self.serve_max_batch_rows < 1:
+            raise ValueError("serve_max_batch_rows must be >= 1")
+        if self.serve_max_batch_delay_ms < 0:
+            raise ValueError("serve_max_batch_delay_ms must be >= 0")
+        if self.serve_queue_depth < self.serve_max_batch_rows:
+            raise ValueError("serve_queue_depth must be >= "
+                             "serve_max_batch_rows (admission control "
+                             "must admit at least one full batch)")
+        if self.predict_cache_entries < 2:
+            raise ValueError("predict_cache_entries must be >= 2 (the "
+                             "walk and its score executable share a "
+                             "bucket)")
         if self.hist_dtype_deep not in (
                 "", "f32", "bf16", "bf16x2", "int8", "int8sr"):
             raise ValueError(

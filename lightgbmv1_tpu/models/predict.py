@@ -43,6 +43,7 @@ all — `tools` dryrun_multichip asserts node-exact parity vs single-device.
 
 from __future__ import annotations
 
+from collections import OrderedDict
 from dataclasses import dataclass
 from typing import Any, Dict, List, NamedTuple, Optional, Tuple
 
@@ -388,14 +389,19 @@ class BatchPredictor:
     keyed on (row bucket, output kind) so repeated `predict` calls at any
     batch size inside a bucket reuse one compiled executable —
     ``trace_count`` counts actual retraces and is asserted zero-growth by
-    the cache tests.  `Booster.predict` holds one BatchPredictor per
-    (start_iteration, tree count, model_version) — any ensemble mutation
-    bumps ``model_version`` and drops the predictor wholesale."""
+    the cache tests.  The cache is LRU-bounded at ``cache_entries``
+    executables (``cache_info()`` exposes hits/misses/evictions) so a
+    long-running server seeing many batch shapes cannot accumulate
+    compiled programs without limit.  `Booster.predict` holds one
+    BatchPredictor per (start_iteration, tree count, model_version) — any
+    ensemble mutation bumps ``model_version`` and drops the predictor
+    wholesale."""
 
     def __init__(self, trees: List[HostTree], K: int, num_features: int, *,
                  method: str = "depthwise", prebin: str = "auto",
                  num_shards: int = 0, bucket_min: int = 256,
-                 chunk_rows: int = 1 << 17, interpret: Optional[bool] = None):
+                 chunk_rows: int = 1 << 17, interpret: Optional[bool] = None,
+                 cache_entries: int = 64):
         import jax
 
         if not trees:
@@ -446,9 +452,18 @@ class BatchPredictor:
             from ..parallel.cluster import make_mesh
 
             self._mesh = make_mesh(self.num_shards, "rows")
-        self._cache: Dict[Tuple[int, str], Any] = {}
+        # LRU-bounded jit cache over (bucket, kind) keys: a long-running
+        # server seeing many batch shapes would otherwise accumulate
+        # compiled executables without limit (each bucket x output kind is
+        # its own XLA program).  Eviction drops the least-recently-used
+        # executable; re-touching that bucket retraces (counted).
+        self._cache: "OrderedDict[Tuple[int, str], Any]" = OrderedDict()
+        self.cache_capacity = max(int(cache_entries), 2)
         self.trace_count = 0
         self.call_count = 0
+        self.cache_hits = 0
+        self.cache_misses = 0
+        self.cache_evictions = 0
         self._scan_stacked = None
         self._pallas_broken = False
 
@@ -460,15 +475,42 @@ class BatchPredictor:
             b = self.num_shards * (-(-b // self.num_shards))
         return b
 
+    def _cache_get(self, key):
+        fn = self._cache.get(key)
+        if fn is not None:
+            self._cache.move_to_end(key)
+            self.cache_hits += 1
+        else:
+            self.cache_misses += 1
+        return fn
+
+    def _cache_put(self, key, fn):
+        self._cache[key] = fn
+        self._cache.move_to_end(key)
+        while len(self._cache) > self.cache_capacity:
+            self._cache.popitem(last=False)
+            self.cache_evictions += 1
+        return fn
+
     def cache_stats(self) -> Dict[str, int]:
         return {"traces": self.trace_count, "calls": self.call_count,
                 "entries": len(self._cache)}
 
+    def cache_info(self) -> Dict[str, int]:
+        """functools.lru_cache-style accessor for the compiled-walk cache
+        (serve metrics and the cache tests read this)."""
+        return {"entries": len(self._cache),
+                "capacity": self.cache_capacity,
+                "hits": self.cache_hits, "misses": self.cache_misses,
+                "evictions": self.cache_evictions,
+                "traces": self.trace_count, "calls": self.call_count}
+
     def _leaf_fn(self, bucket: int):
         """Compiled (bucket, F) -> (bucket, T) leaf-index walk."""
         key = (bucket, "leaf")
-        if key in self._cache:
-            return self._cache[key]
+        fn = self._cache_get(key)
+        if fn is not None:
+            return fn
         import jax
 
         method, prebin = self.method, self.prebin
@@ -496,8 +538,7 @@ class BatchPredictor:
         jfn = jax.jit(fn)
         if self.method == "pallas":
             jfn = self._pallas_guard(jfn, bucket)
-        self._cache[key] = jfn
-        return jfn
+        return self._cache_put(key, jfn)
 
     def _pallas_guard(self, jfn, bucket):
         """First-call fallback: if the Pallas kernel fails to lower on
@@ -520,34 +561,36 @@ class BatchPredictor:
 
     def _xla_fallback(self, bucket):
         key = (bucket, "leaf_xla")
-        if key not in self._cache:
-            import jax
+        cached = self._cache_get(key)
+        if cached is not None:
+            return cached
+        import jax
 
-            depth, has_cat = self.depth, self.has_cat
-            zc, nc = self.binner.zero_code, self.binner.nan_code
-            prebin = self.prebin
+        depth, has_cat = self.depth, self.has_cat
+        zc, nc = self.binner.zero_code, self.binner.nan_code
+        prebin = self.prebin
 
-            def walk(arrays, xb):
-                self.trace_count += 1
-                if prebin:
-                    return serving_leaf_binned(arrays, xb, depth, zc, nc,
-                                               has_cat)
-                return serving_leaf_raw(arrays, xb, depth, has_cat)
+        def walk(arrays, xb):
+            self.trace_count += 1
+            if prebin:
+                return serving_leaf_binned(arrays, xb, depth, zc, nc,
+                                           has_cat)
+            return serving_leaf_raw(arrays, xb, depth, has_cat)
 
-            fn = walk
-            if self._mesh is not None:
-                from ..parallel.trainer import shard_rows
+        fn = walk
+        if self._mesh is not None:
+            from ..parallel.trainer import shard_rows
 
-                fn = shard_rows(walk, self._mesh, "rows", n_replicated=1)
-            self._cache[key] = jax.jit(fn)
-        return self._cache[key]
+            fn = shard_rows(walk, self._mesh, "rows", n_replicated=1)
+        return self._cache_put(key, jax.jit(fn))
 
     def _scan_fn(self, bucket: int):
         """The parity-pin scan walk (models/tree.ensemble_predict_raw) as
         a predict_method — per-tree while-loop walks, summed f32."""
         key = (bucket, "scan")
-        if key in self._cache:
-            return self._cache[key]
+        cached = self._cache_get(key)
+        if cached is not None:
+            return cached
         import jax
 
         from .tree import ensemble_predict_raw
@@ -561,8 +604,7 @@ class BatchPredictor:
             from ..parallel.trainer import shard_rows
 
             fn = shard_rows(fwd, self._mesh, "rows", n_replicated=1)
-        self._cache[key] = jax.jit(fn)
-        return self._cache[key]
+        return self._cache_put(key, jax.jit(fn))
 
     # -- host <-> device ------------------------------------------------
     def encode(self, X: np.ndarray) -> np.ndarray:
@@ -647,8 +689,9 @@ class BatchPredictor:
 
     def _scores_fn(self, bucket: int):
         key = (bucket, "scores")
-        if key in self._cache:
-            return self._cache[key]
+        cached = self._cache_get(key)
+        if cached is not None:
+            return cached
         import jax
 
         from .tree import leaves_to_scores
@@ -659,8 +702,7 @@ class BatchPredictor:
             self.trace_count += 1
             return leaves_to_scores(leaf_value, leaf, K)
 
-        self._cache[key] = jax.jit(fn)
-        return self._cache[key]
+        return self._cache_put(key, jax.jit(fn))
 
     def _predict_raw_scan(self, X, chunk_rows):
         import jax

@@ -1,0 +1,32 @@
+"""Online serving subsystem — the throughput-critical consumer half.
+
+LightGBM's own framing (PAPERS.md) splits the system into an
+offline-optimized trainer and an online consumer; PR 4 built the raw
+device engine (models/predict.py) and this package turns it into a
+service:
+
+* :class:`Server` / :class:`ServeConfig` — deadline-aware micro-batching
+  with bounded-queue admission control and overload degradation
+  (server.py),
+* :class:`ModelRegistry` — versioned atomic hot-swap with warm-off-path
+  publish and instant rollback (registry.py),
+* :class:`ServeMetrics` — QPS / latency quantiles / batch occupancy /
+  queue + shed counters, one JSON snapshot (metrics.py),
+* :class:`ServeHTTP` — stdlib HTTP front-end (http.py).
+
+Front doors: ``Server.submit()`` in-process, ``ServeHTTP`` over the
+wire, and CLI ``task=serve`` (cli.py).  ``tools/loadgen.py`` drives
+open-loop Poisson traffic against any of them.
+"""
+
+from .metrics import ServeMetrics
+from .registry import ModelRegistry, ModelVersion
+from .server import (RequestTimeout, ServeConfig, ServeError, ServeResult,
+                     Server, ServerClosed, ServerOverloaded, build_server)
+from .http import ServeHTTP
+
+__all__ = [
+    "ModelRegistry", "ModelVersion", "RequestTimeout", "ServeConfig",
+    "ServeError", "ServeHTTP", "ServeMetrics", "ServeResult", "Server",
+    "ServerClosed", "ServerOverloaded", "build_server",
+]

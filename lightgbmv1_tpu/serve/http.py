@@ -1,0 +1,110 @@
+"""Stdlib HTTP front-end over :class:`~lightgbmv1_tpu.serve.Server`.
+
+Deliberately dependency-free (``http.server`` + ``json``): the process
+already holds the device runtime, so the HTTP layer only needs to decode
+rows, call ``Server.submit()`` and map the admission-control outcomes
+onto status codes — 200 scored, 503 shed (queue full), 504 deadline
+expired, 400 malformed.  Each handler thread blocks inside ``submit()``
+like any other in-process client, so HTTP requests micro-batch together
+with (and against) direct callers.
+
+Endpoints:
+
+* ``POST /predict``  body ``{"rows": [[...], ...]}`` ->
+  ``{"values": [[...], ...], "version": "v2", "degraded": false,
+  "latency_ms": 1.9}``
+* ``GET /metrics``   the ServeMetrics snapshot (+ version history)
+* ``GET /healthz``   ``{"ok": true, "version": "v2"}``
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from .server import (RequestTimeout, ServeError, Server, ServerClosed,
+                     ServerOverloaded)
+
+
+def _make_handler(server: Server):
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, fmt, *args):  # noqa: A003 — silence stderr
+            pass
+
+        def _reply(self, code: int, payload: dict) -> None:
+            body = json.dumps(payload).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):  # noqa: N802 — BaseHTTPRequestHandler API
+            if self.path == "/metrics":
+                self._reply(200, server.metrics_snapshot())
+            elif self.path == "/healthz":
+                self._reply(200, {"ok": True, "version": server.version()})
+            else:
+                self._reply(404, {"error": f"no route {self.path}"})
+
+        def do_POST(self):  # noqa: N802
+            if self.path != "/predict":
+                self._reply(404, {"error": f"no route {self.path}"})
+                return
+            try:
+                n = int(self.headers.get("Content-Length", 0))
+                req = json.loads(self.rfile.read(n) or b"{}")
+                rows = req["rows"]
+            except (ValueError, KeyError) as e:
+                self._reply(400, {"error": f"bad request body: {e}"})
+                return
+            try:
+                res = server.submit(rows)
+            except ServerOverloaded as e:
+                self._reply(503, {"error": str(e), "shed": True})
+                return
+            except RequestTimeout as e:
+                self._reply(504, {"error": str(e), "timeout": True})
+                return
+            except (ServeError, ValueError, RuntimeError) as e:
+                self._reply(400, {"error": f"{type(e).__name__}: {e}"})
+                return
+            self._reply(200, {
+                "values": res.values.tolist(),
+                "version": res.version,
+                "degraded": res.degraded,
+                "latency_ms": round(res.latency_ms, 3),
+            })
+
+    return Handler
+
+
+class ServeHTTP:
+    """Threaded HTTP listener bound to ``(host, port)``; ``port=0`` picks
+    an ephemeral port (read it back from ``.port``)."""
+
+    def __init__(self, server: Server, host: str = "127.0.0.1",
+                 port: int = 0):
+        self.server = server
+        self._httpd = ThreadingHTTPServer((host, port),
+                                          _make_handler(server))
+        self._httpd.daemon_threads = True
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        name="serve-http", daemon=True)
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1]
+
+    def start(self) -> "ServeHTTP":
+        self._thread.start()
+        return self
+
+    def shutdown(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread.is_alive():
+            self._thread.join(timeout=5.0)

@@ -1,0 +1,144 @@
+"""Serve metrics core — the observability half of the online subsystem.
+
+The reference ships no serving telemetry at all (its Predictor is a batch
+file->file application); a service answering live traffic needs the four
+questions answered continuously: how much (QPS), how fast (latency
+quantiles), how full (batch occupancy / queue depth), and how degraded
+(sheds, timeouts, degraded answers).  This module keeps those counters
+cheap enough to update per request under the batcher lock and snapshots
+them as one JSON-able dict — ``bench.py``'s serve block and
+``tools/perf_report.py``'s "Serving" section render the same fields.
+
+Latency quantiles come from a fixed-size ring of the most recent
+``window`` completions (exact over the window, O(window log window) only
+at snapshot time) — a bounded-memory stand-in for a streaming sketch
+that is exact for the smoke/bench populations we record.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional
+
+
+def _quantile(sorted_vals: List[float], q: float) -> Optional[float]:
+    if not sorted_vals:
+        return None
+    i = min(int(q * len(sorted_vals)), len(sorted_vals) - 1)
+    return sorted_vals[i]
+
+
+class ServeMetrics:
+    """Thread-safe counters + a latency ring; ``snapshot()`` is the one
+    read surface (everything else is write-only on the hot path)."""
+
+    def __init__(self, window: int = 8192):
+        self._lock = threading.Lock()
+        self.window = max(int(window), 16)
+        self.reset()
+
+    def reset(self) -> None:
+        with self._lock:
+            self._lat_ms: List[float] = []
+            self._lat_pos = 0
+            self.submitted = 0
+            self.completed = 0
+            self.shed = 0
+            self.timeouts = 0
+            self.errors = 0
+            self.degraded = 0
+            self.swaps = 0
+            self.rollbacks = 0
+            self.batches = 0
+            self.batch_rows = 0
+            self.batch_capacity = 0
+            self.queue_depth = 0
+            self.queue_depth_max = 0
+            self._t0: Optional[float] = None
+            self._t_last: Optional[float] = None
+
+    # -- hot-path writers ------------------------------------------------
+    def on_submit(self, n_rows: int, queue_depth: int) -> None:
+        with self._lock:
+            now = time.monotonic()
+            if self._t0 is None:
+                self._t0 = now
+            self.submitted += 1
+            self.queue_depth = queue_depth
+            if queue_depth > self.queue_depth_max:
+                self.queue_depth_max = queue_depth
+
+    def on_shed(self) -> None:
+        with self._lock:
+            self.shed += 1
+
+    def on_timeout(self) -> None:
+        with self._lock:
+            self.timeouts += 1
+
+    def on_error(self) -> None:
+        with self._lock:
+            self.errors += 1
+
+    def on_swap(self, rollback: bool = False) -> None:
+        with self._lock:
+            self.swaps += 1
+            if rollback:
+                self.rollbacks += 1
+
+    def on_batch(self, rows: int, bucket: int, queue_depth: int) -> None:
+        """One dispatched device batch: ``rows`` real rows padded into a
+        ``bucket``-row executable (occupancy = rows / bucket)."""
+        with self._lock:
+            self.batches += 1
+            self.batch_rows += rows
+            self.batch_capacity += max(bucket, 1)
+            self.queue_depth = queue_depth
+
+    def on_complete(self, latency_ms: float, degraded: bool = False) -> None:
+        with self._lock:
+            self.completed += 1
+            self._t_last = time.monotonic()
+            if degraded:
+                self.degraded += 1
+            if len(self._lat_ms) < self.window:
+                self._lat_ms.append(latency_ms)
+            else:
+                self._lat_ms[self._lat_pos] = latency_ms
+                self._lat_pos = (self._lat_pos + 1) % self.window
+
+    # -- read surface ----------------------------------------------------
+    def snapshot(self) -> Dict[str, object]:
+        """One JSON-able dict; the serve_* BENCH fields are computed from
+        exactly these keys (bench.py measure_serve)."""
+        with self._lock:
+            lat = sorted(self._lat_ms)
+            span = ((self._t_last - self._t0)
+                    if self._t0 is not None and self._t_last is not None
+                    and self._t_last > self._t0 else None)
+            total = self.submitted + self.shed
+            return {
+                "submitted": self.submitted,
+                "completed": self.completed,
+                "shed": self.shed,
+                "timeouts": self.timeouts,
+                "errors": self.errors,
+                "degraded": self.degraded,
+                "swaps": self.swaps,
+                "rollbacks": self.rollbacks,
+                "batches": self.batches,
+                "qps": (round(self.completed / span, 2) if span else None),
+                "p50_ms": _quantile(lat, 0.50),
+                "p99_ms": _quantile(lat, 0.99),
+                "p999_ms": _quantile(lat, 0.999),
+                "batch_occupancy": (round(self.batch_rows
+                                          / self.batch_capacity, 4)
+                                    if self.batch_capacity else None),
+                "mean_batch_rows": (round(self.batch_rows / self.batches, 1)
+                                    if self.batches else None),
+                "queue_depth": self.queue_depth,
+                "queue_depth_max": self.queue_depth_max,
+                "shed_frac": (round(self.shed / total, 4) if total else 0.0),
+                "latency_window": len(lat),
+            }

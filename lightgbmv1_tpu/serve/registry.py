@@ -1,0 +1,163 @@
+"""Versioned model registry with atomic hot-swap.
+
+The expensive parts of bringing a new ensemble online — materializing
+host trees, building the serving binner, stacking the SoA node tables,
+and compiling the bucketed walk executables — all happen in
+``publish()`` OFF the serving path.  Only after the new
+:class:`~lightgbmv1_tpu.models.predict.BatchPredictor` is fully warmed
+does the registry swap a single reference under a lock; the dispatcher
+reads that reference once per batch, so in-flight batches finish on the
+version they started with and every later batch sees the new one.
+``rollback()`` is the same single-reference swap back to the previous
+entry (its predictor and compiled cache are retained, so rollback is
+instant, not a re-publish).
+
+Every response carries the version tag of the predictor that computed
+it, which is what makes "bit-identical to ``Booster.predict`` of the
+version the response names" a testable contract across a mid-traffic
+swap (tests/test_serve.py).
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ..models.predict import BatchPredictor
+from ..utils.log import log_info
+
+
+@dataclass
+class ModelVersion:
+    """One published ensemble: the serving predictor plus the optional
+    truncated-tree degrade predictor (overload answers; fewer trees =
+    strictly less walk work per row)."""
+
+    tag: str
+    predictor: BatchPredictor
+    degraded: Optional[BatchPredictor] = None
+    num_features: int = 0
+    num_class: int = 1
+    n_trees: int = 0
+    meta: Dict[str, Any] = field(default_factory=dict)
+
+
+def _booster_parts(model):
+    """Accept a Booster or an explicit (trees, K, num_features) triple."""
+    if isinstance(model, tuple):
+        trees, k, f = model
+        return list(trees), int(k), int(f)
+    return (model._all_trees(), model.num_model_per_iteration(),
+            model.num_feature())
+
+
+class ModelRegistry:
+    """Publish / current / rollback over :class:`ModelVersion` entries."""
+
+    def __init__(self, *, warm_buckets: Optional[List[int]] = None,
+                 history: int = 4, metrics=None,
+                 predictor_kwargs: Optional[Dict[str, Any]] = None):
+        self._lock = threading.Lock()
+        self._active: Optional[ModelVersion] = None
+        self._history: List[ModelVersion] = []
+        self._seq = 0
+        self._warm_buckets = warm_buckets
+        self._keep = max(int(history), 1)
+        self._metrics = metrics
+        self._predictor_kwargs = dict(predictor_kwargs or {})
+
+    # -- build + warm (off the serving path) -----------------------------
+    def _build(self, trees, K, F, degrade_trees: int) -> ModelVersion:
+        self._seq += 1
+        tag = f"v{self._seq}"
+        bp = BatchPredictor(trees, K, F, **self._predictor_kwargs)
+        degraded = None
+        if degrade_trees and 0 < degrade_trees < len(trees):
+            # truncate on an iteration boundary so multiclass ensembles
+            # keep whole per-class tree groups
+            n = max(degrade_trees - degrade_trees % max(K, 1), K)
+            degraded = BatchPredictor(trees[:n], K, F,
+                                      **self._predictor_kwargs)
+        return ModelVersion(tag=tag, predictor=bp, degraded=degraded,
+                            num_features=F, num_class=K, n_trees=len(trees))
+
+    def _warm(self, mv: ModelVersion, max_batch_rows: int) -> int:
+        """Compile the bucketed walk for every bucket a live batch can
+        land in, BEFORE the version becomes visible — the first real
+        request must never pay a trace."""
+        n_compiled = 0
+        for bp in filter(None, (mv.predictor, mv.degraded)):
+            buckets = self._warm_buckets
+            if buckets is None:
+                buckets, b = [], bp.bucket_for(1)
+                top = bp.bucket_for(max(int(max_batch_rows), 1))
+                while b <= top:
+                    buckets.append(b)
+                    b *= 2
+            for bucket in buckets:
+                x = np.zeros((min(bucket, max_batch_rows), mv.num_features),
+                             np.float64)
+                bp.predict_raw(x)
+                n_compiled += 1
+        return n_compiled
+
+    # -- public API ------------------------------------------------------
+    def publish(self, model, *, degrade_trees: int = 0,
+                max_batch_rows: int = 1024,
+                meta: Optional[Dict[str, Any]] = None) -> str:
+        """Build + warm a new version, then atomically make it current.
+        Returns the version tag.  ``model`` is a Booster or a
+        ``(trees, K, num_features)`` triple."""
+        trees, K, F = _booster_parts(model)
+        if not trees:
+            raise ValueError("publish() needs a trained model "
+                             "(zero trees)")
+        mv = self._build(trees, K, F, degrade_trees)
+        if meta:
+            mv.meta.update(meta)
+        n_warm = self._warm(mv, max_batch_rows)
+        with self._lock:
+            if self._active is not None:
+                self._history.append(self._active)
+                del self._history[:-self._keep]
+            self._active = mv
+        if self._metrics is not None:
+            self._metrics.on_swap()
+        log_info(f"serve: published {mv.tag} ({mv.n_trees} trees, "
+                 f"{n_warm} warmed executables)")
+        return mv.tag
+
+    def rollback(self) -> str:
+        """Swap back to the previous version (instant: its compiled cache
+        was retained).  Returns the now-current tag."""
+        with self._lock:
+            if not self._history:
+                raise RuntimeError("rollback(): no previous version")
+            self._active = self._history.pop()
+            tag = self._active.tag
+        if self._metrics is not None:
+            self._metrics.on_swap(rollback=True)
+        log_info(f"serve: rolled back to {tag}")
+        return tag
+
+    def current(self) -> ModelVersion:
+        """Atomic read of the active version; the dispatcher calls this
+        once per batch so a swap never splits a batch across versions."""
+        with self._lock:
+            if self._active is None:
+                raise RuntimeError("no model published yet")
+            return self._active
+
+    def current_tag(self) -> Optional[str]:
+        with self._lock:
+            return self._active.tag if self._active is not None else None
+
+    def versions(self) -> List[str]:
+        with self._lock:
+            out = [m.tag for m in self._history]
+            if self._active is not None:
+                out.append(self._active.tag)
+            return out

@@ -1,0 +1,316 @@
+"""Deadline-aware micro-batching server over the batched inference engine.
+
+The reference's Predictor is an offline application: OMP threads walk a
+file of rows as fast as the cores allow (predictor.hpp:29-160).  Online
+traffic inverts the problem — requests arrive one at a time from many
+clients, and the device engine (models/predict.py) only earns its keep
+when rows are batched into its power-of-two compile buckets.  The piece
+in between is this module's micro-batcher, and its one policy knob is
+explicit: a batch dispatches when it FILLS (``max_batch_rows``, device
+occupancy wins) or when its OLDEST request has waited
+``max_batch_delay_ms`` (p99 latency wins) — the classic occupancy/latency
+trade made visible instead of emergent.
+
+Admission control is a bounded queue priced in ROWS: a submit that would
+push the backlog past ``queue_depth_rows`` is shed immediately with
+:class:`ServerOverloaded` (the caller knows NOW, instead of everyone
+queueing into an OOM).  Under a configured backlog fraction the dispatcher
+degrades to the version's truncated-tree predictor (fewer trees =
+strictly less walk work per row) and flags the response ``degraded`` —
+cheaper answers beat failed answers during an overload spike.
+
+All device work happens on the single dispatcher thread;
+``Server.submit()`` is thread-safe and blocks the calling thread until
+its rows come back.  Every response echoes the model-version tag that
+computed it (see registry.py for the hot-swap contract).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ..utils.log import log_info, log_warning
+from .metrics import ServeMetrics
+from .registry import ModelRegistry, ModelVersion
+
+
+class ServeError(RuntimeError):
+    """Base class of the serving-path failures."""
+
+
+class ServerOverloaded(ServeError):
+    """Admission control shed this request (bounded queue was full)."""
+
+
+class RequestTimeout(ServeError):
+    """The request's deadline expired while it sat in the queue."""
+
+
+class ServerClosed(ServeError):
+    """The server is shut down; no further requests are accepted."""
+
+
+@dataclass
+class ServeConfig:
+    """Serving policy knobs (mirrored by the ``serve_*`` names in
+    config.py for the CLI path; defaults match)."""
+
+    max_batch_rows: int = 1024          # bucket to fill before dispatch
+    max_batch_delay_ms: float = 2.0     # oldest-request deadline budget
+    queue_depth_rows: int = 4096        # admission bound (rows, not reqs)
+    timeout_ms: float = 0.0             # per-request timeout; 0 = off
+    degrade_trees: int = 0              # truncated-tree overload predictor
+    degrade_queue_frac: float = 0.5     # backlog fraction that triggers it
+    f64_scores: bool = False            # exact f64 reconstruction per batch
+    metrics_window: int = 8192
+    predictor_kwargs: Dict[str, Any] = field(default_factory=dict)
+
+    def __post_init__(self):
+        self.max_batch_rows = max(int(self.max_batch_rows), 1)
+        self.max_batch_delay_ms = max(float(self.max_batch_delay_ms), 0.0)
+        self.queue_depth_rows = max(int(self.queue_depth_rows),
+                                    self.max_batch_rows)
+        self.timeout_ms = max(float(self.timeout_ms), 0.0)
+        self.degrade_trees = max(int(self.degrade_trees), 0)
+        self.degrade_queue_frac = min(max(
+            float(self.degrade_queue_frac), 0.0), 1.0)
+
+
+@dataclass
+class ServeResult:
+    """One completed request: raw scores plus the serving provenance."""
+
+    values: np.ndarray          # (n, K) raw scores
+    version: str                # model-version tag that computed them
+    latency_ms: float
+    degraded: bool = False
+    batch_rows: int = 0         # rows in the device batch that carried it
+
+
+class _Request:
+    __slots__ = ("rows", "n", "t_enq", "deadline", "event", "result",
+                 "error")
+
+    def __init__(self, rows: np.ndarray, deadline: Optional[float]):
+        self.rows = rows
+        self.n = rows.shape[0]
+        self.t_enq = time.monotonic()
+        self.deadline = deadline
+        self.event = threading.Event()
+        self.result: Optional[ServeResult] = None
+        self.error: Optional[BaseException] = None
+
+
+class Server:
+    """In-process serving front-end: thread-safe ``submit()``, versioned
+    ``publish()``/``rollback()``, bounded queue, one dispatcher thread."""
+
+    def __init__(self, model=None, config: Optional[ServeConfig] = None,
+                 registry: Optional[ModelRegistry] = None):
+        self.config = config or ServeConfig()
+        self.metrics = ServeMetrics(window=self.config.metrics_window)
+        self.registry = registry or ModelRegistry(
+            metrics=self.metrics,
+            predictor_kwargs=self.config.predictor_kwargs)
+        self._cond = threading.Condition()
+        self._queue: deque = deque()
+        self._queue_rows = 0
+        self._closed = False
+        self._dispatcher = threading.Thread(
+            target=self._dispatch_loop, name="serve-dispatcher", daemon=True)
+        if model is not None:
+            self.publish(model)
+        self._dispatcher.start()
+
+    # -- model lifecycle -------------------------------------------------
+    def publish(self, model, **meta) -> str:
+        """Prebin/stack/warm the new ensemble OFF the serving path, then
+        atomically swap it in (registry.py).  In-flight batches finish on
+        the old version; the tag is echoed in every response."""
+        return self.registry.publish(
+            model, degrade_trees=self.config.degrade_trees,
+            max_batch_rows=self.config.max_batch_rows, meta=meta or None)
+
+    def rollback(self) -> str:
+        return self.registry.rollback()
+
+    def version(self) -> Optional[str]:
+        return self.registry.current_tag()
+
+    # -- request path ----------------------------------------------------
+    def submit(self, rows, timeout_ms: Optional[float] = None) -> ServeResult:
+        """Block until the rows are scored; raises
+        :class:`ServerOverloaded` (queue full), :class:`RequestTimeout`
+        (deadline expired in queue) or :class:`ServerClosed`."""
+        mv = self.registry.current()          # raises before queueing when
+        X = np.asarray(rows, np.float64)      # nothing is published yet
+        if X.ndim == 1:
+            X = X.reshape(1, -1)
+        if X.ndim != 2 or X.shape[1] != mv.num_features:
+            raise ValueError(
+                f"submit() rows have {X.shape[-1] if X.ndim else 0} "
+                f"features; the serving model has {mv.num_features}")
+        t_ms = self.config.timeout_ms if timeout_ms is None else timeout_ms
+        deadline = (time.monotonic() + t_ms / 1e3) if t_ms > 0 else None
+        req = _Request(X, deadline)
+        with self._cond:
+            if self._closed:
+                raise ServerClosed("server is shut down")
+            if self._queue_rows + req.n > self.config.queue_depth_rows:
+                self.metrics.on_shed()
+                raise ServerOverloaded(
+                    f"queue full ({self._queue_rows} rows backlogged, "
+                    f"depth {self.config.queue_depth_rows})")
+            self._queue.append(req)
+            self._queue_rows += req.n
+            self.metrics.on_submit(req.n, self._queue_rows)
+            self._cond.notify()
+        req.event.wait()
+        if req.error is not None:
+            raise req.error
+        assert req.result is not None
+        return req.result
+
+    def metrics_snapshot(self) -> Dict[str, Any]:
+        snap = self.metrics.snapshot()
+        snap["version"] = self.registry.current_tag()
+        snap["versions"] = self.registry.versions()
+        return snap
+
+    def close(self) -> None:
+        """Stop the dispatcher; pending requests fail with ServerClosed."""
+        with self._cond:
+            if self._closed:
+                return
+            self._closed = True
+            pending = list(self._queue)
+            self._queue.clear()
+            self._queue_rows = 0
+            self._cond.notify_all()
+        for req in pending:
+            req.error = ServerClosed("server shut down with request queued")
+            req.event.set()
+        self._dispatcher.join(timeout=5.0)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    # -- dispatcher ------------------------------------------------------
+    def _collect_batch(self) -> Optional[List[_Request]]:
+        """Deadline-aware collection: return a batch when the pending rows
+        fill ``max_batch_rows`` or the oldest request's delay budget is
+        spent; otherwise keep waiting on the condition."""
+        cfg = self.config
+        delay_s = cfg.max_batch_delay_ms / 1e3
+        with self._cond:
+            while True:
+                if self._closed:
+                    return None
+                if self._queue:
+                    now = time.monotonic()
+                    dispatch_at = self._queue[0].t_enq + delay_s
+                    if (self._queue_rows >= cfg.max_batch_rows
+                            or now >= dispatch_at):
+                        batch: List[_Request] = []
+                        rows = 0
+                        while self._queue and (
+                                not batch
+                                or rows + self._queue[0].n
+                                <= cfg.max_batch_rows):
+                            r = self._queue.popleft()
+                            batch.append(r)
+                            rows += r.n
+                        self._queue_rows -= rows
+                        return batch
+                    self._cond.wait(dispatch_at - now)
+                else:
+                    self._cond.wait(0.1)
+
+    def _dispatch_loop(self) -> None:
+        while True:
+            batch = self._collect_batch()
+            if batch is None:
+                return
+            try:
+                self._run_batch(batch)
+            except BaseException as e:  # noqa: BLE001 — a poisoned batch
+                # must fail ITS requests, never kill the dispatcher
+                for req in batch:
+                    if not req.event.is_set():
+                        self.metrics.on_error()
+                        req.error = e
+                        req.event.set()
+                log_warning(f"serve: batch failed "
+                            f"({type(e).__name__}: {e})")
+
+    def _run_batch(self, batch: List[_Request]) -> None:
+        now = time.monotonic()
+        live: List[_Request] = []
+        for req in batch:
+            if req.deadline is not None and now > req.deadline:
+                self.metrics.on_timeout()
+                req.error = RequestTimeout(
+                    f"deadline expired after "
+                    f"{(now - req.t_enq) * 1e3:.1f} ms in queue")
+                req.event.set()
+            else:
+                live.append(req)
+        if not live:
+            return
+        mv: ModelVersion = self.registry.current()
+        with self._cond:
+            backlog = self._queue_rows
+        degraded = (mv.degraded is not None
+                    and backlog >= self.config.degrade_queue_frac
+                    * self.config.queue_depth_rows)
+        bp = mv.degraded if degraded else mv.predictor
+        X = (live[0].rows if len(live) == 1
+             else np.concatenate([r.rows for r in live], axis=0))
+        n = X.shape[0]
+        out = np.asarray(bp.predict_raw(
+            X, f64_exact=self.config.f64_scores))
+        self.metrics.on_batch(n, bp.bucket_for(n), backlog)
+        done = time.monotonic()
+        lo = 0
+        for req in live:
+            vals = out[lo: lo + req.n]
+            lo += req.n
+            lat_ms = (done - req.t_enq) * 1e3
+            req.result = ServeResult(values=vals, version=mv.tag,
+                                     latency_ms=lat_ms, degraded=degraded,
+                                     batch_rows=n)
+            self.metrics.on_complete(lat_ms, degraded)
+            req.event.set()
+
+
+def build_server(booster, config) -> Server:
+    """CLI glue: a :class:`Server` from a Booster + the global Config's
+    ``serve_*`` knobs (cli.py task=serve)."""
+    sc = ServeConfig(
+        max_batch_rows=config.serve_max_batch_rows,
+        max_batch_delay_ms=config.serve_max_batch_delay_ms,
+        queue_depth_rows=config.serve_queue_depth,
+        timeout_ms=config.serve_timeout_ms,
+        degrade_trees=config.serve_degrade_trees,
+        f64_scores=config.predict_f64_scores,
+        predictor_kwargs={
+            "bucket_min": config.predict_bucket_min,
+            "cache_entries": config.predict_cache_entries,
+        },
+    )
+    server = Server(booster, config=sc)
+    log_info(f"serve: model {server.version()} online "
+             f"({booster.num_trees()} trees, "
+             f"batch<= {sc.max_batch_rows} rows, "
+             f"delay {sc.max_batch_delay_ms} ms, "
+             f"queue {sc.queue_depth_rows} rows)")
+    return server

@@ -1,0 +1,28 @@
+"""Cross-jax-version compatibility shims.
+
+The container and the device driver run different jax releases, so
+version-sensitive call signatures are mapped at the call site instead of
+pinning a version — the same pattern as the ``shard_map``
+``check_vma``/``check_rep`` wrapper in ``parallel/trainer.py``.
+"""
+
+from __future__ import annotations
+
+
+def lowered_text(lowered, debug_info: bool = False) -> str:
+    """``Lowered.as_text`` across jax versions.
+
+    New jax spells debug locations ``as_text(debug_info=True)``; jax <=
+    0.4.x has no such kwarg and its plain ``as_text()`` STRIPS location
+    info (named scopes live in ``loc(...)`` attributes) — there the MLIR
+    module's own ``get_asm(enable_debug_info=True)`` recovers the same
+    text, so callers asserting on ``jax.named_scope`` names (the
+    USE_TIMETAG trace-attribution story, tests/test_aux.py) work on both
+    releases."""
+    try:
+        return lowered.as_text(debug_info=debug_info)
+    except TypeError:
+        if not debug_info:
+            return lowered.as_text()
+        return lowered.compiler_ir(dialect="stablehlo").operation.get_asm(
+            enable_debug_info=True)

@@ -115,6 +115,38 @@ def test_prediction_section_renders_split_fields():
     assert "No predict fields" in txt
 
 
+def test_serving_section_renders_serve_fields():
+    """The Serving section (PR 5) is generated from the BENCH serve_*
+    fields (bench.py measure_serve via tools/loadgen.py): the loadgen
+    table, the hot-swap version accounting, the overload shed/bounded-
+    queue line and the serve_ok guard all grep to record fields."""
+    import perf_report
+
+    rec = {
+        "serve_requests": 1700, "serve_offered_qps": 400.0,
+        "serve_qps": 386.2, "serve_p50_ms": 3.225, "serve_p99_ms": 16.646,
+        "serve_p999_ms": 23.675, "serve_batch_occupancy": 0.0666,
+        "serve_shed_frac": 0.0, "serve_swap_count": 2,
+        "serve_versions": {"v1": 1081, "v2": 619},
+        "serve_overload_shed_frac": 0.2527,
+        "serve_overload_queue_max": 256, "serve_overload_queue_ok": True,
+        "serve_ok": True,
+    }
+    lines = []
+    perf_report.serving_section(lines.append, rec)
+    txt = "\n".join(lines)
+    assert "## Serving" in txt
+    for needle in ("386.2", "16.646", "0.0666", "v1: 1081", "v2: 619",
+                   "0.2527", "serve_ok=True", "bit-identical",
+                   "never unbounded growth"):
+        assert needle in txt, needle
+    # a record with no serve capture renders the placeholder, never dies
+    lines = []
+    perf_report.serving_section(lines.append, {})
+    txt = "\n".join(lines)
+    assert "No serve fields" in txt
+
+
 def test_comm_section_renders_in_perf_md():
     """PERF.md (generated output) must carry the Cross-chip comms section
     and its figures must grep to the analytic formula."""

@@ -209,6 +209,42 @@ def test_cache_zero_retraces_within_bucket(bin_model, rng):
     assert bp.cache_stats()["entries"] >= 2
 
 
+def test_cache_lru_bound_and_info(bin_model, xt_nan, rng):
+    """The jit cache is LRU-bounded over (bucket, kind) keys: a server
+    seeing many batch shapes must never accumulate compiled executables
+    without limit.  Eviction costs a retrace on re-touch but never
+    correctness."""
+    trees = bin_model._all_trees()
+    bp = BatchPredictor(trees, 1, 8, bucket_min=8, cache_entries=4)
+    ref = _host_raw(bin_model, xt_nan[:64])
+    for n in (8, 16, 32, 64):        # 4 buckets x (leaf + scores) entries
+        bp.predict_raw(rng.randn(n, 8))
+    info = bp.cache_info()
+    assert info["capacity"] == 4
+    assert info["entries"] <= 4, info
+    assert info["evictions"] >= 4, info
+    assert info["misses"] >= 8 and info["traces"] >= 8
+    # the LRU-evicted 8-bucket retraces on re-touch — and stays correct
+    t0 = bp.trace_count
+    out = bp.predict_raw(xt_nan[:8], f64_exact=True)
+    assert bp.trace_count > t0
+    assert np.array_equal(out[:, 0], ref[:8])
+    # hits: an in-cache bucket served twice back to back never retraces
+    bp.predict_raw(rng.randn(64, 8))
+    h0, t1 = bp.cache_info()["hits"], bp.trace_count
+    bp.predict_raw(rng.randn(64, 8))
+    assert bp.cache_info()["hits"] > h0 and bp.trace_count == t1
+    # capacity floor: the walk and its scores executable share a bucket
+    assert BatchPredictor(trees, 1, 8, cache_entries=0).cache_capacity == 2
+
+
+def test_booster_plumbs_cache_entries(bin_model, rng):
+    bin_model._device_pred_cache = None   # predictor key ignores kwargs
+    bin_model.predict(rng.randn(50, 8), predict_method="depthwise",
+                      predict_cache_entries=6)
+    assert bin_model._device_pred_cache[1].cache_capacity == 6
+
+
 def test_cache_leaf_and_raw_share_walk(bin_model, rng):
     bp = BatchPredictor(bin_model._all_trees(), 1, 8)
     bp.predict_leaf(rng.randn(300, 8))

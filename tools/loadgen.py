@@ -1,0 +1,229 @@
+"""Open-loop Poisson load generator for the serve/ subsystem.
+
+Open-loop means arrivals are scheduled from the Poisson process ALONE —
+a slow server cannot slow the offered load down (the closed-loop
+fallacy: measuring a server with clients that politely wait understates
+tail latency exactly when it matters).  Arrival times are drawn once
+from exponential inter-arrivals at ``rate_qps``; a pool of client
+threads sleeps until each scheduled instant and then blocks in
+``Server.submit()`` like a real caller, so queueing delay lands in the
+measured latency, not in the arrival schedule.
+
+Core entry point (used by ``bench.py``'s serve block and the
+``__graft_entry__`` smoke):
+
+    run_loadgen(server, X, rate_qps=..., duration_s=..., ...) -> dict
+
+with client-side outcome counts (ok/shed/timeout), client-measured
+latency quantiles, achieved vs offered QPS, and the server's own
+metrics snapshot.  Optional mid-run hooks drive a hot-swap under live
+traffic (``swap_at_frac`` + ``swap_fn``).
+
+CLI: ``python tools/loadgen.py input_model=<model.txt> [rate=500]
+[duration=5] [rows=1] [features from the model]`` — builds an
+in-process server on the model and prints one JSON line of ``serve_*``
+fields.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _quantile(sorted_vals: List[float], q: float) -> Optional[float]:
+    if not sorted_vals:
+        return None
+    i = min(int(q * len(sorted_vals)), len(sorted_vals) - 1)
+    return round(sorted_vals[i], 3)
+
+
+def run_loadgen(server, X: np.ndarray, *, rate_qps: float,
+                duration_s: float, rows_per_req: int = 1,
+                n_threads: int = 8, seed: int = 0,
+                swap_at_frac: Optional[float] = None,
+                swap_fn: Optional[Callable[[], None]] = None,
+                tail_requests_after_swap: int = 0,
+                check_fn: Optional[Callable] = None) -> Dict[str, object]:
+    """Drive ``server.submit`` with open-loop Poisson arrivals.
+
+    ``X`` is the row pool (requests sample ``rows_per_req`` consecutive
+    rows from it).  ``swap_fn`` (e.g. a ``server.publish`` closure) runs
+    once from a side thread when ``swap_at_frac`` of the schedule has
+    elapsed — the hot-swap-under-live-traffic probe; because a publish
+    warms compile caches off the serving path, it can outlast a short
+    window, so ``tail_requests_after_swap`` sends that many extra
+    sequential requests once the swap has completed (deterministic
+    post-swap coverage for the per-version parity check).
+    ``check_fn(start, n_rows, result)`` may verify each response (parity
+    bookkeeping); check failures are counted, never raised mid-run."""
+    from lightgbmv1_tpu.serve.server import (RequestTimeout,
+                                             ServerOverloaded)
+
+    rng = np.random.RandomState(seed)
+    n_arrivals = max(int(rate_qps * duration_s), 1)
+    gaps = rng.exponential(1.0 / max(rate_qps, 1e-9), size=n_arrivals)
+    arrivals = np.cumsum(gaps)
+    starts = rng.randint(0, max(X.shape[0] - rows_per_req, 1),
+                         size=n_arrivals)
+
+    next_idx = [0]
+    idx_lock = threading.Lock()
+    out_lock = threading.Lock()
+    stats = {"ok": 0, "shed": 0, "timeout": 0, "error": 0,
+             "check_failures": 0, "degraded": 0}
+    latencies: List[float] = []
+    versions: Dict[str, int] = {}
+    t0 = time.monotonic()
+
+    def do_one(s: int):
+        rows = X[s: s + rows_per_req]
+        t_req = time.monotonic()
+        try:
+            res = server.submit(rows)
+        except ServerOverloaded:
+            with out_lock:
+                stats["shed"] += 1
+            return
+        except RequestTimeout:
+            with out_lock:
+                stats["timeout"] += 1
+            return
+        except Exception:  # noqa: BLE001 — counted, run continues
+            with out_lock:
+                stats["error"] += 1
+            return
+        lat = (time.monotonic() - t_req) * 1e3
+        ok = True
+        if check_fn is not None:
+            try:
+                ok = bool(check_fn(s, rows_per_req, res))
+            except Exception:  # noqa: BLE001
+                ok = False
+        with out_lock:
+            stats["ok"] += 1
+            if res.degraded:
+                stats["degraded"] += 1
+            if not ok:
+                stats["check_failures"] += 1
+            latencies.append(lat)
+            versions[res.version] = versions.get(res.version, 0) + 1
+
+    def client():
+        while True:
+            with idx_lock:
+                i = next_idx[0]
+                if i >= n_arrivals:
+                    return
+                next_idx[0] += 1
+            delay = t0 + arrivals[i] - time.monotonic()
+            if delay > 0:
+                time.sleep(delay)
+            do_one(int(starts[i]))
+
+    threads = [threading.Thread(target=client, daemon=True)
+               for _ in range(max(int(n_threads), 1))]
+    swapper = None
+    if swap_fn is not None and swap_at_frac is not None:
+        swap_t = t0 + float(arrivals[-1]) * float(swap_at_frac)
+
+        def do_swap():
+            dt = swap_t - time.monotonic()
+            if dt > 0:
+                time.sleep(dt)
+            swap_fn()
+
+        swapper = threading.Thread(target=do_swap, daemon=True)
+        swapper.start()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    if swapper is not None:
+        swapper.join()
+        tail_starts = rng.randint(0, max(X.shape[0] - rows_per_req, 1),
+                                  size=max(int(tail_requests_after_swap), 0))
+        for s in tail_starts:
+            do_one(int(s))
+    wall = time.monotonic() - t0
+
+    lat = sorted(latencies)
+    total = sum(stats[k] for k in ("ok", "shed", "timeout", "error"))
+    snap = server.metrics_snapshot()
+    return {
+        "offered_qps": round(rate_qps, 1),
+        "achieved_qps": round(stats["ok"] / wall, 1) if wall > 0 else None,
+        "duration_s": round(wall, 2),
+        "requests": total,
+        **stats,
+        "shed_frac": round(stats["shed"] / total, 4) if total else 0.0,
+        "client_p50_ms": _quantile(lat, 0.50),
+        "client_p99_ms": _quantile(lat, 0.99),
+        "client_p999_ms": _quantile(lat, 0.999),
+        "versions_served": versions,
+        "server_metrics": snap,
+    }
+
+
+def serve_record_fields(lg: Dict[str, object]) -> Dict[str, object]:
+    """Map a ``run_loadgen`` result onto the flat ``serve_*`` BENCH
+    fields (bench.py's serve block and tools/perf_report.py render
+    these)."""
+    snap = lg.get("server_metrics", {}) or {}
+    return {
+        "serve_qps": lg.get("achieved_qps"),
+        "serve_offered_qps": lg.get("offered_qps"),
+        "serve_requests": lg.get("requests"),
+        "serve_p50_ms": lg.get("client_p50_ms"),
+        "serve_p99_ms": lg.get("client_p99_ms"),
+        "serve_p999_ms": lg.get("client_p999_ms"),
+        "serve_batch_occupancy": snap.get("batch_occupancy"),
+        "serve_mean_batch_rows": snap.get("mean_batch_rows"),
+        "serve_queue_depth_max": snap.get("queue_depth_max"),
+        "serve_shed_frac": lg.get("shed_frac"),
+        "serve_timeouts": lg.get("timeout"),
+        "serve_degraded": lg.get("degraded"),
+        "serve_swap_count": snap.get("swaps"),
+        "serve_versions": lg.get("versions_served"),
+    }
+
+
+def main(argv: List[str]) -> int:
+    from lightgbmv1_tpu.basic import Booster
+    from lightgbmv1_tpu.config import Config
+    from lightgbmv1_tpu.serve.server import build_server
+
+    kv = Config.kv2map(argv)
+    model_path = kv.pop("input_model", "")
+    if not model_path:
+        print(__doc__)
+        return 1
+    rate = float(kv.pop("rate", 500.0))
+    duration = float(kv.pop("duration", 5.0))
+    rows_per_req = int(kv.pop("rows", 1))
+    seed = int(kv.pop("seed", 0))
+    config = Config.from_dict(kv)
+    booster = Booster(params={"verbosity": config.verbosity},
+                      model_file=model_path)
+    server = build_server(booster, config)
+    rng = np.random.RandomState(seed + 1)
+    X = rng.randn(8192, booster.num_feature())
+    try:
+        lg = run_loadgen(server, X, rate_qps=rate, duration_s=duration,
+                         rows_per_req=rows_per_req, seed=seed)
+    finally:
+        server.close()
+    print(json.dumps({**serve_record_fields(lg), "loadgen": lg}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

@@ -173,6 +173,61 @@ def prediction_section(w, rec):
         w("")
 
 
+def serving_section(w, rec):
+    """Serving: the online-subsystem loadgen figures (serve/ — deadline-
+    aware micro-batching, hot-swap registry, bounded-queue admission
+    control) — every figure greps to a BENCH serve_* field written by
+    bench.py's measure_serve via tools/loadgen.py.  Renders a placeholder
+    until the first capture that carries the fields."""
+    w("## Serving (open-loop loadgen against the in-process server)")
+    w("")
+    if rec.get("serve_qps") is None:
+        w("No serve fields in this record yet — the next driver capture "
+          "runs bench.py's measure_serve (tools/loadgen.py open-loop "
+          "Poisson traffic with a mid-run hot-swap, then a bounded-queue "
+          "overload probe) and this section renders the QPS / latency "
+          "quantiles / batch occupancy / shed figures and the `serve_ok` "
+          "guard.")
+        w("")
+        return
+    w(f"{get(rec, 'serve_requests', 0)} requests at "
+      f"{get(rec, 'serve_offered_qps', 1)} offered QPS "
+      "(live phase, hot-swap mid-run):")
+    w("")
+    w("| achieved QPS | p50 ms | p99 ms | p999 ms | batch occupancy | "
+      "shed frac |")
+    w("|---|---|---|---|---|---|")
+    w(f"| {get(rec, 'serve_qps', 1)} | {get(rec, 'serve_p50_ms', 3)} | "
+      f"{get(rec, 'serve_p99_ms', 3)} | {get(rec, 'serve_p999_ms', 3)} | "
+      f"{get(rec, 'serve_batch_occupancy', 4)} | "
+      f"{get(rec, 'serve_shed_frac', 4)} |")
+    w("")
+    versions = rec.get("serve_versions") or {}
+    if versions:
+        served = ", ".join(f"{k}: {v}" for k, v in versions.items())
+        w(f"Hot swap under live traffic: versions served {{{served}}} "
+          f"across {get(rec, 'serve_swap_count', 0)} publishes — every "
+          "response bit-identical to `Booster.predict` of the version "
+          "tag it carries (checked per request by the loadgen).")
+        w("")
+    if rec.get("serve_overload_shed_frac") is not None:
+        w(f"Overload probe (2x+ capacity into a "
+          f"{get(rec, 'serve_overload_queue_max', 0)}-row-max queue): "
+          f"shed frac {get(rec, 'serve_overload_shed_frac', 4)} with the "
+          "backlog bounded at the configured admission depth "
+          f"(`serve_overload_queue_ok="
+          f"{rec.get('serve_overload_queue_ok')}`) — explicit rejection, "
+          "never unbounded growth.")
+        w("")
+    if rec.get("serve_ok") is not None:
+        w(f"Guard `serve_ok={rec.get('serve_ok')}`: zero "
+          "failed/incorrect responses in the live phase AND both "
+          "versions served across the swap AND the overload queue "
+          "stayed bounded (bench.py asserts the split; this report "
+          "surfaces it).")
+        w("")
+
+
 def fmt(v, nd=2):
     if v is None:
         return "—"
@@ -344,12 +399,23 @@ def generate(rec, name, prev=None, prev_name=None):
         w("")
         w("(Throughput from ONE long scanned window per family — the "
           "binary block's 500-iter methodology — after the old best-of-3 "
-          "short windows recorded 2x tunnel-drift swings.  The "
-          "multiclass mlogloss gap has a diagnostic A/B on record: "
-          "tools/mc_gap_ab.py.)")
+          "short windows recorded 2x tunnel-drift swings.)")
+        w("")
+        w("Multiclass parity config (tools/mc_gap_ab.py A/B, CPU smoke "
+          "on record): the mlogloss gap vs the reference is driven by "
+          "the WAVE SCHEDULE, not precision — `gpu_use_dp` (f32 "
+          "histograms) is bit-identical to base while "
+          "`leafwise_wave_size=1` diverges from base at tree 0.  "
+          "`leafwise_wave_size=1` is the documented parity setting (the "
+          "reference's exact sequential best-first order; "
+          "tests/test_wave_grower.py pins it reproducing the sequential "
+          "grower's trees on the multiclass smoke shape — see "
+          "BASELINE.md).")
         w("")
 
     prediction_section(w, rec)
+
+    serving_section(w, rec)
 
     mc_name, mc = load_multichip()
     comm_section(w, mc_name, mc)
