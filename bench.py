@@ -708,6 +708,27 @@ def measure_serve(gb_lw, X):
     return fields
 
 
+def measure_chaos():
+    """Robustness block (PR 6): the scripted fault suite (tools/chaos.py)
+    runs its fast deterministic subset on EVERY backend — kill-and-resume
+    (bit-identical model text), torn-snapshot fallback, poisoned
+    gradients (finite_guard detect + clamp), publish-of-garbage (the
+    corrupt model never serves), dispatcher stall/death (watchdog),
+    bounded-queue overload, and transient-H2D retry.  ``chaos_ok`` is
+    the guard: EVERY injected fault must be recovered."""
+    from tools.chaos import run_suite
+
+    rec = run_suite(fast=True)
+    return {
+        "chaos_ok": bool(rec["chaos_ok"]),
+        "chaos_n_scenarios": rec["n_scenarios"],
+        "chaos_scenarios": {k: bool(v.get("ok"))
+                            for k, v in rec["scenarios"].items()},
+        "chaos_seconds": round(sum(v.get("seconds", 0)
+                                   for v in rec["scenarios"].values()), 1),
+    }
+
+
 def main():
     import jax
 
@@ -1071,6 +1092,15 @@ def main():
     except Exception as e:  # noqa: BLE001 — partial records beat none
         extra["serve_error"] = f"{type(e).__name__}: {e}"[:200]
         extra["serve_ok"] = False
+
+    # Robustness block (PR 6): the scripted chaos suite on every backend
+    # — every injected fault (kill/torn-file/NaN/stall/garbage-publish/
+    # overload/transient-H2D) must be recovered or the record flags it.
+    try:
+        extra.update(measure_chaos())
+    except Exception as e:  # noqa: BLE001
+        extra["chaos_error"] = f"{type(e).__name__}: {e}"[:200]
+        extra["chaos_ok"] = False
 
     # Cross-chip comm pricing (analytic, parallel/cluster.py — the same
     # single-source formula the trainer logs and dryrun_multichip

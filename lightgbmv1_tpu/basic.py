@@ -353,6 +353,9 @@ class Booster:
         self.best_score: Dict[str, Dict[str, float]] = {}
         self._gbdt: Optional[GBDT] = None
         self._loaded: Optional[LoadedModel] = None
+        self._loaded_str: Optional[str] = None   # source text of _loaded
+                                                 # (checkpoint bundles
+                                                 # re-embed it verbatim)
         self.train_set = train_set
         self._name_valid_sets: List[str] = []
         self._pred_objective = None
@@ -371,10 +374,12 @@ class Booster:
                 # boosting.cpp:46+, init score from the old model's
                 # prediction, application.cpp:90-93)
                 if isinstance(init_model, Booster):
-                    self._loaded = model_from_string(init_model.model_to_string())
+                    base_str = init_model.model_to_string()
                 else:
                     with fileio.open_file(init_model) as fh:
-                        self._loaded = model_from_string(fh.read())
+                        base_str = fh.read()
+                self._loaded = model_from_string(base_str)
+                self._loaded_str = base_str
                 if self._loaded.average_output:
                     log_fatal("Continued training from an RF (average_output)"
                               " model is not supported")
@@ -399,6 +404,7 @@ class Booster:
     # ------------------------------------------------------------------
     def _init_from_string(self, s: str) -> None:
         self._loaded = model_from_string(s)
+        self._loaded_str = s
         params = {"objective": self._loaded.objective}
         if self._loaded.num_class > 1:
             params["num_class"] = self._loaded.num_class
@@ -449,14 +455,19 @@ class Booster:
         if train_set is not None:
             log_fatal("Resetting train_set is not supported")
         if fobj is None:
-            return self._gbdt.train_one_iter()
-        preds = self._gbdt.raw_train_scores()
-        if self._gbdt.num_class == 1:
-            preds = preds[:, 0]
-        grad, hess = fobj(preds, self.train_set)
-        return self._gbdt.train_one_iter(
-            custom_grad=np.asarray(grad), custom_hess=np.asarray(hess)
-        )
+            finished = self._gbdt.train_one_iter()
+        else:
+            preds = self._gbdt.raw_train_scores()
+            if self._gbdt.num_class == 1:
+                preds = preds[:, 0]
+            grad, hess = fobj(preds, self.train_set)
+            finished = self._gbdt.train_one_iter(
+                custom_grad=np.asarray(grad), custom_hess=np.asarray(hess)
+            )
+        # finite_guard=warn|raise: one scalar device read per iteration
+        # boundary; off (default) costs nothing (models/gbdt.py)
+        self._gbdt.check_finite_boundary()
+        return finished
 
     def rollback_one_iter(self) -> "Booster":
         if self._gbdt is not None:
@@ -803,6 +814,7 @@ class Booster:
         new_booster._gbdt = None
         new_booster.train_set = None
         new_booster._name_valid_sets = []
+        new_booster._loaded_str = None
         if self._loaded is not None and self._gbdt is None:
             loaded = deepcopy(self._loaded)
         else:
@@ -884,8 +896,59 @@ class Booster:
 
     def save_model(self, filename, num_iteration: Optional[int] = None,
                    start_iteration: int = 0) -> "Booster":
-        with fileio.open_file(filename, "w") as fh:
-            fh.write(self.model_to_string(num_iteration, start_iteration))
+        # crash-consistent by construction: tmp+fsync+rename, so a kill
+        # mid-save leaves the previous model file intact instead of a
+        # truncated one (the pre-PR-6 snapshot failure mode)
+        fileio.atomic_write_text(
+            str(filename), self.model_to_string(num_iteration,
+                                                start_iteration),
+            site=str(filename))
+        return self
+
+    # ------------------------------------------------------------------
+    def save_checkpoint(self, path) -> "Booster":
+        """Write a crash-consistent full-trainer-state bundle
+        (io/checkpoint.py): model text + score caches + RNG/bagging/DART
+        state + iteration counter, atomically.  A training run resumed
+        from this bundle (:meth:`resume_from_checkpoint`) continues
+        BIT-EXACTLY — the final model text matches the uninterrupted
+        run's byte for byte (tests/test_checkpoint.py)."""
+        if self._gbdt is None:
+            log_fatal("save_checkpoint() requires a training Booster")
+        from .io.checkpoint import write_checkpoint
+
+        manifest, arrays = self._gbdt.capture_state()
+        manifest["num_trees_total"] = self.num_trees()
+        write_checkpoint(str(path), manifest, arrays,
+                         model_text=self.model_to_string(),
+                         base_model_text=(self._loaded_str
+                                          if self._loaded is not None
+                                          else "") or "")
+        return self
+
+    def resume_from_checkpoint(self, path_or_bundle) -> "Booster":
+        """Restore a bundle into this FRESH training Booster (same data,
+        same config, valid sets already attached).  Accepts a path or a
+        pre-loaded ``io.checkpoint.load_checkpoint`` dict.  The bundle is
+        fully validated (digests + ``validate_host_tree`` on the model
+        text) before any state is touched; raises ``CheckpointError``
+        otherwise."""
+        if self._gbdt is None:
+            log_fatal("resume_from_checkpoint() requires a training "
+                      "Booster (construct with train_set=...)")
+        from .io.checkpoint import load_checkpoint
+        from .io.model_text import model_from_string
+
+        bundle = (path_or_bundle
+                  if isinstance(path_or_bundle, dict)
+                  else load_checkpoint(str(path_or_bundle)))
+        base = bundle.get("base_model_text", "")
+        if base and self._loaded is None:
+            # the checkpointed run itself continued from an input_model:
+            # restore the loaded-tree prefix so tree indexing matches
+            self._loaded = model_from_string(base)
+            self._loaded_str = base
+        self._gbdt.restore_state(bundle["manifest"], bundle["arrays"])
         return self
 
     def dump_model(self, num_iteration: Optional[int] = None,

@@ -80,17 +80,77 @@ def _load_dataset(config: Config, path: str,
     )
 
 
-def _find_latest_snapshot(output_model: str):
-    """Latest ``<output_model>.snapshot_iter_N`` on disk, or None."""
+def _iter_artifacts(output_model: str):
+    """``[(iteration, kind, path)]`` of on-disk resume artifacts:
+    ``kind`` is ``"ckpt"`` (full trainer-state bundle, bit-exact resume)
+    or ``"snapshot"`` (model text, approximate continued training)."""
     import glob
     import re
 
-    best, best_iter = None, -1
-    for p in glob.glob(glob.escape(output_model) + ".snapshot_iter_*"):
-        m = re.search(r"\.snapshot_iter_(\d+)$", p)
-        if m and int(m.group(1)) > best_iter:
-            best, best_iter = p, int(m.group(1))
-    return best, best_iter
+    out = []
+    for kind, tag in (("ckpt", ".ckpt_iter_"),
+                      ("snapshot", ".snapshot_iter_")):
+        for p in glob.glob(glob.escape(output_model) + tag + "*"):
+            m = re.search(r"_iter_(\d+)$", p)
+            if m:
+                out.append((int(m.group(1)), kind, p))
+    return out
+
+
+def _find_resume_point(output_model: str):
+    """Newest VALID resume artifact as ``(kind, path, done_iters,
+    bundle)``; ``(None, None, 0, None)`` when nothing intact exists.
+
+    ANY intact checkpoint bundle is preferred over ANY model-text
+    snapshot — even one at a higher iteration: a bundle resumes
+    bit-exactly, so iterations "lost" to a torn newer file are recomputed
+    IDENTICALLY (pure compute cost), while a model-text resume is
+    approximate forever.  Every candidate is VALIDATED before it is
+    chosen — a torn or corrupted newest file (kill mid-write under the
+    legacy non-atomic writer, bit rot, a partial copy) makes the scan
+    fall back to the previous intact artifact instead of crashing or
+    silently mistraining the resumed run."""
+    arts = _iter_artifacts(output_model)
+    # all bundles (newest first), then all snapshots (newest first)
+    arts.sort(key=lambda t: (t[1] == "ckpt", t[0]), reverse=True)
+    for it, kind, path in arts:
+        if kind == "ckpt":
+            try:
+                from .io.checkpoint import load_checkpoint
+
+                bundle = load_checkpoint(path)
+                return kind, path, int(bundle["manifest"]["iteration"]), \
+                    bundle
+            except Exception as e:  # noqa: BLE001 — fall back, loudly
+                log_warning(f"Ignoring invalid checkpoint {path} "
+                            f"({type(e).__name__}: {e}); falling back")
+        else:
+            try:
+                from .io.model_text import model_from_string
+                from .utils import fileio
+
+                with fileio.open_file(path) as fh:
+                    model_from_string(fh.read())   # validate_host_tree
+                return kind, path, it, None
+            except Exception as e:  # noqa: BLE001
+                log_warning(f"Ignoring invalid snapshot {path} "
+                            f"({type(e).__name__}: {e}); falling back")
+    return None, None, 0, None
+
+
+def _prune_snapshots(output_model: str, keep: int) -> None:
+    """Bound the on-disk footprint: keep the newest ``keep`` artifacts of
+    EACH kind (>= 2, so a torn newest always has an intact predecessor)."""
+    by_kind = {"ckpt": [], "snapshot": []}
+    for it, kind, path in _iter_artifacts(output_model):
+        by_kind[kind].append((it, path))
+    for arts in by_kind.values():
+        arts.sort(reverse=True)
+        for _, path in arts[max(keep, 2):]:
+            try:
+                os.remove(path)
+            except OSError:  # pragma: no cover — already gone is fine
+                pass
 
 
 def run_train(config: Config) -> Booster:
@@ -105,15 +165,21 @@ def run_train(config: Config) -> Booster:
         train_set.save_binary(config.data + ".bin")
     init_model = config.input_model or None
     done_iters = 0
+    resume_bundle = None
     if init_model is None and config.snapshot_freq > 0 \
             and not os.path.exists(config.output_model):
-        # crash recovery: resume from the newest snapshot automatically —
-        # but ONLY when the final model is absent (i.e. the previous run
-        # crashed); a completed run's leftover snapshots never hijack a
-        # fresh training run (the reference's recovery story is snapshots +
-        # manual restart via input_model; this closes the loop)
-        snap, done_iters = _find_latest_snapshot(config.output_model)
-        if snap is not None:
+        # crash recovery: resume from the newest VALIDATED artifact
+        # automatically — but ONLY when the final model is absent (i.e.
+        # the previous run crashed); a completed run's leftover snapshots
+        # never hijack a fresh training run.  Checkpoint bundles resume
+        # BIT-EXACTLY (full trainer state, io/checkpoint.py); model-text
+        # snapshots remain as the approximate fallback.
+        kind, snap, done_iters, resume_bundle = _find_resume_point(
+            config.output_model)
+        if kind == "ckpt":
+            log_info(f"Resuming bit-exactly from checkpoint {snap} "
+                     f"({done_iters} iterations already trained)")
+        elif kind == "snapshot":
             log_info(f"Resuming from snapshot {snap} ({done_iters} "
                      "iterations already trained)")
             init_model = snap
@@ -131,6 +197,9 @@ def run_train(config: Config) -> Booster:
                                         init_score_file=vinit),
                           name)
         valid_names.append(name)
+    if resume_bundle is not None:
+        # after add_valid: the valid score caches are part of the bundle
+        booster.resume_from_checkpoint(resume_bundle)
     log_info(f"Finished loading data in {time.time() - t0:.6f} seconds")
 
     n_iter = max(config.num_iterations - done_iters, 0)
@@ -156,12 +225,25 @@ def run_train(config: Config) -> Booster:
                              f": {value:g}")
             log_info(f"{time.time() - t0:.6f} seconds elapsed, "
                      f"finished iteration {i + 1}")
-            # snapshots (reference: GBDT::Train, gbdt.cpp:258-262)
+            # snapshots (reference: GBDT::Train, gbdt.cpp:258-262) — both
+            # artifacts are written atomically (tmp+fsync+rename): a kill
+            # at ANY instant leaves only intact files on disk, and the
+            # checkpoint bundle makes the next run's auto-resume
+            # bit-exact instead of predict-reseeded
             total_i = done_iters + i + 1
             if config.snapshot_freq > 0 and total_i % config.snapshot_freq == 0:
                 snap = f"{config.output_model}.snapshot_iter_{total_i}"
                 booster.save_model(snap)
-                log_info(f"Saved snapshot to {snap}")
+                ckpt = f"{config.output_model}.ckpt_iter_{total_i}"
+                booster.save_checkpoint(ckpt)
+                log_info(f"Saved snapshot to {snap} (+ checkpoint bundle)")
+                _prune_snapshots(config.output_model,
+                                 keep=config.snapshot_keep)
+                from .utils import faults
+
+                # chaos seam: a scripted kill lands exactly here — after
+                # the Nth snapshot is durable, before the next iteration
+                faults.fire("snapshot", site=str(total_i))
             if finished:
                 break
     finally:

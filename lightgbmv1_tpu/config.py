@@ -406,6 +406,36 @@ class Config:
                                     # an ephemeral port (logged)
     serve_duration_s: float = 0.0   # task=serve runs this long (0 = until
                                     # interrupted); bounded runs for CI
+    # -- serving failure domains (serve/server.py, serve/registry.py) --
+    # transient device errors (a failed H2D, a flaky dispatch) are
+    # retried on the dispatcher with exponential backoff before the
+    # batch is failed; 0 disables retries
+    serve_retry_max: int = 2
+    serve_retry_backoff_ms: float = 5.0
+    # circuit breaker: this many CONSECUTIVE failed device batches
+    # auto-roll the registry back to the previous version (a bad publish
+    # that slipped past validation un-ships itself); 0 disables
+    serve_breaker_failures: int = 3
+    # dispatcher watchdog: a device batch running longer than this is
+    # declared stalled — its requests fail with 503 (DispatcherStalled)
+    # instead of hanging the queue, and a dead dispatcher thread is
+    # restarted; 0 disables the watchdog
+    serve_watchdog_ms: float = 0.0
+    # publish-time golden probe: the candidate predictor must reproduce
+    # the host-tree walk bit-exactly on this many seeded probe rows
+    # BEFORE the atomic swap (a corrupt model can never reach traffic);
+    # 0 disables the semantic probe (structural+finite checks remain)
+    serve_probe_rows: int = 64
+    # -- training robustness ------------------------------------------
+    # guard on the grad/hess pass: "off" (no cost) | "warn" / "raise"
+    # (detect NaN/Inf propagation at each iteration boundary — one
+    # scalar device read) | "clamp" (zero non-finite grad/hess entries
+    # inside the traced step; a poisoned row behaves like a bagged-out
+    # row and training continues on the surviving rows)
+    finite_guard: str = "off"
+    # snapshots/checkpoints retained on disk by the CLI (last N of each;
+    # >= 2 so a torn newest file always has an intact predecessor)
+    snapshot_keep: int = 2
     profile_dir: str = ""          # write a jax.profiler device trace of
                                    # training here; hist/split/partition
                                    # phases carry lgbm.* named scopes (the
@@ -548,6 +578,24 @@ class Config:
             raise ValueError("serve_queue_depth must be >= "
                              "serve_max_batch_rows (admission control "
                              "must admit at least one full batch)")
+        if self.finite_guard not in ("off", "warn", "raise", "clamp"):
+            raise ValueError(
+                f"finite_guard={self.finite_guard!r}: expected "
+                "off | warn | raise | clamp")
+        if self.serve_retry_max < 0 or self.serve_retry_backoff_ms < 0:
+            raise ValueError("serve_retry_max / serve_retry_backoff_ms "
+                             "must be >= 0")
+        if self.serve_breaker_failures < 0:
+            raise ValueError("serve_breaker_failures must be >= 0 "
+                             "(0 disables the circuit breaker)")
+        if self.serve_watchdog_ms < 0:
+            raise ValueError("serve_watchdog_ms must be >= 0 "
+                             "(0 disables the watchdog)")
+        if self.serve_probe_rows < 0:
+            raise ValueError("serve_probe_rows must be >= 0")
+        if self.snapshot_keep < 2:
+            raise ValueError("snapshot_keep must be >= 2 (a torn newest "
+                             "snapshot needs an intact predecessor)")
         if self.predict_cache_entries < 2:
             raise ValueError("predict_cache_entries must be >= 2 (the "
                              "walk and its score executable share a "

@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import collections
 import copy
+import os
 from typing import Any, Callable, Dict, List, Optional, Union
 
 import numpy as np
@@ -51,6 +52,20 @@ def train(
     if fobj is not None:
         params["objective"] = "none"
 
+    # init_model may be a crash-consistent checkpoint bundle
+    # (io/checkpoint.py) instead of model text: resume is then BIT-EXACT
+    # (score caches + RNG state restored), not the approximate
+    # predict-reseeded continued training of a plain model file.  The
+    # restore happens after the valid sets attach (their score caches are
+    # part of the bundle).
+    ckpt_bundle = None
+    if isinstance(init_model, (str, os.PathLike)):
+        from .io.checkpoint import is_checkpoint_file, load_checkpoint
+
+        if is_checkpoint_file(init_model):
+            ckpt_bundle = load_checkpoint(str(init_model))
+            init_model = None
+
     booster = Booster(params=params, train_set=train_set,
                       init_model=init_model)
     is_valid_contain_train = False
@@ -75,6 +90,8 @@ def train(
                 vs.reference = train_set
             booster.add_valid(vs, name)
     booster._train_data_name = train_data_name
+    if ckpt_bundle is not None:
+        booster.resume_from_checkpoint(ckpt_bundle)
 
     cbs = set(callbacks or [])
     if early_stopping_rounds is not None and early_stopping_rounds > 0:

@@ -1,0 +1,215 @@
+"""Crash-consistent trainer checkpoints with bit-exact resume.
+
+The reference's recovery story is ``snapshot_freq`` model-text dumps plus
+continued training via ``input_model`` (gbdt.cpp:258-262,
+application.cpp:90-93).  That resume is *approximate*: the score cache is
+re-seeded by predicting the loaded trees in f64, so a killed-and-resumed
+run drifts from the uninterrupted one within an iteration.  For a
+production trainer the bar is **bit-exact**: kill at iteration *k*,
+resume, and the final model text is byte-identical to the run that never
+died — otherwise every crash silently changes the model that ships.
+
+A checkpoint bundle is therefore the FULL trainer state, not just the
+model text:
+
+* the per-tree **device arrays** in bin space (``TreeArrays`` stacked per
+  field) — so DART drops, rescales and score removals replay on exactly
+  the arrays the uninterrupted run holds, with no text->parse->re-bin
+  roundtrip in the loop;
+* the f32 **score caches** (train + every valid set) — the one piece the
+  reference's predict-reseed loses;
+* **RNG/bagging state**: the feature-sampling ``RandomState``, DART's
+  drop ``RandomState`` + per-tree weights, and (when recorded) the
+  per-iteration train-row leaf assignments the fused DART drop path
+  gathers through;
+* the **iteration counter**, per-tree shrink/bias metadata, CEGB masks;
+* the **model text** at the checkpoint iteration — the human-visible,
+  independently loadable view, and the validate-on-load surface
+  (``model_from_string`` runs ``validate_host_tree`` on every tree).
+
+File format: one zip (written via ``fileio.atomic_write_bytes`` —
+tmp+fsync+rename, a crash leaves the old bundle intact) holding
+``manifest.json``, ``model.txt``, optional ``base_model.txt`` (continued
+training), and ``arrays.npz``.  The manifest carries SHA-256 digests of
+the other members; ``load_checkpoint`` verifies them before any array is
+trusted, so a torn or bit-flipped bundle is *rejected* (CheckpointError)
+and the caller falls back to the previous intact one (cli.py).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import io
+import json
+import zipfile
+from typing import Any, Dict, Tuple
+
+import numpy as np
+
+from ..utils import fileio
+from ..utils.log import log_info
+
+FORMAT_NAME = "lightgbmv1-tpu-checkpoint"
+FORMAT_VERSION = 1
+
+
+class CheckpointError(RuntimeError):
+    """The bundle is unreadable, torn, or inconsistent with the trainer
+    it is being restored into.  Callers treat this as 'not a checkpoint'
+    and fall back (previous snapshot, or fresh training)."""
+
+
+def _digest(data: bytes) -> str:
+    return hashlib.sha256(data).hexdigest()
+
+
+def encode_rng_state(rng: np.random.RandomState) -> Dict[str, Any]:
+    name, keys, pos, has_gauss, cached = rng.get_state()
+    return {"name": name, "pos": int(pos), "has_gauss": int(has_gauss),
+            "cached_gaussian": float(cached),
+            "keys": np.asarray(keys, np.uint32).tolist()}
+
+
+def decode_rng_state(d: Dict[str, Any]) -> tuple:
+    return (d["name"], np.asarray(d["keys"], np.uint32), int(d["pos"]),
+            int(d["has_gauss"]), float(d["cached_gaussian"]))
+
+
+# ---------------------------------------------------------------------------
+# write
+# ---------------------------------------------------------------------------
+
+
+def write_checkpoint(path: str, manifest: Dict[str, Any],
+                     arrays: Dict[str, np.ndarray], model_text: str,
+                     base_model_text: str = "") -> None:
+    """Serialize and atomically write one bundle."""
+    buf = io.BytesIO()
+    np.savez(buf, **arrays)
+    arrays_bytes = buf.getvalue()
+    model_bytes = model_text.encode("utf-8")
+    base_bytes = base_model_text.encode("utf-8") if base_model_text else b""
+
+    manifest = dict(manifest)
+    manifest["format"] = FORMAT_NAME
+    manifest["format_version"] = FORMAT_VERSION
+    manifest["digests"] = {
+        "arrays.npz": _digest(arrays_bytes),
+        "model.txt": _digest(model_bytes),
+    }
+    if base_bytes:
+        manifest["digests"]["base_model.txt"] = _digest(base_bytes)
+    out = io.BytesIO()
+    # ZIP_STORED: the payload is already compact npz; the checkpoint write
+    # sits on the training path, so cheap beats small
+    with zipfile.ZipFile(out, "w", zipfile.ZIP_STORED) as zf:
+        zf.writestr("manifest.json", json.dumps(manifest))
+        zf.writestr("model.txt", model_bytes)
+        if base_bytes:
+            zf.writestr("base_model.txt", base_bytes)
+        zf.writestr("arrays.npz", arrays_bytes)
+    fileio.atomic_write_bytes(path, out.getvalue(), site=path)
+
+
+# ---------------------------------------------------------------------------
+# read / validate
+# ---------------------------------------------------------------------------
+
+
+def is_checkpoint_file(path) -> bool:
+    """Cheap sniff: a zip whose member list starts with our manifest."""
+    try:
+        with fileio.open_file(str(path), "rb") as fh:
+            head = fh.read(4)
+        if head[:2] != b"PK":
+            return False
+        with fileio.open_file(str(path), "rb") as fh:
+            with zipfile.ZipFile(io.BytesIO(fh.read())) as zf:
+                return "manifest.json" in zf.namelist()
+    except Exception:  # noqa: BLE001 — any unreadable file is "not a ckpt"
+        return False
+
+
+def load_checkpoint(path: str) -> Dict[str, Any]:
+    """Read + fully validate a bundle.  Raises :class:`CheckpointError`
+    on ANY integrity failure — torn zip, digest mismatch, missing
+    members, or model text whose trees fail ``validate_host_tree``.
+
+    Returns ``{"manifest", "arrays", "model_text", "base_model_text"}``.
+    """
+    try:
+        with fileio.open_file(str(path), "rb") as fh:
+            raw = fh.read()
+        with zipfile.ZipFile(io.BytesIO(raw)) as zf:
+            names = set(zf.namelist())
+            if "manifest.json" not in names:
+                raise CheckpointError(f"{path}: no manifest")
+            manifest = json.loads(zf.read("manifest.json"))
+            if manifest.get("format") != FORMAT_NAME:
+                raise CheckpointError(f"{path}: not a {FORMAT_NAME} bundle")
+            members = {}
+            for member, want in manifest.get("digests", {}).items():
+                if member not in names:
+                    raise CheckpointError(f"{path}: missing {member}")
+                data = zf.read(member)
+                if _digest(data) != want:
+                    raise CheckpointError(
+                        f"{path}: digest mismatch on {member} (torn or "
+                        "corrupted bundle)")
+                members[member] = data
+    except CheckpointError:
+        raise
+    except Exception as e:  # noqa: BLE001 — zip/json/IO failures
+        raise CheckpointError(
+            f"{path}: unreadable checkpoint ({type(e).__name__}: {e})")
+
+    model_text = members.get("model.txt", b"").decode("utf-8")
+    base_text = members.get("base_model.txt", b"").decode("utf-8")
+    # validate-on-load rides PR 4's validate_host_tree (model_from_string
+    # runs it per tree): a structurally invalid model can never resume
+    try:
+        from .model_text import model_from_string
+
+        loaded = model_from_string(model_text)
+    except Exception as e:  # noqa: BLE001
+        raise CheckpointError(
+            f"{path}: model text failed validation "
+            f"({type(e).__name__}: {e})")
+    if len(loaded.trees) != int(manifest.get("num_trees_total",
+                                             len(loaded.trees))):
+        raise CheckpointError(
+            f"{path}: manifest claims {manifest.get('num_trees_total')} "
+            f"trees, model text carries {len(loaded.trees)}")
+
+    try:
+        npz = np.load(io.BytesIO(members["arrays.npz"]), allow_pickle=False)
+        arrays = {k: npz[k] for k in npz.files}
+    except Exception as e:  # noqa: BLE001
+        raise CheckpointError(
+            f"{path}: unreadable arrays ({type(e).__name__}: {e})")
+    # a NaN-poisoned trainer must not be able to produce a "valid"
+    # checkpoint: score caches are required finite (tree arrays are not
+    # checked — real thresholds may legitimately carry +inf bin uppers)
+    for k, a in arrays.items():
+        if k.endswith("_score") or "_score_" in k:
+            if a.dtype.kind == "f" and not np.isfinite(a).all():
+                raise CheckpointError(f"{path}: non-finite values in {k}")
+    return {"manifest": manifest, "arrays": arrays,
+            "model_text": model_text, "base_model_text": base_text}
+
+
+def validate_checkpoint(path: str) -> Dict[str, Any]:
+    """Full validation pass; returns the manifest.  Used by the CLI's
+    resume-point scan to pick the newest INTACT bundle."""
+    return load_checkpoint(path)["manifest"]
+
+
+def checkpoint_iteration(path: str) -> int:
+    return int(validate_checkpoint(path)["iteration"])
+
+
+def log_loaded(path: str, manifest: Dict[str, Any]) -> None:
+    log_info(
+        f"Loaded checkpoint {path}: iteration {manifest.get('iteration')}, "
+        f"{manifest.get('num_trees')} trees, "
+        f"boosting={manifest.get('boosting')}")

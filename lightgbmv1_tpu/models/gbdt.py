@@ -40,6 +40,14 @@ from .tree import (HostTree, TreeArrays, leaf_lookup,
                    tree_predict_binned, tree_used_features)
 
 
+class FiniteGuardError(RuntimeError):
+    """``finite_guard=raise``: non-finite training state (NaN/Inf
+    gradients propagated into the score cache) detected at an iteration
+    boundary — the poisoned iteration is the LAST one, so a caller can
+    roll back or resume from the previous checkpoint instead of shipping
+    silently corrupted trees."""
+
+
 def _np_weighted_quantile_sorted(v, w, q):
     cw = np.cumsum(w)
     if cw[-1] <= 0:
@@ -229,6 +237,13 @@ class GBDT:
         self._rng_key = jax.random.PRNGKey(config.seed)
         self._bag_mask: Optional[jax.Array] = None
         self._feat_rng = np.random.RandomState(config.feature_fraction_seed)
+        # fault injection (utils/faults.py): an armed grad_poison fault is
+        # baked in at trace time as a traced iteration==N select, so it
+        # fires exactly once even inside a scanned multi-iteration dispatch
+        from ..utils import faults as _faults
+
+        self._poison_iter = _faults.grad_poison_iteration()
+        self._finite_warned = False
 
     # ------------------------------------------------------------------
     @property
@@ -383,8 +398,79 @@ class GBDT:
 
     def _objective_grads(self, s, iteration=None):
         if getattr(self.objective, "is_stochastic", False):
-            return self.objective.get_gradients(s, iteration=iteration)
-        return self.objective.get_gradients(s)
+            grad, hess = self.objective.get_gradients(s, iteration=iteration)
+        else:
+            grad, hess = self.objective.get_gradients(s)
+        return self._guard_grads(grad, hess, iteration)
+
+    def _guard_grads(self, grad, hess, iteration):
+        """Finite-guard + fault-injection seam on the grad/hess pass.
+
+        ``finite_guard=clamp`` zeroes non-finite grad/hess entries inside
+        the traced step (a poisoned row behaves like a bagged-out row:
+        zero weight in every histogram channel), so one bad pass cannot
+        corrupt a tree.  ``warn``/``raise`` detect the propagated damage
+        host-side at the iteration boundary (check_finite_boundary).
+        The injected poison hits a deterministic ~8% row slice — enough
+        to corrupt every histogram, small enough that clamp-mode training
+        continues meaningfully on the surviving rows."""
+        if self._poison_iter is not None and iteration is not None:
+            n = grad.shape[0]
+            rows = (jnp.arange(n, dtype=jnp.int32) % 13) == 0
+            bad = rows if grad.ndim == 1 else rows[:, None]
+            firing = jnp.asarray(iteration, jnp.int32) == jnp.int32(
+                self._poison_iter)
+            poison = jnp.where(bad & firing, jnp.float32(jnp.nan),
+                               jnp.float32(0.0))
+            grad = grad + poison
+            hess = hess + poison
+        if self.config.finite_guard == "clamp":
+            finite = jnp.isfinite(grad) & jnp.isfinite(hess)
+            grad = jnp.where(finite, grad, 0.0)
+            hess = jnp.where(finite, hess, 0.0)
+        return grad, hess
+
+    def check_finite_boundary(self) -> None:
+        """Iteration-boundary finite check (``finite_guard=warn|raise``).
+
+        Two detectors, both one scalar device read:
+
+        1. the train score cache — catches NaN/Inf that PROPAGATED into
+           the model (diverged training, poisoned leaf values);
+        2. a re-run of the just-finished gradient pass on the saved
+           pre-update scores (``_prev_state`` — the rollback snapshot
+           taken before the iteration) — catches a poisoned pass even
+           when the grower ABSORBED it (NaN gains compare false, the
+           iteration silently trains a zero no-op tree: the quiet
+           mistraining this guard exists to surface).
+
+        Called by Booster.update() after each iteration; train_iters()
+        checks at scanned-block boundaries (detector 1 only is exact
+        there).  Cost: one extra gradient pass per iteration, only when
+        the guard is armed."""
+        mode = self.config.finite_guard
+        if mode not in ("warn", "raise"):
+            return
+        bad = not bool(np.isfinite(np.asarray(
+            jax.device_get(jnp.sum(self._train_scores.score)))))
+        if not bad and self.objective is not None \
+                and self._prev_state is not None and self.iter > 0:
+            score = self._prev_state[0]
+            s = score[:, 0] if self.num_class == 1 else score
+            g, h = self._objective_grads(s, iteration=int(self.iter - 1))
+            tot = jax.device_get(jnp.sum(g) + jnp.sum(h))
+            bad = not bool(np.isfinite(np.asarray(tot)))
+        if not bad:
+            return
+        msg = (f"non-finite gradient/score state at iteration {self.iter} "
+               f"boundary (finite_guard={mode}): the last iteration's "
+               "trees are suspect — roll back or resume from the "
+               "previous checkpoint")
+        if mode == "raise":
+            raise FiniteGuardError(msg)
+        if not self._finite_warned:
+            self._finite_warned = True
+            log_warning(msg)
 
     # ------------------------------------------------------------------
     def train_iters(self, n: int) -> None:
@@ -448,6 +534,7 @@ class GBDT:
                 )
                 self._model_bias.append(self._tree_bias(k))
             self.iter += 1
+        self.check_finite_boundary()
 
     def _fused_train_one_iter(self) -> None:
         if self._step is None:
@@ -818,6 +905,114 @@ class GBDT:
         self._prev_state = None
 
     # ------------------------------------------------------------------
+    # Crash-consistent checkpointing (io/checkpoint.py).  The captured
+    # state is everything a resumed trainer needs to continue BIT-EXACTLY
+    # where the killed one stopped: the same device tree arrays (bin
+    # space — no text roundtrip in the loop), the same f32 score caches,
+    # the same host RNG states.  Per-iteration PRNG (bagging, GOSS,
+    # extra_trees, tree keys) is fold_in-keyed on the iteration counter
+    # and therefore stateless — only the sequentially-consumed
+    # RandomStates (feature sampling, DART drops) need saving.
+    # ------------------------------------------------------------------
+    def capture_state(self):
+        """-> (manifest dict, arrays dict) for io.checkpoint.write."""
+        from ..io.checkpoint import encode_rng_state
+        from .tree import TreeArrays
+
+        trees = jax.device_get(self._device_trees)
+        arrays: Dict[str, np.ndarray] = {}
+        for f in TreeArrays._fields:
+            arrays[f"tree_{f}"] = np.stack(
+                [np.asarray(getattr(t, f)) for t in trees])
+        arrays["train_score"] = np.asarray(
+            jax.device_get(self._train_scores.score))
+        for i, vs in enumerate(self._valid_scores):
+            arrays[f"valid_score_{i}"] = np.asarray(jax.device_get(vs.score))
+        cegb = jax.device_get(self._cegb_used)
+        if isinstance(cegb, tuple):
+            arrays["cegb_used"] = np.asarray(cegb[0])
+            arrays["cegb_marks"] = np.asarray(cegb[1])
+        else:
+            arrays["cegb_used"] = np.asarray(cegb)
+        manifest = {
+            "iteration": int(self.iter),
+            "num_trees": len(self.models),
+            "num_class": int(self.num_class),
+            "num_data": int(self.num_data),
+            "n_valid": len(self._valid_scores),
+            "boosting": type(self).__name__,
+            "objective": self.config.objective,
+            "seed": int(self.config.seed),
+            "used_init_score": bool(self._used_init_score),
+            "init_scores": [float(v) for v in self._init_scores],
+            "model_shrink": [float(v) for v in self._model_shrink],
+            "model_bias": [float(v) for v in self._model_bias],
+            "feat_rng": encode_rng_state(self._feat_rng),
+        }
+        self._capture_extra(manifest, arrays)
+        return manifest, arrays
+
+    def _capture_extra(self, manifest, arrays) -> None:
+        """Subclass hook (DART adds drop RNG / weights / leaf ids)."""
+
+    def restore_state(self, manifest, arrays) -> None:
+        """Restore a captured state into a FRESH trainer built on the
+        same dataset/config (valid sets already attached).  Raises
+        :class:`~lightgbmv1_tpu.io.checkpoint.CheckpointError` on any
+        shape/identity mismatch rather than resuming wrong."""
+        from ..io.checkpoint import CheckpointError, decode_rng_state
+        from .tree import TreeArrays
+
+        if self.iter != 0 or self.models:
+            raise CheckpointError(
+                "restore_state() needs a fresh trainer (training already "
+                f"started: iteration {self.iter})")
+        for key, want, got in (
+                ("num_data", int(manifest["num_data"]), self.num_data),
+                ("num_class", int(manifest["num_class"]), self.num_class),
+                ("boosting", manifest["boosting"], type(self).__name__),
+                ("objective", manifest["objective"],
+                 self.config.objective),
+                ("seed", int(manifest["seed"]), int(self.config.seed)),
+                ("n_valid", int(manifest["n_valid"]),
+                 len(self._valid_scores))):
+            if want != got:
+                raise CheckpointError(
+                    f"checkpoint/trainer mismatch on {key}: checkpoint "
+                    f"has {want!r}, trainer has {got!r}")
+        T = int(manifest["num_trees"])
+        stacked = {f: arrays[f"tree_{f}"] for f in TreeArrays._fields}
+        if any(v.shape[0] != T for v in stacked.values()):
+            raise CheckpointError("tree array stack does not match the "
+                                  "manifest tree count")
+        self._device_trees = [
+            TreeArrays(**{f: jnp.asarray(stacked[f][i])
+                          for f in TreeArrays._fields})
+            for i in range(T)
+        ]
+        self.models = [None] * T
+        self._model_shrink = [float(v) for v in manifest["model_shrink"]]
+        self._model_bias = [float(v) for v in manifest["model_bias"]]
+        self._train_scores.score = jnp.asarray(arrays["train_score"])
+        for i, vs in enumerate(self._valid_scores):
+            vs.score = jnp.asarray(arrays[f"valid_score_{i}"])
+        if "cegb_marks" in arrays:
+            self._cegb_used = (jnp.asarray(arrays["cegb_used"]),
+                               jnp.asarray(arrays["cegb_marks"]))
+        else:
+            self._cegb_used = jnp.asarray(arrays["cegb_used"])
+        self._feat_rng.set_state(decode_rng_state(manifest["feat_rng"]))
+        self._used_init_score = bool(manifest["used_init_score"])
+        self._init_scores = np.asarray(manifest["init_scores"], np.float64)
+        self._bag_mask = None
+        self._prev_state = None
+        self._restore_extra(manifest, arrays)
+        self.iter = int(manifest["iteration"])   # last: bumps model_version
+
+    def _restore_extra(self, manifest, arrays) -> None:
+        """Subclass hook (DART)."""
+
+    # ------------------------------------------------------------------
     @staticmethod
     def _host_array(arr) -> np.ndarray:
         """Fetch a (possibly cross-process-sharded) score array to host.
@@ -977,6 +1172,47 @@ class DART(GBDT):
         return (self._keep_lids and self._lids_aligned
                 and len(self._train_leaf_ids)
                 == len(self.models) // self.num_class)
+
+    def _capture_extra(self, manifest, arrays) -> None:
+        from ..io.checkpoint import encode_rng_state
+
+        manifest["dart"] = {
+            "drop_rng": encode_rng_state(self._drop_rng),
+            "tree_weight": [float(v) for v in self._tree_weight],
+            "sum_weight": float(self._sum_weight),
+            "lids_kept": bool(self._drop_lids_usable()),
+        }
+        if self._drop_lids_usable() and self._train_leaf_ids:
+            # the recorded per-iteration (K, N) leaf assignments: restoring
+            # them keeps the resumed run on the SAME fused drop path
+            # (leaf-table gather) the uninterrupted run compiles, so the
+            # two runs execute identical programs — the strongest
+            # bit-exactness guarantee, not just value equality
+            arrays["dart_lids"] = np.stack(
+                [np.asarray(a) for a in jax.device_get(
+                    self._train_leaf_ids)])
+
+    def _restore_extra(self, manifest, arrays) -> None:
+        from ..io.checkpoint import decode_rng_state
+
+        d = manifest["dart"]
+        self._drop_rng.set_state(decode_rng_state(d["drop_rng"]))
+        self._tree_weight = [float(v) for v in d["tree_weight"]]
+        self._sum_weight = float(d["sum_weight"])
+        self._train_leaf_ids.clear()
+        if d.get("lids_kept") and "dart_lids" in arrays:
+            lids = arrays["dart_lids"]
+            self._train_leaf_ids.extend(
+                jnp.asarray(lids[i]).astype(self._lid_dtype)
+                for i in range(lids.shape[0]))
+            self._keep_lids = True
+            self._lids_aligned = True
+        else:
+            # no recorded assignments: drops fall back to tree walks
+            # (value-equal; the compiled drop program differs)
+            self._keep_lids = False
+            self._lids_aligned = False
+        self._prev_weights = None
 
     def _supports_fused_step(self) -> bool:
         # the scanned multi-iteration path cannot host the per-iteration
@@ -1435,8 +1671,10 @@ class RF(GBDT):
         const = jnp.broadcast_to(init[None, :], (self.num_data, self.num_class))
         sc = const[:, 0] if self.num_class == 1 else const
         if getattr(self.objective, "is_stochastic", False):
-            return self.objective.get_gradients(sc, iteration=iteration)
-        return self.objective.get_gradients(sc)
+            grad, hess = self.objective.get_gradients(sc, iteration=iteration)
+        else:
+            grad, hess = self.objective.get_gradients(sc)
+        return self._guard_grads(grad, hess, iteration)
 
     def train_one_iter(self, custom_grad=None, custom_hess=None,
                        check_stop: bool = True) -> bool:

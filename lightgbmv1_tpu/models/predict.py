@@ -50,6 +50,7 @@ from typing import Any, Dict, List, NamedTuple, Optional, Tuple
 import numpy as np
 
 from ..io.binning import K_ZERO_THRESHOLD, MISSING_NAN, MISSING_ZERO
+from ..utils import faults
 from ..utils.log import log_warning
 from .tree import HostTree, host_tree_depth, validate_host_tree
 
@@ -634,6 +635,10 @@ class BatchPredictor:
             chunk = X[lo: lo + self.chunk_rows]
             bucket = self.bucket_for(chunk.shape[0])
             enc = self._pad(self.encode(chunk), bucket)
+            # chaos seam: a transient host->device transfer failure lands
+            # here, before the walk dispatch (utils/faults.py) — the
+            # serving retry loop must absorb it
+            faults.fire("h2d", site="predict_leaf")
             self.call_count += 1
             leaf = self._leaf_fn(bucket)(self.arrays, jax.numpy.asarray(enc))
             outs.append(jax.device_get(leaf)[: chunk.shape[0]])
@@ -670,6 +675,7 @@ class BatchPredictor:
         pending = []
         nxt_dev = None
         for i, chunk in enumerate(chunks):
+            faults.fire("h2d", site="predict_raw")
             bucket = self.bucket_for(chunk.shape[0])
             if nxt_dev is not None and nxt_dev[1] == bucket:
                 enc_dev = nxt_dev[0]

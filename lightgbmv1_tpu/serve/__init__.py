@@ -20,13 +20,15 @@ open-loop Poisson traffic against any of them.
 """
 
 from .metrics import ServeMetrics
-from .registry import ModelRegistry, ModelVersion
-from .server import (RequestTimeout, ServeConfig, ServeError, ServeResult,
-                     Server, ServerClosed, ServerOverloaded, build_server)
+from .registry import ModelRegistry, ModelVersion, PublishValidationError
+from .server import (DispatcherDied, DispatcherStalled, RequestTimeout,
+                     ServeConfig, ServeError, ServeResult, Server,
+                     ServerClosed, ServerOverloaded, build_server)
 from .http import ServeHTTP
 
 __all__ = [
-    "ModelRegistry", "ModelVersion", "RequestTimeout", "ServeConfig",
+    "DispatcherDied", "DispatcherStalled", "ModelRegistry", "ModelVersion",
+    "PublishValidationError", "RequestTimeout", "ServeConfig",
     "ServeError", "ServeHTTP", "ServeMetrics", "ServeResult", "Server",
     "ServerClosed", "ServerOverloaded", "build_server",
 ]

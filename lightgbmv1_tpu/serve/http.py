@@ -3,10 +3,15 @@
 Deliberately dependency-free (``http.server`` + ``json``): the process
 already holds the device runtime, so the HTTP layer only needs to decode
 rows, call ``Server.submit()`` and map the admission-control outcomes
-onto status codes — 200 scored, 503 shed (queue full), 504 deadline
-expired, 400 malformed.  Each handler thread blocks inside ``submit()``
-like any other in-process client, so HTTP requests micro-batch together
-with (and against) direct callers.
+onto status codes — 200 scored, 503 shed / stalled / closed, 504
+deadline expired, 400 malformed.  Every client-input failure mode
+(malformed JSON, a non-object body, missing/non-list ``rows``,
+non-numeric cells, wrong feature count) answers a structured 400 — an
+unhandled 500 on bad input is a bug, and an unexpected server-side
+exception answers a structured 500, never a traceback page.  Each
+handler thread blocks inside ``submit()`` like any other in-process
+client, so HTTP requests micro-batch together with (and against) direct
+callers.
 
 Endpoints:
 
@@ -14,7 +19,10 @@ Endpoints:
   ``{"values": [[...], ...], "version": "v2", "degraded": false,
   "latency_ms": 1.9}``
 * ``GET /metrics``   the ServeMetrics snapshot (+ version history)
-* ``GET /healthz``   ``{"ok": true, "version": "v2"}``
+* ``GET /healthz``   liveness, not process-up: 200 with
+  ``{"ok": true, "version", "dispatcher_alive", "published"}`` only
+  when the dispatcher thread is alive AND a model is published; 503
+  otherwise — a wedged replica must fall out of its load balancer.
 """
 
 from __future__ import annotations
@@ -23,8 +31,8 @@ import json
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
-from .server import (RequestTimeout, ServeError, Server, ServerClosed,
-                     ServerOverloaded)
+from .server import (DispatcherStalled, RequestTimeout, ServeError, Server,
+                     ServerClosed, ServerOverloaded)
 
 
 def _make_handler(server: Server):
@@ -46,7 +54,8 @@ def _make_handler(server: Server):
             if self.path == "/metrics":
                 self._reply(200, server.metrics_snapshot())
             elif self.path == "/healthz":
-                self._reply(200, {"ok": True, "version": server.version()})
+                health = server.health()
+                self._reply(200 if health["ok"] else 503, health)
             else:
                 self._reply(404, {"error": f"no route {self.path}"})
 
@@ -57,8 +66,17 @@ def _make_handler(server: Server):
             try:
                 n = int(self.headers.get("Content-Length", 0))
                 req = json.loads(self.rfile.read(n) or b"{}")
+                if not isinstance(req, dict):
+                    raise ValueError(
+                        f"body must be a JSON object, got "
+                        f"{type(req).__name__}")
                 rows = req["rows"]
-            except (ValueError, KeyError) as e:
+                if not isinstance(rows, list) or not rows:
+                    raise ValueError("'rows' must be a non-empty list")
+            except KeyError as e:
+                self._reply(400, {"error": f"missing field {e}"})
+                return
+            except (ValueError, TypeError) as e:
                 self._reply(400, {"error": f"bad request body: {e}"})
                 return
             try:
@@ -69,8 +87,25 @@ def _make_handler(server: Server):
             except RequestTimeout as e:
                 self._reply(504, {"error": str(e), "timeout": True})
                 return
-            except (ServeError, ValueError, RuntimeError) as e:
+            except (DispatcherStalled, ServerClosed) as e:
+                # retryable-elsewhere: the replica is wedged or draining
+                self._reply(503, {"error": f"{type(e).__name__}: {e}"})
+                return
+            except (ValueError, TypeError) as e:
+                # client-input failures from row coercion/shape checks
+                # (non-numeric cells, wrong feature count, ragged rows)
                 self._reply(400, {"error": f"{type(e).__name__}: {e}"})
+                return
+            except ServeError as e:
+                self._reply(503, {"error": f"{type(e).__name__}: {e}"})
+                return
+            except RuntimeError as e:
+                # e.g. "no model published yet" — not ready, not a bug
+                self._reply(503, {"error": str(e)})
+                return
+            except Exception as e:  # noqa: BLE001 — structured 500, not
+                # an unhandled-traceback page
+                self._reply(500, {"error": f"{type(e).__name__}: {e}"})
                 return
             self._reply(200, {
                 "values": res.values.tolist(),

@@ -50,6 +50,11 @@ class ServeMetrics:
             self.degraded = 0
             self.swaps = 0
             self.rollbacks = 0
+            self.retries = 0            # transient batch errors retried
+            self.breaker_trips = 0      # circuit-breaker auto-rollbacks
+            self.watchdog_failures = 0  # requests failed by the watchdog
+            self.dispatcher_restarts = 0
+            self.publish_rejects = 0    # candidate versions refused
             self.batches = 0
             self.batch_rows = 0
             self.batch_capacity = 0
@@ -86,6 +91,26 @@ class ServeMetrics:
             self.swaps += 1
             if rollback:
                 self.rollbacks += 1
+
+    def on_retry(self) -> None:
+        with self._lock:
+            self.retries += 1
+
+    def on_breaker(self) -> None:
+        with self._lock:
+            self.breaker_trips += 1
+
+    def on_watchdog(self, n: int = 1) -> None:
+        with self._lock:
+            self.watchdog_failures += n
+
+    def on_dispatcher_restart(self) -> None:
+        with self._lock:
+            self.dispatcher_restarts += 1
+
+    def on_publish_reject(self) -> None:
+        with self._lock:
+            self.publish_rejects += 1
 
     def on_batch(self, rows: int, bucket: int, queue_depth: int) -> None:
         """One dispatched device batch: ``rows`` real rows padded into a
@@ -127,6 +152,11 @@ class ServeMetrics:
                 "degraded": self.degraded,
                 "swaps": self.swaps,
                 "rollbacks": self.rollbacks,
+                "retries": self.retries,
+                "breaker_trips": self.breaker_trips,
+                "watchdog_failures": self.watchdog_failures,
+                "dispatcher_restarts": self.dispatcher_restarts,
+                "publish_rejects": self.publish_rejects,
                 "batches": self.batches,
                 "qps": (round(self.completed / span, 2) if span else None),
                 "p50_ms": _quantile(lat, 0.50),

@@ -27,7 +27,15 @@ from typing import Any, Dict, List, Optional
 import numpy as np
 
 from ..models.predict import BatchPredictor
-from ..utils.log import log_info
+from ..utils import faults
+from ..utils.log import log_info, log_warning
+
+
+class PublishValidationError(RuntimeError):
+    """The candidate version failed pre-swap validation (structurally
+    invalid trees, non-finite outputs, or a golden-probe mismatch
+    between the device predictor and the host-tree oracle).  The active
+    version is untouched: a corrupt model can never reach traffic."""
 
 
 @dataclass
@@ -87,7 +95,9 @@ class ModelRegistry:
     def _warm(self, mv: ModelVersion, max_batch_rows: int) -> int:
         """Compile the bucketed walk for every bucket a live batch can
         land in, BEFORE the version becomes visible — the first real
-        request must never pay a trace."""
+        request must never pay a trace.  Every warm output is
+        finite-checked: a version whose executables produce NaN/Inf is
+        rejected here, pre-swap."""
         n_compiled = 0
         for bp in filter(None, (mv.predictor, mv.degraded)):
             buckets = self._warm_buckets
@@ -98,27 +108,96 @@ class ModelRegistry:
                     buckets.append(b)
                     b *= 2
             for bucket in buckets:
+                # chaos seam: a publish() that dies mid-warm must leave
+                # the active version serving (utils/faults.py)
+                faults.fire("publish_warm", site=mv.tag)
                 x = np.zeros((min(bucket, max_batch_rows), mv.num_features),
                              np.float64)
-                bp.predict_raw(x)
+                out = np.asarray(bp.predict_raw(x))
+                if not np.isfinite(out).all():
+                    raise PublishValidationError(
+                        f"{mv.tag}: non-finite scores from the "
+                        f"{bucket}-row warm batch")
                 n_compiled += 1
         return n_compiled
+
+    # -- pre-swap validation ---------------------------------------------
+    @staticmethod
+    def _validate_trees(trees) -> None:
+        """Structural + finite validation of every candidate tree (rides
+        PR 4's validate_host_tree: acyclicity, child-index bounds)."""
+        from ..models.tree import validate_host_tree
+
+        for i, t in enumerate(trees):
+            validate_host_tree(t, i)
+            nl = t.num_leaves
+            if not np.isfinite(np.asarray(t.leaf_value[:nl],
+                                          np.float64)).all():
+                raise PublishValidationError(
+                    f"tree {i}: non-finite leaf values")
+            if nl > 1 and not np.isfinite(
+                    np.asarray(t.threshold[: nl - 1], np.float64)).all():
+                raise PublishValidationError(
+                    f"tree {i}: non-finite split thresholds")
+
+    @staticmethod
+    def _probe_check(mv: ModelVersion, trees, K: int, F: int,
+                     probe_rows: int) -> None:
+        """Golden probe: the candidate's device predictor must reproduce
+        the host-tree oracle BIT-EXACTLY (f64 reconstruction path, the
+        PR 4 parity contract) on a seeded batch of random rows.  Catches
+        what structural checks cannot: a mis-stacked serving table, a
+        broken binner, a miscompiled walk."""
+        rng = np.random.RandomState(0xC0FFEE ^ (len(trees) * 2654435761
+                                                & 0x7FFFFFFF))
+        Xp = rng.randn(int(probe_rows), F)
+        want = np.zeros((int(probe_rows), K), np.float64)
+        for i, t in enumerate(trees):
+            want[:, i % K] += t.predict(Xp)
+        got = np.asarray(mv.predictor.predict_raw(Xp, f64_exact=True))
+        if got.shape != want.shape or not np.array_equal(got, want):
+            raise PublishValidationError(
+                f"{mv.tag}: golden-probe mismatch — device predictor "
+                "diverges from the host-tree oracle on "
+                f"{int(probe_rows)} probe rows")
 
     # -- public API ------------------------------------------------------
     def publish(self, model, *, degrade_trees: int = 0,
                 max_batch_rows: int = 1024,
-                meta: Optional[Dict[str, Any]] = None) -> str:
-        """Build + warm a new version, then atomically make it current.
-        Returns the version tag.  ``model`` is a Booster or a
-        ``(trees, K, num_features)`` triple."""
+                meta: Optional[Dict[str, Any]] = None,
+                probe_rows: int = 64) -> str:
+        """Build, warm and VALIDATE a new version, then atomically make
+        it current.  Returns the version tag.  ``model`` is a Booster or
+        a ``(trees, K, num_features)`` triple.
+
+        Validation is the serving failure domain's front door: every
+        candidate tree is structurally checked (validate_host_tree) and
+        finite-checked, every warmed executable's output is
+        finite-checked, and (``probe_rows`` > 0) the device predictor
+        must reproduce the host-tree oracle bit-exactly on a seeded
+        golden probe batch — all BEFORE the swap, so a corrupt model can
+        never serve a single answer.  Failure raises
+        :class:`PublishValidationError` and the active version keeps
+        serving untouched."""
         trees, K, F = _booster_parts(model)
         if not trees:
             raise ValueError("publish() needs a trained model "
                              "(zero trees)")
-        mv = self._build(trees, K, F, degrade_trees)
-        if meta:
-            mv.meta.update(meta)
-        n_warm = self._warm(mv, max_batch_rows)
+        try:
+            self._validate_trees(trees)
+            mv = self._build(trees, K, F, degrade_trees)
+            if meta:
+                mv.meta.update(meta)
+            n_warm = self._warm(mv, max_batch_rows)
+            if probe_rows > 0:
+                self._probe_check(mv, trees, K, F, probe_rows)
+        except Exception as e:
+            if self._metrics is not None:
+                self._metrics.on_publish_reject()
+            log_warning(f"serve: publish rejected pre-swap "
+                        f"({type(e).__name__}: {e}); active version "
+                        "keeps serving")
+            raise
         with self._lock:
             if self._active is not None:
                 self._history.append(self._active)

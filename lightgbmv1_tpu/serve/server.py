@@ -35,6 +35,7 @@ from typing import Any, Dict, List, Optional
 
 import numpy as np
 
+from ..utils import faults
 from ..utils.log import log_info, log_warning
 from .metrics import ServeMetrics
 from .registry import ModelRegistry, ModelVersion
@@ -56,6 +57,18 @@ class ServerClosed(ServeError):
     """The server is shut down; no further requests are accepted."""
 
 
+class DispatcherStalled(ServeError):
+    """The watchdog declared the in-flight device batch stalled (or the
+    dispatcher thread dead) and failed this request instead of letting
+    it hang the queue.  HTTP maps it to 503 — the client should retry
+    against another replica."""
+
+
+class DispatcherDied(ServeError):
+    """The dispatcher thread exited with this request in flight; the
+    watchdog restarts the dispatcher and fails the stranded requests."""
+
+
 @dataclass
 class ServeConfig:
     """Serving policy knobs (mirrored by the ``serve_*`` names in
@@ -69,6 +82,15 @@ class ServeConfig:
     degrade_queue_frac: float = 0.5     # backlog fraction that triggers it
     f64_scores: bool = False            # exact f64 reconstruction per batch
     metrics_window: int = 8192
+    # -- failure domains (PR 6) ----------------------------------------
+    retry_max: int = 2                  # transient batch errors retried
+    retry_backoff_ms: float = 5.0       # exponential base between attempts
+    breaker_failures: int = 3           # consecutive failed batches that
+                                        # auto-roll back a bad publish
+                                        # (0 = breaker off)
+    watchdog_ms: float = 0.0            # stalled-batch deadline; 0 = off
+    probe_rows: int = 64                # publish golden-probe batch size
+                                        # (0 = structural checks only)
     predictor_kwargs: Dict[str, Any] = field(default_factory=dict)
 
     def __post_init__(self):
@@ -80,6 +102,11 @@ class ServeConfig:
         self.degrade_trees = max(int(self.degrade_trees), 0)
         self.degrade_queue_frac = min(max(
             float(self.degrade_queue_frac), 0.0), 1.0)
+        self.retry_max = max(int(self.retry_max), 0)
+        self.retry_backoff_ms = max(float(self.retry_backoff_ms), 0.0)
+        self.breaker_failures = max(int(self.breaker_failures), 0)
+        self.watchdog_ms = max(float(self.watchdog_ms), 0.0)
+        self.probe_rows = max(int(self.probe_rows), 0)
 
 
 @dataclass
@@ -122,20 +149,35 @@ class Server:
         self._queue: deque = deque()
         self._queue_rows = 0
         self._closed = False
+        # failure-domain state: the in-flight batch the watchdog observes
+        # ((t_start, requests) or None), and the consecutive-failure
+        # count feeding the circuit breaker
+        self._inflight: Optional[tuple] = None
+        self._consec_failures = 0
         self._dispatcher = threading.Thread(
             target=self._dispatch_loop, name="serve-dispatcher", daemon=True)
         if model is not None:
             self.publish(model)
         self._dispatcher.start()
+        self._watchdog: Optional[threading.Thread] = None
+        if self.config.watchdog_ms > 0:
+            self._watchdog = threading.Thread(
+                target=self._watchdog_loop, name="serve-watchdog",
+                daemon=True)
+            self._watchdog.start()
 
     # -- model lifecycle -------------------------------------------------
     def publish(self, model, **meta) -> str:
-        """Prebin/stack/warm the new ensemble OFF the serving path, then
-        atomically swap it in (registry.py).  In-flight batches finish on
-        the old version; the tag is echoed in every response."""
+        """Prebin/stack/warm/VALIDATE the new ensemble OFF the serving
+        path, then atomically swap it in (registry.py).  In-flight
+        batches finish on the old version; the tag is echoed in every
+        response.  A candidate that fails validation (structural, finite,
+        or golden-probe — see registry.publish) raises
+        ``PublishValidationError`` and never serves a single answer."""
         return self.registry.publish(
             model, degrade_trees=self.config.degrade_trees,
-            max_batch_rows=self.config.max_batch_rows, meta=meta or None)
+            max_batch_rows=self.config.max_batch_rows, meta=meta or None,
+            probe_rows=self.config.probe_rows)
 
     def rollback(self) -> str:
         return self.registry.rollback()
@@ -182,6 +224,18 @@ class Server:
         snap["version"] = self.registry.current_tag()
         snap["versions"] = self.registry.versions()
         return snap
+
+    def dispatcher_alive(self) -> bool:
+        return self._dispatcher.is_alive() and not self._closed
+
+    def health(self) -> Dict[str, Any]:
+        """Liveness the /healthz endpoint reports: a wedged or dead
+        dispatcher and an empty registry are NOT healthy, even though
+        the process is up."""
+        alive = self.dispatcher_alive()
+        tag = self.registry.current_tag()
+        return {"ok": bool(alive and tag is not None), "version": tag,
+                "dispatcher_alive": alive, "published": tag is not None}
 
     def close(self) -> None:
         """Stop the dispatcher; pending requests fail with ServerClosed."""
@@ -242,15 +296,75 @@ class Server:
                 return
             try:
                 self._run_batch(batch)
+                self._consec_failures = 0
+            except faults.ThreadKilled as e:
+                # injected dispatcher death: fail this batch's requests
+                # and let the thread die — the watchdog notices the
+                # corpse and restarts (the recovery under test)
+                self._fail_batch(batch, DispatcherDied(str(e)))
+                log_warning("serve: dispatcher thread died "
+                            f"({e}); watchdog will restart")
+                return
             except BaseException as e:  # noqa: BLE001 — a poisoned batch
                 # must fail ITS requests, never kill the dispatcher
-                for req in batch:
-                    if not req.event.is_set():
-                        self.metrics.on_error()
-                        req.error = e
-                        req.event.set()
-                log_warning(f"serve: batch failed "
+                self._fail_batch(batch, e)
+                log_warning(f"serve: batch failed after retries "
                             f"({type(e).__name__}: {e})")
+                self._consec_failures += 1
+                self._maybe_trip_breaker()
+
+    def _fail_batch(self, batch: List[_Request], err: BaseException) -> None:
+        for req in batch:
+            if not req.event.is_set():
+                self.metrics.on_error()
+                req.error = (err if isinstance(err, Exception)
+                             else ServeError(str(err)))
+                req.event.set()
+
+    def _maybe_trip_breaker(self) -> None:
+        """Circuit breaker: ``breaker_failures`` CONSECUTIVE failed
+        batches auto-roll the registry back to the previous version — a
+        bad publish that slipped past validation (or a version whose
+        executables started failing) un-ships itself instead of failing
+        every batch forever."""
+        bf = self.config.breaker_failures
+        if bf <= 0 or self._consec_failures < bf:
+            return
+        self._consec_failures = 0
+        try:
+            tag = self.registry.rollback()
+        except Exception as e:  # noqa: BLE001 — nothing to roll back to
+            log_warning(f"serve: circuit breaker tripped with no "
+                        f"previous version to roll back to ({e})")
+            return
+        self.metrics.on_breaker()
+        log_warning(f"serve: circuit breaker tripped after {bf} "
+                    f"consecutive batch failures — rolled back to {tag}")
+
+    def _predict_with_retry(self, bp, X: np.ndarray) -> np.ndarray:
+        """Bounded retry with exponential backoff around the device
+        batch: transient errors (a failed H2D, a flaky dispatch) are
+        retried ``retry_max`` times before the batch is failed."""
+        cfg = self.config
+        attempt = 0
+        while True:
+            try:
+                # chaos seam: injected dispatch faults land inside the
+                # retried region, exactly like a real transient error
+                faults.fire("dispatch", site="batch")
+                return np.asarray(bp.predict_raw(
+                    X, f64_exact=cfg.f64_scores))
+            except faults.ThreadKilled:
+                raise
+            except Exception as e:  # noqa: BLE001
+                if attempt >= cfg.retry_max:
+                    raise
+                attempt += 1
+                self.metrics.on_retry()
+                log_warning(f"serve: batch attempt {attempt} failed "
+                            f"({type(e).__name__}: {e}); retrying")
+                time.sleep(cfg.retry_backoff_ms * (2 ** (attempt - 1))
+                           / 1e3)
 
     def _run_batch(self, batch: List[_Request]) -> None:
         now = time.monotonic()
@@ -276,20 +390,65 @@ class Server:
         X = (live[0].rows if len(live) == 1
              else np.concatenate([r.rows for r in live], axis=0))
         n = X.shape[0]
-        out = np.asarray(bp.predict_raw(
-            X, f64_exact=self.config.f64_scores))
+        self._inflight = (time.monotonic(), live)
+        try:
+            out = self._predict_with_retry(bp, X)
+        finally:
+            self._inflight = None
         self.metrics.on_batch(n, bp.bucket_for(n), backlog)
         done = time.monotonic()
         lo = 0
         for req in live:
             vals = out[lo: lo + req.n]
             lo += req.n
+            if req.event.is_set():
+                # the watchdog already failed this request (stalled
+                # batch): its client is gone — never double-complete
+                continue
             lat_ms = (done - req.t_enq) * 1e3
             req.result = ServeResult(values=vals, version=mv.tag,
                                      latency_ms=lat_ms, degraded=degraded,
                                      batch_rows=n)
             self.metrics.on_complete(lat_ms, degraded)
             req.event.set()
+
+    # -- watchdog --------------------------------------------------------
+    def _watchdog_loop(self) -> None:
+        """Detects the two ways a dispatcher hangs the queue: a STALLED
+        in-flight batch (device wedged — its requests fail with 503
+        instead of blocking their clients forever) and a DEAD dispatcher
+        thread (restarted, stranded requests failed)."""
+        limit_s = self.config.watchdog_ms / 1e3
+        period = max(limit_s / 4.0, 0.005)
+        while True:
+            time.sleep(period)
+            if self._closed:
+                return
+            infl = self._inflight
+            if infl is not None:
+                t_start, live = infl
+                if time.monotonic() - t_start > limit_s:
+                    n_failed = 0
+                    for req in live:
+                        if not req.event.is_set():
+                            req.error = DispatcherStalled(
+                                f"device batch exceeded the "
+                                f"{self.config.watchdog_ms:.0f} ms "
+                                "watchdog deadline")
+                            req.event.set()
+                            n_failed += 1
+                    if n_failed:
+                        self.metrics.on_watchdog(n_failed)
+                        log_warning(
+                            f"serve: watchdog failed {n_failed} "
+                            "request(s) of a stalled batch")
+            if not self._dispatcher.is_alive() and not self._closed:
+                log_warning("serve: dispatcher thread dead — restarting")
+                self.metrics.on_dispatcher_restart()
+                self._dispatcher = threading.Thread(
+                    target=self._dispatch_loop, name="serve-dispatcher",
+                    daemon=True)
+                self._dispatcher.start()
 
 
 def build_server(booster, config) -> Server:
@@ -302,6 +461,11 @@ def build_server(booster, config) -> Server:
         timeout_ms=config.serve_timeout_ms,
         degrade_trees=config.serve_degrade_trees,
         f64_scores=config.predict_f64_scores,
+        retry_max=config.serve_retry_max,
+        retry_backoff_ms=config.serve_retry_backoff_ms,
+        breaker_failures=config.serve_breaker_failures,
+        watchdog_ms=config.serve_watchdog_ms,
+        probe_rows=config.serve_probe_rows,
         predictor_kwargs={
             "bucket_min": config.predict_bucket_min,
             "cache_entries": config.predict_cache_entries,

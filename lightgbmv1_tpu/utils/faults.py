@@ -1,0 +1,252 @@
+"""Deterministic fault injection — the chaos substrate.
+
+The reference has no fault story at all (a crashed trainer is restarted
+by hand from a snapshot; the Predictor is a batch job).  A production
+system serving live traffic meets every failure the hardware and the
+fleet can produce — dead replicas, torn checkpoint files, NaN-poisoned
+gradient passes, stuck collectives, wedged dispatchers — and each one
+needs an *injection point* so the recovery path is exercised by tests
+instead of discovered in an outage.  This module is that injection
+layer: **seeded, counter-deterministic fault plans** that fire on the
+Nth matching event, so a chaos scenario replays bit-identically.
+
+Design rules:
+
+* **Zero cost when inactive.**  Every hook is ``if faults.active():``
+  over a module global — no allocation, no locking on the hot path.
+* **Deterministic.**  A plan fires on event *counts* (the ``at``-th
+  matching event, then ``count`` consecutive events), never on wall
+  clock or unseeded randomness; ``seed`` drives only the byte choices
+  of ``corrupt`` mode via a counter-keyed RandomState.
+* **Process-spanning.**  ``LGBMV1_FAULTS`` (a JSON list of spec dicts)
+  arms the plan at import time, so a *subprocess* CLI run can be killed
+  mid-snapshot by the chaos driver — a real ``os._exit`` with no
+  cleanup, the honest crash.
+
+Injection points wired through the codebase (grep ``faults.fire``):
+
+========================  =====================================================
+kind                      site / effect
+========================  =====================================================
+``h2d``                   models/predict.py — raise before the Nth host->device
+                          batch transfer (transient device error)
+``file_write``            utils/fileio.py atomic writer — ``truncate`` (torn
+                          file), ``corrupt`` (flipped bytes), ``kill`` (die
+                          after tmp write, before the atomic rename)
+``grad_poison``           models/gbdt.py — NaN-poison a slice of the gradient
+                          pass at iteration ``payload`` (traced, fires inside
+                          jit exactly once)
+``dispatch``              serve/server.py — ``raise`` (failed device batch),
+                          ``stall`` (wedge for ``stall_s``), ``exit_thread``
+                          (dispatcher thread dies)
+``publish_warm``          serve/registry.py — fail a publish() mid-warm,
+                          before the atomic swap
+``snapshot``              cli.py — fires after the Nth snapshot/checkpoint
+                          write (``kill`` = crash the training process there)
+========================  =====================================================
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from .log import log_warning
+
+
+class FaultInjected(RuntimeError):
+    """An injected fault fired in ``raise`` mode.  Deliberately a plain
+    RuntimeError subclass: recovery code must treat it like any real
+    transient error (retry, shed, roll back), never special-case it."""
+
+
+class ThreadKilled(BaseException):
+    """``exit_thread`` mode: kills the *current worker thread* (the serve
+    dispatcher), not the process.  A BaseException so ordinary
+    ``except Exception`` recovery paths cannot swallow the death — the
+    watchdog must notice the corpse instead."""
+
+
+@dataclass
+class FaultSpec:
+    """One scripted fault: fire on the ``at``-th matching event (1-based)
+    and the following ``count - 1`` events."""
+
+    kind: str                 # h2d | file_write | grad_poison | dispatch | ...
+    mode: str = "raise"       # raise | truncate | corrupt | kill | stall |
+                              # exit_thread | nan
+    at: int = 1               # 1-based index of the first firing event
+    count: int = 1            # consecutive events that fire from `at`
+    match: str = ""           # substring the site must contain ("" = any)
+    stall_s: float = 0.0      # mode=stall: how long to wedge
+    payload: int = 0          # kind-specific (grad_poison: iteration index)
+
+    def to_dict(self) -> Dict[str, object]:
+        return {k: getattr(self, k) for k in
+                ("kind", "mode", "at", "count", "match", "stall_s",
+                 "payload")}
+
+
+class FaultPlan:
+    """A seeded list of :class:`FaultSpec` with per-spec event counters.
+    Thread-safe: serve-path hooks fire from dispatcher/watchdog threads."""
+
+    def __init__(self, specs: List[FaultSpec], seed: int = 0):
+        self.specs = list(specs)
+        self.seed = int(seed)
+        self._lock = threading.Lock()
+        self._counts: Dict[int, int] = {}
+        self.fired: List[Tuple[str, str, str]] = []   # (kind, site, mode)
+
+    # ------------------------------------------------------------------
+    def on_event(self, kind: str, site: str = "") -> Optional[FaultSpec]:
+        """Count one event; return the spec that fires on it, if any."""
+        hit = None
+        with self._lock:
+            for i, sp in enumerate(self.specs):
+                if sp.kind != kind or (sp.match and sp.match not in site):
+                    continue
+                n = self._counts.get(i, 0) + 1
+                self._counts[i] = n
+                if sp.at <= n < sp.at + sp.count and hit is None:
+                    hit = sp
+                    self.fired.append((kind, site, sp.mode))
+        return hit
+
+    def peek(self, kind: str) -> Optional[FaultSpec]:
+        """First spec of a kind WITHOUT counting an event — for faults
+        that are baked in at trace time (grad_poison)."""
+        for sp in self.specs:
+            if sp.kind == kind:
+                return sp
+        return None
+
+    def corrupt_bytes(self, data: bytes, event_index: int = 0) -> bytes:
+        """Seeded byte flips in the middle third of the payload."""
+        import numpy as np
+
+        if not data:
+            return data
+        rng = np.random.RandomState((self.seed * 1_000_003 + event_index)
+                                    & 0x7FFFFFFF)
+        buf = bytearray(data)
+        lo, hi = len(buf) // 3, max(2 * len(buf) // 3, len(buf) // 3 + 1)
+        for _ in range(max(8, (hi - lo) // 64)):
+            i = int(rng.randint(lo, hi))
+            buf[i] ^= 0xFF
+        return bytes(buf)
+
+
+# ---------------------------------------------------------------------------
+# module-global active plan
+# ---------------------------------------------------------------------------
+
+_ACTIVE: Optional[FaultPlan] = None
+
+
+def active() -> bool:
+    return _ACTIVE is not None
+
+
+def current_plan() -> Optional[FaultPlan]:
+    return _ACTIVE
+
+
+def activate(plan: Optional[FaultPlan]) -> None:
+    global _ACTIVE
+    _ACTIVE = plan
+
+
+def deactivate() -> None:
+    activate(None)
+
+
+class inject:
+    """Context manager arming a plan for the enclosed block::
+
+        with faults.inject(FaultSpec("h2d", mode="raise", at=2)):
+            ...
+    """
+
+    def __init__(self, *specs: FaultSpec, seed: int = 0):
+        self.plan = FaultPlan(list(specs), seed=seed)
+
+    def __enter__(self) -> FaultPlan:
+        activate(self.plan)
+        return self.plan
+
+    def __exit__(self, *exc) -> None:
+        deactivate()
+
+
+def plan_from_env(env_var: str = "LGBMV1_FAULTS") -> Optional[FaultPlan]:
+    """Arm a plan from a JSON spec list in the environment — the bridge
+    that lets the chaos driver inject faults into a *subprocess* CLI run
+    (the only honest way to test a SIGKILL-grade crash)."""
+    raw = os.environ.get(env_var, "")
+    if not raw:
+        return None
+    try:
+        items = json.loads(raw)
+        seed = 0
+        specs = []
+        for it in items:
+            if "seed" in it and len(it) == 1:
+                seed = int(it["seed"])
+                continue
+            specs.append(FaultSpec(**it))
+        return FaultPlan(specs, seed=seed)
+    except (ValueError, TypeError) as e:
+        log_warning(f"faults: unparseable {env_var} ignored ({e})")
+        return None
+
+
+# arm automatically for subprocess scenarios; a no-op when the var is unset
+if os.environ.get("LGBMV1_FAULTS"):
+    activate(plan_from_env())
+
+
+# ---------------------------------------------------------------------------
+# hooks
+# ---------------------------------------------------------------------------
+
+
+def fire(kind: str, site: str = "") -> Optional[FaultSpec]:
+    """The generic injection hook.  Handles the process/thread-level modes
+    itself (``raise`` / ``stall`` / ``kill`` / ``exit_thread``); returns
+    the spec for caller-interpreted modes (``truncate`` / ``corrupt`` /
+    ``nan``) and ``None`` when nothing fires."""
+    plan = _ACTIVE
+    if plan is None:
+        return None
+    sp = plan.on_event(kind, site)
+    if sp is None:
+        return None
+    if sp.mode == "raise":
+        raise FaultInjected(f"injected {kind} fault at {site or '<any>'}")
+    if sp.mode == "stall":
+        log_warning(f"faults: stalling {kind}/{site} for {sp.stall_s}s")
+        time.sleep(sp.stall_s)
+        return sp
+    if sp.mode == "kill":
+        # the honest crash: no atexit, no finally blocks, no flush
+        os._exit(137)
+    if sp.mode == "exit_thread":
+        raise ThreadKilled(f"injected {kind} thread death at {site}")
+    return sp
+
+
+def grad_poison_iteration() -> Optional[int]:
+    """Iteration index of an armed ``grad_poison`` fault, or None.  Read
+    once at trainer build (trace time): the poison is a traced
+    ``iteration == N`` select, so it fires exactly once even inside a
+    scanned multi-iteration dispatch."""
+    plan = _ACTIVE
+    if plan is None:
+        return None
+    sp = plan.peek("grad_poison")
+    return int(sp.payload) if sp is not None else None

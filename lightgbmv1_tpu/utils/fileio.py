@@ -38,6 +38,71 @@ def open_file(path, mode: str = "r", **kwargs) -> IO:
     return fsspec.open(path, mode, **kwargs).open()
 
 
+def atomic_write_bytes(path, data: bytes, site: str = "") -> None:
+    """Crash-consistent local write: tmp file in the target directory,
+    ``flush`` + ``fsync``, then ``os.replace`` (atomic on POSIX) and a
+    directory fsync so the rename itself is durable.  A crash at ANY
+    point leaves either the old file or the new file — never a torn one.
+
+    Remote (scheme-prefixed) paths fall back to a plain streamed write:
+    object stores commit whole objects, so the tmp+rename dance is both
+    impossible and unnecessary there.
+
+    Fault injection (``utils/faults.py``, kind ``file_write``): the chaos
+    suite uses this exact seam to produce torn files (``truncate``),
+    flipped bytes (``corrupt``) and crash-before-rename (``kill``) —
+    validating that the *readers* of these files survive every one.
+    """
+    import os
+
+    from . import faults
+
+    path = str(path)
+    sp = faults.fire("file_write", site=site or path)
+    if sp is not None and sp.mode == "truncate":
+        # a torn write: half the payload lands at the FINAL path with no
+        # atomicity — the legacy failure mode this module exists to kill,
+        # kept reproducible so the validators stay honest
+        with open(path, "wb") as fh:
+            fh.write(data[: max(len(data) // 2, 1)])
+        return
+    if sp is not None and sp.mode == "corrupt":
+        data = faults.current_plan().corrupt_bytes(data)
+    if is_remote_path(path):
+        with open_file(path, "wb") as fh:
+            fh.write(data)
+        return
+    d = os.path.dirname(os.path.abspath(path))
+    tmp = os.path.join(d, f".{os.path.basename(path)}.tmp.{os.getpid()}")
+    try:
+        with open(tmp, "wb") as fh:
+            fh.write(data)
+            fh.flush()
+            os.fsync(fh.fileno())
+        if sp is not None and sp.mode == "kill":
+            os._exit(137)   # crash between tmp write and rename: the old
+                            # file must survive intact
+        os.replace(tmp, path)
+        try:
+            dfd = os.open(d, os.O_RDONLY)
+            try:
+                os.fsync(dfd)
+            finally:
+                os.close(dfd)
+        except OSError:   # pragma: no cover — not all filesystems allow it
+            pass
+    finally:
+        if os.path.exists(tmp):
+            try:
+                os.remove(tmp)
+            except OSError:   # pragma: no cover
+                pass
+
+
+def atomic_write_text(path, text: str, site: str = "") -> None:
+    atomic_write_bytes(path, text.encode("utf-8"), site=site)
+
+
 def exists(path) -> bool:
     path = str(path)
     if not is_remote_path(path):

@@ -1,0 +1,89 @@
+"""Chaos harness (tools/chaos.py) under the ``chaos`` marker.
+
+Each test drives one scripted fault scenario end to end and asserts the
+scenario's own recovery record — the same functions bench.py's
+measure_chaos and __graft_entry__.chaos_smoke aggregate into the CHAOS
+record's ``chaos_ok`` guard.
+
+Tier-1 wall budget: a fast deterministic subset (poisoned gradients,
+publish-of-garbage, transient-H2D) runs in tier-1; the scenarios that
+train multiple CLI models or sit in multi-second stalls are
+``slow``-marked — they run in the full suite, in every bench capture
+(measure_chaos) and in every driver capture (chaos_smoke), so the
+recovery paths cannot rot between sessions.  The CLI-level
+kill/torn-resume paths are additionally pinned in tier-1 by
+tests/test_cli.py and the checkpoint validators by
+tests/test_checkpoint.py.
+"""
+
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+
+from tools import chaos  # noqa: E402
+
+pytestmark = pytest.mark.chaos
+
+
+@pytest.mark.slow
+def test_train_kill_resume_in_process(tmp_path):
+    out = chaos.scenario_train_kill_resume(str(tmp_path),
+                                           subprocess_kill=False)
+    assert out["crashed"] and out["model_absent"]
+    assert out["bit_identical"], out
+    assert out["ok"]
+
+
+@pytest.mark.slow
+def test_train_kill_resume_subprocess(tmp_path):
+    """The honest crash: a child CLI process dies with os._exit(137)
+    right after a snapshot write; rerunning the command auto-resumes
+    from the checkpoint bundle to a byte-identical final model."""
+    out = chaos.scenario_train_kill_resume(str(tmp_path),
+                                           subprocess_kill=True)
+    assert out["ok"], out
+
+
+@pytest.mark.slow
+def test_torn_snapshot_falls_back(tmp_path):
+    out = chaos.scenario_torn_snapshot(str(tmp_path))
+    assert out["torn_rejected"], out
+    assert out["bit_identical"], out
+    assert out["ok"]
+
+
+def test_poisoned_gradients_detected_and_clamped():
+    out = chaos.scenario_poisoned_gradients()
+    assert out["detected_at_boundary"], out
+    assert out["clamp_survived"], out
+    assert out["ok"]
+
+
+def test_publish_of_garbage_never_serves():
+    out = chaos.scenario_publish_of_garbage()
+    assert out["garbage_rejected"] and out["active_served_exact"], out
+    assert out["ok"]
+
+
+@pytest.mark.slow
+def test_dispatcher_stall_and_death_recovered():
+    out = chaos.scenario_dispatcher_stall()
+    assert out["stalled_failed_fast"] and out["watchdog_restarted"], out
+    assert out["ok"]
+
+
+@pytest.mark.slow
+def test_overload_sheds_bounded():
+    out = chaos.scenario_overload()
+    assert out["shed"] > 0 and out["queue_bounded"] and not out["hung"], out
+    assert out["ok"]
+
+
+def test_h2d_transient_retried():
+    out = chaos.scenario_h2d_transient()
+    assert out["retries"] >= 1 and out["answer_exact"], out
+    assert out["ok"]

@@ -67,6 +67,27 @@ def test_cli_snapshot_freq(tmp_path):
     assert rc == 0
     assert os.path.exists(model + ".snapshot_iter_2")
     assert os.path.exists(model + ".snapshot_iter_4")
+    # PR 6: every snapshot also writes a full trainer-state bundle
+    from lightgbmv1_tpu.io.checkpoint import validate_checkpoint
+
+    assert validate_checkpoint(model + ".ckpt_iter_2")["iteration"] == 2
+    assert validate_checkpoint(model + ".ckpt_iter_4")["iteration"] == 4
+
+
+def test_cli_snapshot_keep_prunes_old_artifacts(tmp_path):
+    """snapshot_keep bounds the disk footprint: only the newest N of
+    each artifact kind survive a long run."""
+    data = _write_data(tmp_path)
+    model = str(tmp_path / "m.txt")
+    rc = cli_main([f"data={data}", "objective=binary", "num_trees=6",
+                   "num_leaves=7", "min_data_in_leaf=20", "snapshot_freq=2",
+                   f"output_model={model}", "verbosity=-1"])
+    assert rc == 0
+    for gone in ("snapshot_iter_2", "ckpt_iter_2"):
+        assert not os.path.exists(model + "." + gone), gone
+    for kept in ("snapshot_iter_4", "snapshot_iter_6", "ckpt_iter_4",
+                 "ckpt_iter_6"):
+        assert os.path.exists(model + "." + kept), kept
 
 
 def test_cli_refit(tmp_path):
@@ -141,8 +162,9 @@ def test_convert_model_cpp_compiles_and_matches(tmp_path):
 
 
 def test_cli_snapshot_auto_resume(tmp_path):
-    """Crash recovery: rerunning the same train command picks up the newest
-    snapshot and trains only the remaining iterations."""
+    """Crash recovery: rerunning the same train command picks up the
+    newest VALID artifact — checkpoint bundles resume BIT-EXACTLY; the
+    model-text snapshot remains the fallback when no bundle is intact."""
     data = _write_data(tmp_path)
     model = str(tmp_path / "m.txt")
     args = [f"data={data}", "objective=binary", "num_trees=6",
@@ -152,24 +174,62 @@ def test_cli_snapshot_auto_resume(tmp_path):
     import lightgbmv1_tpu as lgb
     full = lgb.Booster(model_file=model)
     assert full.num_trees() == 6
-    # simulate a crash after iteration 4: delete the final model + last snap
+    with open(model) as fh:
+        straight = fh.read()
+    # simulate a crash after iteration 4: delete the final model + the
+    # iteration-6 artifacts
     os.remove(model)
     os.remove(model + ".snapshot_iter_6")
+    os.remove(model + ".ckpt_iter_6")
     import io
     from contextlib import redirect_stderr
     buf = io.StringIO()
     with redirect_stderr(buf):
         cli_main([a for a in args if not a.startswith("verbosity")]
-                 + ["verbosity=1"])   # resumes from snapshot_iter_4
-    assert "Resuming from snapshot" in buf.getvalue()
-    resumed = lgb.Booster(model_file=model)
-    assert resumed.num_trees() == 6
-    # a COMPLETED run must not be hijacked by leftover snapshots
+                 + ["verbosity=1"])   # resumes from ckpt_iter_4
+    assert "Resuming bit-exactly from checkpoint" in buf.getvalue()
+    with open(model) as fh:
+        assert fh.read() == straight   # byte-identical to the unkilled run
+    # model-text fallback: with every bundle gone, the snapshot resumes
+    os.remove(model)
+    for p in os.listdir(tmp_path):
+        if ".ckpt_iter_" in p:
+            os.remove(str(tmp_path / p))
+    buf3 = io.StringIO()
+    with redirect_stderr(buf3):
+        cli_main([a for a in args if not a.startswith("verbosity")]
+                 + ["verbosity=1"])
+    assert "Resuming from snapshot" in buf3.getvalue()
+    assert lgb.Booster(model_file=model).num_trees() == 6
+    # a COMPLETED run must not be hijacked by leftover artifacts
     buf2 = io.StringIO()
     with redirect_stderr(buf2):
         cli_main([a for a in args if not a.startswith("verbosity")]
                  + ["verbosity=1"])
-    assert "Resuming from snapshot" not in buf2.getvalue()
+    assert "Resuming" not in buf2.getvalue()
+
+
+@pytest.mark.slow
+def test_cli_auto_resume_skips_torn_checkpoint(tmp_path):
+    """A torn newest bundle is rejected by validate-on-load and the scan
+    falls back to the previous INTACT one — final model still
+    byte-identical to the uninterrupted run."""
+    data = _write_data(tmp_path)
+    model = str(tmp_path / "m.txt")
+    args = [f"data={data}", "objective=binary", "num_trees=6",
+            "num_leaves=7", "min_data_in_leaf=20", "snapshot_freq=2",
+            f"output_model={model}", "verbosity=-1"]
+    cli_main(args)
+    with open(model) as fh:
+        straight = fh.read()
+    os.remove(model)
+    os.remove(model + ".snapshot_iter_6")    # no text fallback at 6
+    raw = open(model + ".ckpt_iter_6", "rb").read()
+    with open(model + ".ckpt_iter_6", "wb") as fh:
+        fh.write(raw[: len(raw) // 2])       # torn newest bundle
+    cli_main(args)
+    with open(model) as fh:
+        assert fh.read() == straight
 
 
 def test_cli_profile_dir_writes_trace(tmp_path):

@@ -147,6 +147,43 @@ def test_serving_section_renders_serve_fields():
     assert "No serve fields" in txt
 
 
+def test_robustness_section_renders_chaos_fields():
+    """The Robustness section (PR 6) is generated from the BENCH chaos_*
+    fields (bench.py measure_chaos via tools/chaos.py): the per-scenario
+    recovery table and the chaos_ok guard grep to record fields."""
+    import perf_report
+
+    rec = {
+        "chaos_ok": True, "chaos_n_scenarios": 7, "chaos_seconds": 31.2,
+        "chaos_scenarios": {
+            "train_kill_resume": True, "torn_snapshot": True,
+            "poisoned_gradients": True, "publish_of_garbage": True,
+            "dispatcher_stall": True, "overload": True,
+            "h2d_transient": True,
+        },
+    }
+    lines = []
+    perf_report.robustness_section(lines.append, rec)
+    txt = "\n".join(lines)
+    assert "## Robustness" in txt
+    for needle in ("chaos_ok=True", "bit-identical model text",
+                   "never serves an answer", "watchdog 503",
+                   "finite_guard", "31.2", "7 scripted fault scenarios"):
+        assert needle in txt, needle
+    # a record with no chaos capture renders the placeholder, never dies
+    lines = []
+    perf_report.robustness_section(lines.append, {})
+    txt = "\n".join(lines)
+    assert "No chaos fields" in txt
+    # a failed scenario renders False (the guard line carries it)
+    rec["chaos_scenarios"]["overload"] = False
+    rec["chaos_ok"] = False
+    lines = []
+    perf_report.robustness_section(lines.append, rec)
+    txt = "\n".join(lines)
+    assert "chaos_ok=False" in txt and "| False |" in txt
+
+
 def test_comm_section_renders_in_perf_md():
     """PERF.md (generated output) must carry the Cross-chip comms section
     and its figures must grep to the analytic formula."""

@@ -392,7 +392,9 @@ def test_http_endpoint_roundtrip(boosters):
         np.testing.assert_array_equal(
             np.asarray(out["values"])[:, 0], _host_raw(b1, X[:3]))
         health = json.loads(urllib.request.urlopen(u + "/healthz").read())
-        assert health == {"ok": True, "version": "v1"}
+        # liveness, not process-up (PR 6): registry + dispatcher state
+        assert health == {"ok": True, "version": "v1",
+                          "dispatcher_alive": True, "published": True}
         m = json.loads(urllib.request.urlopen(u + "/metrics").read())
         assert m["completed"] >= 1 and m["version"] == "v1"
         with pytest.raises(urllib.error.HTTPError) as ei:

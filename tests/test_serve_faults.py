@@ -1,0 +1,363 @@
+"""Serving failure domains (PR 6): publish validation, circuit breaker,
+retry-with-backoff, dispatcher watchdog, and the hardened HTTP error
+paths.
+
+These are the *unit*-level pins behind tools/chaos.py's end-to-end
+scenarios: each failure domain is exercised in isolation with the fault
+layer (utils/faults.py) so a regression names the broken domain, not
+just "chaos failed".
+
+Tier-1 wall budget: each failure domain is pinned at least once in
+tier-1; the heavier/sleep-bound variants (golden probe, retry
+exhaustion, dispatcher restart, HTTP stall mapping) are ``slow``-marked
+and covered by the full suite + the chaos tool every capture.
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import lightgbmv1_tpu as lgb
+from lightgbmv1_tpu.serve import (DispatcherDied, DispatcherStalled,
+                                  PublishValidationError, ServeConfig,
+                                  ServeHTTP, Server)
+from lightgbmv1_tpu.utils import faults
+from lightgbmv1_tpu.utils.faults import FaultInjected, FaultSpec
+
+from conftest import make_binary_problem
+
+
+def _train(rounds, num_leaves=15, seed=1):
+    X, y = make_binary_problem(1200, 8, seed=seed)
+    return lgb.train({"objective": "binary", "num_leaves": num_leaves,
+                      "min_data_in_leaf": 5, "verbosity": -1},
+                     lgb.Dataset(X, label=y), num_boost_round=rounds,
+                     verbose_eval=False), X
+
+
+def _host_raw(booster, X):
+    return np.asarray(booster.predict(X, raw_score=True,
+                                      predict_method="host"), np.float64)
+
+
+@pytest.fixture(scope="module")
+def boosters():
+    b1, X = _train(4)
+    b2, _ = _train(8, num_leaves=31)
+    return b1, b2, X
+
+
+def _cfg(**over):
+    kw = dict(max_batch_rows=64, max_batch_delay_ms=1.0,
+              queue_depth_rows=4096, f64_scores=True,
+              retry_max=2, retry_backoff_ms=2.0, breaker_failures=3,
+              predictor_kwargs={"bucket_min": 64})
+    kw.update(over)
+    return ServeConfig(**kw)
+
+
+# ---------------------------------------------------------------------------
+# publish validation — a corrupt model can never reach traffic
+# ---------------------------------------------------------------------------
+
+
+def test_publish_rejects_nan_leaves(boosters):
+    b1, b2, X = boosters
+    srv = Server(b1, config=_cfg())
+    try:
+        want = _host_raw(b1, X[:8])
+        corrupt = lgb.Booster(model_str=b2.model_to_string())
+        corrupt._loaded.trees[0].leaf_value[:] = np.nan
+        with pytest.raises(PublishValidationError, match="non-finite"):
+            srv.publish(corrupt)
+        assert srv.version() == "v1"
+        r = srv.submit(X[:8])
+        assert r.version == "v1"
+        np.testing.assert_array_equal(r.values[:, 0], want)
+        assert srv.metrics_snapshot()["publish_rejects"] == 1
+    finally:
+        srv.close()
+
+
+def test_publish_rejects_structurally_cyclic_tree(boosters):
+    """validate_host_tree rides publish: a cyclic candidate is refused
+    pre-swap instead of hanging a serving walk."""
+    b1, b2, X = boosters
+    srv = Server(b1, config=_cfg())
+    try:
+        corrupt = lgb.Booster(model_str=b2.model_to_string())
+        t = corrupt._loaded.trees[0]
+        if t.num_leaves > 2:
+            t.left_child[0] = 0          # node 0 -> node 0: a cycle
+        with pytest.raises((PublishValidationError, Exception)):
+            srv.publish(corrupt)
+        assert srv.version() == "v1"
+    finally:
+        srv.close()
+
+
+def test_publish_midwarm_failure_keeps_active(boosters):
+    b1, b2, X = boosters
+    srv = Server(b1, config=_cfg())
+    try:
+        with faults.inject(FaultSpec("publish_warm", mode="raise", at=1)):
+            with pytest.raises(FaultInjected):
+                srv.publish(b2)
+        assert srv.version() == "v1"
+        r = srv.submit(X[:4])
+        assert r.version == "v1"
+        tag = srv.publish(b2)            # clean publish still works
+        assert srv.submit(X[:4]).version == tag
+    finally:
+        srv.close()
+
+
+@pytest.mark.slow
+def test_golden_probe_catches_semantic_corruption(boosters):
+    """The probe compares the candidate's device predictor against the
+    host-tree oracle bit-exactly — a predictor that walks wrong (here:
+    simulated via a monkeypatched predict_raw) is refused."""
+    from lightgbmv1_tpu.serve.registry import ModelRegistry
+
+    b1, _, X = boosters
+    reg = ModelRegistry()
+    orig = None
+
+    from lightgbmv1_tpu.models.predict import BatchPredictor
+
+    orig = BatchPredictor.predict_raw
+
+    def wrong(self, X, f64_exact=False, chunk_rows=None):
+        out = np.asarray(orig(self, X, f64_exact=f64_exact,
+                              chunk_rows=chunk_rows))
+        return out + 1e-9                 # a one-ulp-ish semantic bug
+
+    BatchPredictor.predict_raw = wrong
+    try:
+        with pytest.raises(PublishValidationError, match="probe"):
+            reg.publish(b1, probe_rows=32)
+    finally:
+        BatchPredictor.predict_raw = orig
+    # un-patched, the same publish passes the probe
+    assert reg.publish(b1, probe_rows=32) == "v2"
+
+
+# ---------------------------------------------------------------------------
+# retry / breaker / watchdog
+# ---------------------------------------------------------------------------
+
+
+def test_transient_h2d_error_is_retried(boosters):
+    b1, _, X = boosters
+    srv = Server(b1, config=_cfg())
+    try:
+        srv.submit(X[:4])
+        want = _host_raw(b1, X[:8])
+        with faults.inject(FaultSpec("h2d", mode="raise", at=1)):
+            r = srv.submit(X[:8])
+        np.testing.assert_array_equal(r.values[:, 0], want)
+        snap = srv.metrics_snapshot()
+        assert snap["retries"] >= 1 and snap["errors"] == 0
+    finally:
+        srv.close()
+
+
+@pytest.mark.slow
+def test_retry_exhaustion_fails_batch(boosters):
+    b1, _, X = boosters
+    srv = Server(b1, config=_cfg(retry_max=1, breaker_failures=0))
+    try:
+        srv.submit(X[:4])
+        with faults.inject(FaultSpec("dispatch", mode="raise", at=1,
+                                     count=10)):
+            with pytest.raises(FaultInjected):
+                srv.submit(X[:4])
+        assert srv.metrics_snapshot()["errors"] >= 1
+    finally:
+        srv.close()
+
+
+def test_circuit_breaker_rolls_back_bad_version(boosters):
+    """Consecutive batch failures on the new version auto-roll back to
+    the previous one; traffic then succeeds on the rolled-back tag."""
+    b1, b2, X = boosters
+    srv = Server(b1, config=_cfg(retry_max=0, breaker_failures=2))
+    try:
+        srv.submit(X[:4])
+        srv.publish(b2)
+        assert srv.version() == "v2"
+        with faults.inject(FaultSpec("dispatch", mode="raise", at=1,
+                                     count=2)):
+            for _ in range(2):
+                with pytest.raises(FaultInjected):
+                    srv.submit(X[:2])
+        snap = srv.metrics_snapshot()
+        assert snap["breaker_trips"] == 1
+        assert srv.version() == "v1"      # rolled back
+        r = srv.submit(X[:4])
+        assert r.version == "v1"
+        np.testing.assert_array_equal(r.values[:, 0],
+                                      _host_raw(b1, X[:4]))
+    finally:
+        srv.close()
+
+
+def test_watchdog_fails_stalled_batch_fast(boosters):
+    b1, _, X = boosters
+    srv = Server(b1, config=_cfg(watchdog_ms=120.0))
+    try:
+        srv.submit(X[:4])
+        stall_s = 0.6
+        with faults.inject(FaultSpec("dispatch", mode="stall", at=1,
+                                     stall_s=stall_s)):
+            t0 = time.monotonic()
+            with pytest.raises(DispatcherStalled):
+                srv.submit(X[:4])
+            assert time.monotonic() - t0 < stall_s
+        assert srv.metrics_snapshot()["watchdog_failures"] >= 1
+        time.sleep(stall_s + 0.2)         # wedged batch drains
+        assert srv.submit(X[:4]).version == "v1"
+    finally:
+        srv.close()
+
+
+@pytest.mark.slow
+def test_watchdog_restarts_dead_dispatcher(boosters):
+    b1, _, X = boosters
+    srv = Server(b1, config=_cfg(watchdog_ms=100.0))
+    try:
+        srv.submit(X[:4])
+        with faults.inject(FaultSpec("dispatch", mode="exit_thread",
+                                     at=1)):
+            with pytest.raises((DispatcherDied, DispatcherStalled)):
+                srv.submit(X[:4])
+        deadline = time.monotonic() + 3.0
+        while not srv.dispatcher_alive() and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert srv.dispatcher_alive()
+        assert srv.submit(X[:4]).version == "v1"
+        snap = srv.metrics_snapshot()
+        assert snap["dispatcher_restarts"] >= 1
+        assert srv.health()["ok"]
+    finally:
+        srv.close()
+
+
+# ---------------------------------------------------------------------------
+# hardened HTTP error paths + healthz liveness
+# ---------------------------------------------------------------------------
+
+
+def _post(url, body: bytes):
+    return urllib.request.urlopen(urllib.request.Request(
+        url, data=body, headers={"Content-Type": "application/json"}))
+
+
+def test_http_bad_inputs_return_400_not_500(boosters):
+    b1, _, X = boosters
+    srv = Server(b1, config=_cfg())
+    http = ServeHTTP(srv, port=0).start()
+    try:
+        u = f"http://127.0.0.1:{http.port}/predict"
+        cases = [
+            b"not json at all",                          # malformed JSON
+            b"[1, 2, 3]",                                # non-object body
+            b"{}",                                       # missing rows
+            b'{"rows": "nope"}',                         # rows not a list
+            b'{"rows": []}',                             # empty rows
+            b'{"rows": [["a", "b", 1, 2, 3, 4, 5, 6]]}',  # non-numeric
+            b'{"rows": [[1, 2, 3]]}',                    # wrong width
+            b'{"rows": [[1, 2], [1, 2, 3]]}',            # ragged
+        ]
+        for body in cases:
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                _post(u, body)
+            assert ei.value.code == 400, (body, ei.value.code)
+            payload = json.loads(ei.value.read())
+            assert "error" in payload, body
+        # a good request still succeeds after all the bad ones
+        out = json.loads(_post(u, json.dumps(
+            {"rows": X[:2].tolist()}).encode()).read())
+        assert out["version"] == "v1"
+    finally:
+        http.shutdown()
+        srv.close()
+
+
+def test_http_healthz_reflects_liveness(boosters):
+    """healthz is liveness, not process-up: 200 only while a model is
+    published AND the dispatcher is alive; 503 (ok=false) when the
+    dispatcher is dead or nothing is published."""
+    b1, _, X = boosters
+    srv = Server(config=_cfg())          # nothing published yet
+    http = ServeHTTP(srv, port=0).start()
+    try:
+        u = f"http://127.0.0.1:{http.port}"
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(u + "/healthz")
+        assert ei.value.code == 503
+        body = json.loads(ei.value.read())
+        assert body["ok"] is False and body["published"] is False
+
+        srv.publish(b1)
+        health = json.loads(urllib.request.urlopen(u + "/healthz").read())
+        assert health["ok"] is True and health["version"] == "v1"
+        assert health["dispatcher_alive"] is True
+
+        # no watchdog configured: a dead dispatcher flips healthz to 503
+        with faults.inject(FaultSpec("dispatch", mode="exit_thread",
+                                     at=1)):
+            with pytest.raises(Exception):  # noqa: B017 — died mid-req
+                srv.submit(X[:2])
+        deadline = time.monotonic() + 2.0
+        while srv.dispatcher_alive() and time.monotonic() < deadline:
+            time.sleep(0.02)
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(u + "/healthz")
+        assert ei.value.code == 503
+        assert json.loads(ei.value.read())["dispatcher_alive"] is False
+    finally:
+        http.shutdown()
+        srv.close()
+
+
+def test_http_unpublished_predict_is_503_not_500(boosters):
+    srv = Server(config=_cfg())
+    http = ServeHTTP(srv, port=0).start()
+    try:
+        u = f"http://127.0.0.1:{http.port}/predict"
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post(u, b'{"rows": [[1, 2, 3, 4, 5, 6, 7, 8]]}')
+        assert ei.value.code == 503
+    finally:
+        http.shutdown()
+        srv.close()
+
+
+@pytest.mark.slow
+def test_watchdog_stall_maps_to_503_over_http(boosters):
+    b1, _, X = boosters
+    srv = Server(b1, config=_cfg(watchdog_ms=150.0))
+    http = ServeHTTP(srv, port=0).start()
+    try:
+        u = f"http://127.0.0.1:{http.port}/predict"
+        _post(u, json.dumps({"rows": X[:2].tolist()}).encode())
+        with faults.inject(FaultSpec("dispatch", mode="stall", at=1,
+                                     stall_s=0.5)):
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                _post(u, json.dumps({"rows": X[:2].tolist()}).encode())
+            assert ei.value.code == 503
+            assert "DispatcherStalled" in json.loads(
+                ei.value.read())["error"]
+        time.sleep(0.6)
+        out = json.loads(_post(u, json.dumps(
+            {"rows": X[:2].tolist()}).encode()).read())
+        assert out["version"] == "v1"
+    finally:
+        http.shutdown()
+        srv.close()

@@ -1,0 +1,491 @@
+"""Scripted chaos suite — every injected fault must be survivable.
+
+Runs the fault scenarios the robustness substrate (PR 6) exists for,
+end to end on CPU, and emits a CHAOS record with a ``chaos_ok`` guard
+(wired into ``bench.py`` and ``__graft_entry__.chaos_smoke`` so a
+regression in ANY recovery path trips a driver capture, not a pager):
+
+==========================  ===============================================
+scenario                    contract proven
+==========================  ===============================================
+``train_kill_resume``       a REAL ``os._exit`` mid-training (subprocess
+                            CLI, full suite) / an in-process crash (fast
+                            suite): auto-resume from the checkpoint bundle
+                            reproduces the uninterrupted run's model text
+                            **byte-identically**
+``torn_snapshot``           the newest checkpoint is torn at write time:
+                            validate-on-load rejects it, resume falls back
+                            to the previous INTACT bundle, final model
+                            still byte-identical
+``poisoned_gradients``      a NaN-poisoned gradient pass is DETECTED at
+                            the iteration boundary (``finite_guard=raise``)
+                            and SURVIVED under ``finite_guard=clamp``
+                            (finite model, training continues)
+``publish_of_garbage``      a corrupt candidate (NaN leaves) and a publish
+                            that dies mid-warm both leave the active
+                            version serving bit-exact answers — the corrupt
+                            model never serves a single response
+``dispatcher_stall``        a wedged device batch fails its requests fast
+                            (watchdog -> 503) instead of hanging the queue;
+                            a DEAD dispatcher thread is restarted; traffic
+                            resumes on the same version
+``overload``                a burst far above capacity sheds EXPLICITLY
+                            with the backlog bounded at the admission
+                            depth; post-burst requests succeed
+``h2d_transient``           a transient host->device transfer error is
+                            retried with backoff — zero client-visible
+                            failures
+==========================  ===============================================
+
+Usage::
+
+    python tools/chaos.py          # full suite (includes subprocess kill)
+    python tools/chaos.py --fast   # in-process deterministic subset
+
+Prints ``CHAOS {json}``; exit code 0 iff ``chaos_ok``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+
+# ---------------------------------------------------------------------------
+# helpers
+# ---------------------------------------------------------------------------
+
+
+def _write_data(path: str, n: int = 400, seed: int = 0) -> str:
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, 5)
+    y = (X[:, 0] - X[:, 1] + rng.randn(n) * 0.3 > 0).astype(float)
+    np.savetxt(path, np.column_stack([y, X]), fmt="%.7g", delimiter="\t")
+    return path
+
+
+def _cli_args(data: str, model: str, n_trees: int = 8):
+    return [f"data={data}", "objective=binary", f"num_trees={n_trees}",
+            "num_leaves=7", "min_data_in_leaf=20", "snapshot_freq=2",
+            f"output_model={model}", "verbosity=-1"]
+
+
+def _train_problem(n=1000, seed=3):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, 8)
+    logit = 1.5 * X[:, 0] - X[:, 1] + 0.8 * X[:, 2] * X[:, 3]
+    y = (logit + rng.randn(n) * 0.4 > 0).astype(np.float64)
+    return X, y
+
+
+_BOOSTER_CACHE = []
+
+
+def _tiny_boosters():
+    """Two small models + their training rows; memoized — the serving
+    scenarios only READ them (publishes copy via model text)."""
+    if not _BOOSTER_CACHE:
+        import lightgbmv1_tpu as lgb
+
+        X, y = _train_problem(1200, seed=1)
+        P = {"objective": "binary", "num_leaves": 15, "min_data_in_leaf": 5,
+             "verbosity": -1}
+        b1 = lgb.train(P, lgb.Dataset(X, label=y), num_boost_round=4,
+                       verbose_eval=False)
+        b2 = lgb.train(P, lgb.Dataset(X, label=y), num_boost_round=8,
+                       verbose_eval=False)
+        _BOOSTER_CACHE.append((b1, b2, X))
+    return _BOOSTER_CACHE[0]
+
+
+def _serve_cfg(**over):
+    from lightgbmv1_tpu.serve import ServeConfig
+
+    kw = dict(max_batch_rows=128, max_batch_delay_ms=1.0,
+              queue_depth_rows=4096, f64_scores=True,
+              retry_max=2, retry_backoff_ms=2.0, breaker_failures=3,
+              watchdog_ms=250.0, predictor_kwargs={"bucket_min": 64})
+    kw.update(over)
+    return ServeConfig(**kw)
+
+
+def _host_raw(booster, X):
+    return np.asarray(booster.predict(X, raw_score=True,
+                                      predict_method="host"), np.float64)
+
+
+# ---------------------------------------------------------------------------
+# scenarios — each returns a dict with at least {"ok": bool}
+# ---------------------------------------------------------------------------
+
+
+def scenario_train_kill_resume(tmp: str, subprocess_kill: bool) -> dict:
+    """Kill training after the 2nd snapshot; rerunning the same command
+    must auto-resume from the checkpoint bundle and produce model text
+    BYTE-IDENTICAL to a run that never died.  ``subprocess_kill=True``
+    uses a real child process and ``os._exit(137)`` (no cleanup, no
+    flush); the fast variant crashes in-process via an injected raise."""
+    from lightgbmv1_tpu.cli import main as cli_main
+    from lightgbmv1_tpu.utils import faults
+    from lightgbmv1_tpu.utils.faults import FaultInjected, FaultSpec
+
+    data = _write_data(os.path.join(tmp, "train.tsv"))
+    model = os.path.join(tmp, "m.txt")
+    args = _cli_args(data, model)
+
+    cli_main(args)                       # straight run
+    with open(model) as fh:
+        straight = fh.read()
+    for p in list(os.listdir(tmp)):      # clean slate for the crash run
+        if p.startswith("m.txt"):
+            os.remove(os.path.join(tmp, p))
+
+    plan = [{"kind": "snapshot", "mode": "kill", "at": 2}]
+    if subprocess_kill:
+        env = dict(os.environ, LGBMV1_FAULTS=json.dumps(plan),
+                   JAX_PLATFORMS="cpu",
+                   PYTHONPATH=REPO + os.pathsep
+                   + os.environ.get("PYTHONPATH", ""))
+        proc = subprocess.run(
+            [sys.executable, "-m", "lightgbmv1_tpu"] + args,
+            env=env, cwd=tmp, capture_output=True, text=True)
+        crashed = proc.returncode == 137
+    else:
+        with faults.inject(FaultSpec("snapshot", mode="raise", at=2)):
+            try:
+                cli_main(args)
+                crashed = False
+            except FaultInjected:
+                crashed = True
+    model_absent = not os.path.exists(model)
+
+    cli_main(args)                       # auto-resume
+    with open(model) as fh:
+        resumed = fh.read()
+    ok = crashed and model_absent and resumed == straight
+    return {"ok": ok, "crashed": crashed, "model_absent": model_absent,
+            "bit_identical": resumed == straight,
+            "kill": "subprocess" if subprocess_kill else "in-process"}
+
+
+def scenario_torn_snapshot(tmp: str) -> dict:
+    """The NEWEST checkpoint bundle is torn at write time (injected
+    non-atomic half-write) and the run dies there: validate-on-load must
+    reject the torn bundle, fall back to the previous intact one, and
+    the completed resume must still be byte-identical to the
+    uninterrupted run."""
+    from lightgbmv1_tpu.cli import main as cli_main
+    from lightgbmv1_tpu.utils import faults
+    from lightgbmv1_tpu.utils.faults import FaultInjected, FaultSpec
+
+    data = _write_data(os.path.join(tmp, "train.tsv"))
+    model = os.path.join(tmp, "m.txt")
+    args = _cli_args(data, model, n_trees=8)
+
+    cli_main(args)
+    with open(model) as fh:
+        straight = fh.read()
+    for p in list(os.listdir(tmp)):
+        if p.startswith("m.txt"):
+            os.remove(os.path.join(tmp, p))
+
+    # tear the 2nd checkpoint write (iteration 4), then crash right after
+    with faults.inject(
+            FaultSpec("file_write", mode="truncate", match=".ckpt_iter_4"),
+            FaultSpec("snapshot", mode="raise", at=2)):
+        try:
+            cli_main(args)
+            crashed = False
+        except FaultInjected:
+            crashed = True
+    torn = os.path.join(tmp, "m.txt.ckpt_iter_4")
+    from lightgbmv1_tpu.io.checkpoint import (CheckpointError,
+                                              validate_checkpoint)
+
+    torn_rejected = False
+    try:
+        validate_checkpoint(torn)
+    except CheckpointError:
+        torn_rejected = True
+
+    cli_main(args)                       # resume: must fall back to iter 2
+    with open(model) as fh:
+        resumed = fh.read()
+    ok = crashed and torn_rejected and resumed == straight
+    return {"ok": ok, "crashed": crashed, "torn_rejected": torn_rejected,
+            "bit_identical": resumed == straight}
+
+
+def scenario_poisoned_gradients() -> dict:
+    """NaN-poisoned gradient pass: ``finite_guard=raise`` detects it at
+    the iteration boundary; ``finite_guard=clamp`` survives it with a
+    finite model; guard off documents the silent-absorption baseline."""
+    import lightgbmv1_tpu as lgb
+    from lightgbmv1_tpu.models.gbdt import FiniteGuardError
+    from lightgbmv1_tpu.utils import faults
+    from lightgbmv1_tpu.utils.faults import FaultSpec
+
+    X, y = _train_problem()
+    P = {"objective": "binary", "num_leaves": 7, "min_data_in_leaf": 20,
+         "verbosity": -1}
+
+    detected = False
+    with faults.inject(FaultSpec("grad_poison", payload=2)):
+        try:
+            lgb.train({**P, "finite_guard": "raise"},
+                      lgb.Dataset(X, label=y), num_boost_round=6,
+                      verbose_eval=False)
+        except FiniteGuardError:
+            detected = True
+
+    with faults.inject(FaultSpec("grad_poison", payload=2)):
+        b = lgb.train({**P, "finite_guard": "clamp"},
+                      lgb.Dataset(X, label=y), num_boost_round=6,
+                      verbose_eval=False)
+    clamped_finite = bool(np.isfinite(b.predict(X)).all()) \
+        and b.num_trees() == 6
+    # clamp must also leave the model text loadable + structurally valid
+    import lightgbmv1_tpu as lgb2
+
+    reloaded = lgb2.Booster(model_str=b.model_to_string())
+    reload_ok = reloaded.num_trees() == 6
+    ok = detected and clamped_finite and reload_ok
+    return {"ok": ok, "detected_at_boundary": detected,
+            "clamp_survived": clamped_finite, "reload_ok": reload_ok}
+
+
+def scenario_publish_of_garbage() -> dict:
+    """A corrupt model (NaN leaves) and a publish dying mid-warm: the
+    active version must keep serving bit-exact answers throughout — the
+    corrupt candidate never serves a single response."""
+    import lightgbmv1_tpu as lgb
+    from lightgbmv1_tpu.serve import PublishValidationError, Server
+    from lightgbmv1_tpu.utils import faults
+    from lightgbmv1_tpu.utils.faults import FaultInjected, FaultSpec
+
+    b1, b2, X = _tiny_boosters()
+    srv = Server(b1, config=_serve_cfg())
+    try:
+        want = _host_raw(b1, X[:16])
+        corrupt = lgb.Booster(model_str=b2.model_to_string())
+        corrupt._loaded.trees[1].leaf_value[:] = np.nan
+        rejected = False
+        try:
+            srv.publish(corrupt)
+        except PublishValidationError:
+            rejected = True
+        midwarm_failed = False
+        with faults.inject(FaultSpec("publish_warm", mode="raise", at=2)):
+            try:
+                srv.publish(b2)
+            except FaultInjected:
+                midwarm_failed = True
+        still_v1 = srv.version() == "v1"
+        r = srv.submit(X[:16])
+        served_exact = (r.version == "v1"
+                        and np.array_equal(r.values[:, 0], want))
+        clean_tag = srv.publish(b2)       # recovery: a clean publish works
+        r2 = srv.submit(X[:16])
+        recovered = (r2.version == clean_tag and np.array_equal(
+            r2.values[:, 0], _host_raw(b2, X[:16])))
+        rejects = srv.metrics_snapshot()["publish_rejects"]
+        ok = (rejected and midwarm_failed and still_v1 and served_exact
+              and recovered and rejects == 2)
+        return {"ok": ok, "garbage_rejected": rejected,
+                "midwarm_failed": midwarm_failed,
+                "active_served_exact": served_exact,
+                "clean_publish_recovered": recovered,
+                "publish_rejects": rejects}
+    finally:
+        srv.close()
+
+
+def scenario_dispatcher_stall() -> dict:
+    """A wedged device batch: the watchdog fails its requests fast (the
+    503 path) instead of hanging the queue, and a DEAD dispatcher thread
+    is restarted — traffic resumes on the same version both times."""
+    from lightgbmv1_tpu.serve import DispatcherDied, DispatcherStalled, \
+        Server
+    from lightgbmv1_tpu.utils import faults
+    from lightgbmv1_tpu.utils.faults import FaultSpec
+
+    b1, _, X = _tiny_boosters()
+    srv = Server(b1, config=_serve_cfg(watchdog_ms=200.0))
+    try:
+        srv.submit(X[:4])                 # warm
+        stall_s = 1.0
+        with faults.inject(FaultSpec("dispatch", mode="stall", at=1,
+                                     stall_s=stall_s)):
+            t0 = time.monotonic()
+            stalled_fast = False
+            try:
+                srv.submit(X[:4])
+            except DispatcherStalled:
+                stalled_fast = (time.monotonic() - t0) < stall_s
+        time.sleep(stall_s + 0.2)         # let the wedged batch drain
+        r = srv.submit(X[:4])
+        post_stall = r.version == "v1"
+
+        died = False
+        with faults.inject(FaultSpec("dispatch", mode="exit_thread", at=1)):
+            try:
+                srv.submit(X[:4])
+            except (DispatcherDied, DispatcherStalled):
+                died = True
+        deadline = time.monotonic() + 3.0
+        while not srv.dispatcher_alive() and time.monotonic() < deadline:
+            time.sleep(0.05)
+        r2 = srv.submit(X[:4])
+        snap = srv.metrics_snapshot()
+        restarted = snap["dispatcher_restarts"] >= 1 and r2.version == "v1"
+        healthy = srv.health()["ok"]
+        ok = stalled_fast and post_stall and died and restarted and healthy
+        return {"ok": ok, "stalled_failed_fast": stalled_fast,
+                "post_stall_recovered": post_stall,
+                "dispatcher_died": died,
+                "watchdog_restarted": restarted, "healthy_after": healthy,
+                "watchdog_failures": snap["watchdog_failures"]}
+    finally:
+        srv.close()
+
+
+def scenario_overload() -> dict:
+    """A burst far above capacity into a small admission queue: explicit
+    sheds, backlog bounded at the configured depth, zero hangs, and
+    post-burst requests succeed."""
+    from lightgbmv1_tpu.serve import Server, ServerOverloaded
+
+    b1, _, X = _tiny_boosters()
+    depth = 64
+    srv = Server(b1, config=_serve_cfg(
+        max_batch_rows=32, queue_depth_rows=depth,
+        max_batch_delay_ms=20.0, watchdog_ms=0.0))
+    try:
+        srv.submit(X[:4])
+        results = {"ok": 0, "shed": 0, "other": 0}
+        lock = threading.Lock()
+
+        def client(i):
+            try:
+                srv.submit(X[(i * 16) % 512: (i * 16) % 512 + 16])
+                key = "ok"
+            except ServerOverloaded:
+                key = "shed"
+            except Exception:  # noqa: BLE001 — anything else is a failure
+                key = "other"
+            with lock:
+                results[key] += 1
+
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(32)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30.0)
+        hung = any(t.is_alive() for t in threads)
+        snap = srv.metrics_snapshot()
+        bounded = snap["queue_depth_max"] <= depth
+        r = srv.submit(X[:4])             # post-burst service
+        ok = (not hung and results["shed"] > 0 and results["other"] == 0
+              and bounded and r.version == "v1"
+              and results["ok"] + results["shed"] == 32)
+        return {"ok": ok, "served": results["ok"], "shed": results["shed"],
+                "failed": results["other"], "hung": hung,
+                "queue_depth_max": snap["queue_depth_max"],
+                "queue_bounded": bounded}
+    finally:
+        srv.close()
+
+
+def scenario_h2d_transient() -> dict:
+    """A transient host->device transfer failure inside the device batch
+    is retried with backoff: the client sees a normal answer, never an
+    error."""
+    from lightgbmv1_tpu.serve import Server
+    from lightgbmv1_tpu.utils import faults
+    from lightgbmv1_tpu.utils.faults import FaultSpec
+
+    b1, _, X = _tiny_boosters()
+    srv = Server(b1, config=_serve_cfg())
+    try:
+        srv.submit(X[:4])
+        want = _host_raw(b1, X[:8])
+        with faults.inject(FaultSpec("h2d", mode="raise", at=1)):
+            r = srv.submit(X[:8])
+        snap = srv.metrics_snapshot()
+        exact = np.array_equal(r.values[:, 0], want)
+        ok = exact and snap["retries"] >= 1 and snap["errors"] == 0
+        return {"ok": ok, "answer_exact": exact,
+                "retries": snap["retries"], "errors": snap["errors"]}
+    finally:
+        srv.close()
+
+
+# ---------------------------------------------------------------------------
+# suite
+# ---------------------------------------------------------------------------
+
+
+def run_suite(fast: bool = False) -> dict:
+    """Run the scenarios; ``fast=True`` swaps the subprocess kill for the
+    in-process crash (the tier-1/bench subset — same recovery paths, no
+    child-interpreter cost).  Returns the CHAOS record."""
+    scenarios = {}
+
+    def run(name, fn, *a, **kw):
+        t0 = time.time()
+        try:
+            out = fn(*a, **kw)
+        except Exception as e:  # noqa: BLE001 — a crashed scenario FAILS
+            out = {"ok": False,
+                   "error": f"{type(e).__name__}: {e}"[:200]}
+        out["seconds"] = round(time.time() - t0, 2)
+        scenarios[name] = out
+
+    tmp = tempfile.mkdtemp(prefix="lgbm_chaos_")
+    try:
+        for sub in ("kill", "torn"):
+            os.makedirs(os.path.join(tmp, sub), exist_ok=True)
+        run("train_kill_resume", scenario_train_kill_resume,
+            os.path.join(tmp, "kill"), not fast)
+        run("torn_snapshot", scenario_torn_snapshot,
+            os.path.join(tmp, "torn"))
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    run("poisoned_gradients", scenario_poisoned_gradients)
+    run("publish_of_garbage", scenario_publish_of_garbage)
+    run("dispatcher_stall", scenario_dispatcher_stall)
+    run("overload", scenario_overload)
+    run("h2d_transient", scenario_h2d_transient)
+
+    record = {
+        "metric": "chaos suite (scripted fault injection, CPU)",
+        "n_scenarios": len(scenarios),
+        "scenarios": scenarios,
+        "chaos_ok": all(s.get("ok") for s in scenarios.values()),
+        "fast": bool(fast),
+    }
+    return record
+
+
+def main(argv) -> int:
+    fast = "--fast" in argv
+    record = run_suite(fast=fast)
+    print("CHAOS " + json.dumps(record))
+    return 0 if record["chaos_ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

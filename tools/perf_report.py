@@ -228,6 +228,59 @@ def serving_section(w, rec):
         w("")
 
 
+def robustness_section(w, rec):
+    """Robustness: the scripted chaos-suite record (PR 6 — bench.py
+    measure_chaos via tools/chaos.py).  Each row is one injected-fault
+    scenario and whether its recovery path held; ``chaos_ok`` is the
+    all-scenarios guard.  Renders a placeholder until the first capture
+    that carries the fields."""
+    w("## Robustness (scripted fault injection, tools/chaos.py)")
+    w("")
+    if rec.get("chaos_ok") is None:
+        w("No chaos fields in this record yet — the next driver capture "
+          "runs bench.py's measure_chaos (the fast deterministic subset "
+          "of tools/chaos.py: kill-and-resume with bit-identical model "
+          "text, torn-checkpoint fallback, NaN-poisoned gradients, "
+          "publish-of-garbage, dispatcher stall/death, bounded-queue "
+          "overload, transient-H2D retry) and this section renders the "
+          "per-scenario table and the `chaos_ok` guard.")
+        w("")
+        return
+    scenarios = rec.get("chaos_scenarios") or {}
+    w(f"{get(rec, 'chaos_n_scenarios', 0)} scripted fault scenarios"
+      + (f" in {get(rec, 'chaos_seconds', 1)} s"
+         if rec.get("chaos_seconds") is not None else "") + ":")
+    w("")
+    w("| scenario | recovered |")
+    w("|---|---|")
+    labels = {
+        "train_kill_resume": "kill mid-training -> checkpoint auto-resume "
+                             "(bit-identical model text)",
+        "torn_snapshot": "torn newest checkpoint -> validated fallback to "
+                         "previous intact bundle",
+        "poisoned_gradients": "NaN-poisoned gradient pass -> finite_guard "
+                              "detect (raise) + survive (clamp)",
+        "publish_of_garbage": "corrupt model publish -> rejected pre-swap, "
+                              "never serves an answer",
+        "dispatcher_stall": "stalled/dead dispatcher -> watchdog 503 + "
+                            "thread restart",
+        "overload": "burst over capacity -> explicit shed, bounded queue",
+        "h2d_transient": "transient H2D failure -> bounded "
+                         "retry-with-backoff, zero client errors",
+    }
+    for name, ok in scenarios.items():
+        w(f"| {labels.get(name, name)} | {ok} |")
+    w("")
+    w(f"Guard `chaos_ok={rec.get('chaos_ok')}`: EVERY injected fault "
+      "recovered (bench.py runs the suite on every backend; "
+      "__graft_entry__.chaos_smoke hard-asserts it each driver "
+      "capture).  Knobs: `finite_guard=off|warn|raise|clamp` on the "
+      "gradient pass; `serve_retry_max`/`serve_breaker_failures`/"
+      "`serve_watchdog_ms`/`serve_probe_rows` on the serving failure "
+      "domains (BASELINE.md).")
+    w("")
+
+
 def fmt(v, nd=2):
     if v is None:
         return "—"
@@ -416,6 +469,8 @@ def generate(rec, name, prev=None, prev_name=None):
     prediction_section(w, rec)
 
     serving_section(w, rec)
+
+    robustness_section(w, rec)
 
     mc_name, mc = load_multichip()
     comm_section(w, mc_name, mc)
