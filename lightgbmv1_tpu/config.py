@@ -336,6 +336,10 @@ class Config:
     # counter-based PRNG keyed per (iteration, round), bit-reproducible
     # given `seed`.  Plain "int8" (round-to-nearest) was measured and
     # rejected at -0.007 AUC@500 (PERF.md round 5).
+    # "auto" (ROADMAP item 3a): backend-resolved policy — int8sr on TPU
+    # backends (the int8 MXU path the mode targets; the flip is gated on
+    # bench.py's precision_expt AUC-parity record), full bf16x2
+    # everywhere else.  Opt out by setting any explicit dtype.
     hist_dtype_deep: str = ""
     # fused per-round bookkeeping in the wave grower: the frontier /
     # tree-assembly state lives in two packed tables written with ONE
@@ -346,6 +350,26 @@ class Config:
     # are bit-identical either way on the exact-fp32 scatter path
     # (tests/test_phase_attrib.py pins this).
     fused_bookkeeping: bool = True
+    # software-pipelined wave rounds (models/grower_wave.py): the per-leaf
+    # histogram-state scatter and the valid-row routing of round r are
+    # deferred into a pending carry and issued inside round r+1 — off its
+    # critical path (top-k -> partition -> histogram -> split scan), so
+    # the scheduler overlaps them with the next round's MXU pass instead
+    # of serializing at the while-loop body barrier.  Parent-histogram
+    # reads are value-forwarded, and a post-loop drain applies the final
+    # round's routing, so trees / leaf ids / valid routings are
+    # bit-identical to the sequential schedule (false = the legacy
+    # fully-serialized round body, kept as the bit-parity pin;
+    # tests/test_wave_pipeline.py).
+    async_wave_pipeline: bool = True
+    # donate the score caches (train + valid) into the fused per-iteration
+    # step (jax donate_argnums): the iteration's score update runs in
+    # place instead of allocating a second (N, K) buffer per cache —
+    # halves steady-state score HBM footprint and removes the defensive
+    # copy at the dispatch boundary.  Rollback/finite-guard snapshots keep
+    # explicit copies when armed (models/gbdt.py _save_rollback_state).
+    # No-op on the CPU backend (XLA:CPU ignores donation).
+    donate_buffers: bool = True
     # Cross-chip collective of the row-sharded (data/voting) learners:
     # "reduce_scatter" (default) maps the reference's ReduceScatter of
     # histogram blocks faithfully — each device reduces and KEEPS only its
@@ -601,10 +625,11 @@ class Config:
                              "walk and its score executable share a "
                              "bucket)")
         if self.hist_dtype_deep not in (
-                "", "f32", "bf16", "bf16x2", "int8", "int8sr"):
+                "", "auto", "f32", "bf16", "bf16x2", "int8", "int8sr"):
             raise ValueError(
                 f"hist_dtype_deep={self.hist_dtype_deep!r}: expected one of "
-                "f32 | bf16 | bf16x2 | int8 | int8sr (or empty for auto)")
+                "auto | f32 | bf16 | bf16x2 | int8 | int8sr (or empty for "
+                "the legacy bf16-drop policy)")
         if self.gpu_use_dp and not self.hist_dtype_deep:
             # the double-precision request covers deep wave rounds too —
             # but an EXPLICIT hist_dtype_deep wins (the trainer documents

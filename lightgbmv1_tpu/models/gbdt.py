@@ -244,6 +244,14 @@ class GBDT:
 
         self._poison_iter = _faults.grad_poison_iteration()
         self._finite_warned = False
+        # score-cache buffer donation through the fused step
+        # (donate_argnums): the iteration's score update runs in place —
+        # no second (N, K) buffer per cache, no defensive copy at the
+        # dispatch boundary.  XLA:CPU ignores donation (and warns), so
+        # the knob arms only off-CPU; tests probe the lowered HLO's
+        # aliasing directly (tests/test_wave_pipeline.py).
+        self._donate = bool(config.donate_buffers) and \
+            jax.default_backend() != "cpu"
 
     # ------------------------------------------------------------------
     @property
@@ -394,7 +402,10 @@ class GBDT:
                     cegb_used)
 
         self._step_fn = step
-        return jax.jit(step)
+        # args 2/3 are the train/valid score caches — the buffers the
+        # fused step updates in place under donation
+        return jax.jit(step,
+                       donate_argnums=(2, 3) if self._donate else ())
 
     def _objective_grads(self, s, iteration=None):
         if getattr(self.objective, "is_stochastic", False):
@@ -504,7 +515,8 @@ class GBDT:
                 )
                 return ts, vs, trees, cu
 
-            self._scan = jax.jit(scan_fn)
+            self._scan = jax.jit(
+                scan_fn, donate_argnums=(2, 3) if self._donate else ())
 
         K = self.num_class
         feat_masks = jnp.asarray(np.stack([
@@ -883,11 +895,16 @@ class GBDT:
 
     # ------------------------------------------------------------------
     def _save_rollback_state(self):
-        self._prev_state = (
-            self._train_scores.score,
-            [vs.score for vs in self._valid_scores],
-            len(self.models),
-        )
+        score = self._train_scores.score
+        valid = [vs.score for vs in self._valid_scores]
+        if self._donate:
+            # the fused step donates these buffers (in-place update); the
+            # rollback / finite-guard snapshot must survive the donation,
+            # so it keeps explicit copies — one (N, K) device copy per
+            # cache per iteration, noise next to the histogram pass
+            score = jnp.copy(score)
+            valid = [jnp.copy(v) for v in valid]
+        self._prev_state = (score, valid, len(self.models))
 
     def rollback_one_iter(self):
         """reference: GBDT::RollbackOneIter gbdt.cpp:421-437."""
@@ -1382,7 +1399,9 @@ class DART(GBDT):
             return (new_train, tuple(new_valids), stacked, leaf_ids,
                     cegb_used)
 
-        return jax.jit(full)
+        # same donation contract as the plain fused step: args 2/3 are the
+        # score caches, updated in place (rollback snapshots keep copies)
+        return jax.jit(full, donate_argnums=(2, 3) if self._donate else ())
 
     def _dart_step_for(self, P: int, use_lids: bool):
         key = (P, use_lids)

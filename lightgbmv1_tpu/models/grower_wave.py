@@ -57,6 +57,26 @@ takes a separate dequantize round-trip.  Rounding is keyed per
 (iteration, round) by folding the tree key with the round's leaf count,
 so grown trees are bit-reproducible given the seed.
 
+Async wave pipelining (round 12): the sequential round body ends with
+commits the NEXT round only partially depends on — the per-leaf
+histogram-state scatter and the valid-row routing — yet the
+``lax.while_loop`` body boundary is a barrier, so they serialize against
+the next round's critical path (top-k → partition decision → histogram
+MXU pass → split scan) anyway.  With ``async_wave_pipeline`` (default)
+those commits are DEFERRED one round through a pending carry: round r's
+child-histogram stack + scatter indices + split metadata ride the carry,
+and round r+1 issues the scatter and the valid routing inside ITS
+computation, where the scheduler can overlap them with the MXU pass.
+The subtraction's parent reads are value-forwarded (gather from the
+one-round-stale table, patched from the pending stack — identical
+values, no data dependence on the drained scatter), which also lets the
+subtracted sibling's split scan start before the partition's leaf-id
+reduction drains.  A post-loop drain applies the final round's routing,
+so everything a caller (or a checkpoint) can observe is bit-identical
+to the sequential schedule — pinned across binary/multiclass/DART in
+tests/test_wave_pipeline.py; ``async_wave_pipeline=false`` keeps the
+fully-serialized body as the pin.
+
 Round bookkeeping (round 6): the per-leaf frontier state and the tree
 arrays under construction live behind a store codec.  The default
 ``_PackedStore`` keeps them in two packed f32 tables committed with one
@@ -250,10 +270,19 @@ class WaveState(NamedTuple):
                               # unless interaction constraints are on
     num_leaves: jax.Array     # () int32
     done: jax.Array           # () bool
+    pending: dict = {}        # async_wave_pipeline: the previous round's
+                              # DEFERRED commits — the (2K, F, B, 3) child
+                              # histograms + their scatter indices and the
+                              # (K,) split metadata for the valid-row
+                              # routing — applied at the START of the next
+                              # body (or by the post-loop drain), where the
+                              # scheduler can overlap them with that
+                              # round's partition + histogram pass; {} on
+                              # the sequential path
 
 
 def subtract_child_hists(h_slot, leaf_hist, leafs, order_c, sm_left,
-                         slot_scale=None):
+                         slot_scale=None, h_parent=None):
     """Smaller-child + parent-subtraction child histograms of one wave
     round (reference BeforeFindBestSplit smaller-leaf trick +
     FeatureHistogram::Subtract): ``h_slot`` holds the measured smaller
@@ -268,11 +297,17 @@ def subtract_child_hists(h_slot, leaf_hist, leafs, order_c, sm_left,
     integer counts and the per-slot dequantization is folded HERE — one
     broadcast multiply fused into the gather/subtract pipeline the round
     already pays, so the kernel never writes a dequantized copy and the
-    quantized histogram is read from HBM exactly once."""
+    quantized histogram is read from HBM exactly once.
+
+    ``h_parent`` (K, F, B, 3): pre-gathered parent histograms — the
+    pipelined schedule passes the value-forwarded rows (one-round-stale
+    table patched from the pending commit) so the subtraction never waits
+    on the deferred scatter; None gathers from ``leaf_hist`` as before."""
     h_small = h_slot[order_c]              # slot-order -> rank-order
     if slot_scale is not None:
         h_small = h_small * slot_scale[order_c][:, None, None, :]
-    h_parent = leaf_hist[leafs]
+    if h_parent is None:
+        h_parent = leaf_hist[leafs]
     smL = sm_left[:, None, None, None]
     h_left = jnp.where(smL, h_small, h_parent - h_small)
     h_right = h_parent - h_left
@@ -634,6 +669,7 @@ def make_wave_grower(
     interaction_groups=None,
     wave_size: int = 32,
     fused_bookkeeping: bool = True,
+    async_wave_pipeline: bool = True,
     hist_wave_fn: Callable = None,
     hist_wave_quant_fn: Callable = None,
     split_fn: Callable = None,
@@ -672,6 +708,18 @@ def make_wave_grower(
     tables with one coalesced scatter each (_PackedStore, default) or the
     legacy per-field scatters (_FieldStore); trees are bit-identical
     either way on the exact-fp32 histogram path.
+    ``async_wave_pipeline`` (default on) software-pipelines the round
+    loop: the per-leaf histogram-state scatter and the valid-row routing
+    of round r are DEFERRED into a pending carry and applied at the
+    start of round r+1 — off round r+1's critical path (top-k →
+    partition decision → histogram MXU pass → split scan), so the
+    scheduler can overlap them with it instead of serializing at the
+    while-loop body barrier.  The subtraction's parent-histogram read is
+    value-forwarded (one-round-stale table patched from the pending
+    commit), and a post-loop drain applies the final round's routing, so
+    grown trees, leaf ids and valid routings are bit-identical to the
+    sequential schedule (tests/test_wave_pipeline.py pins this; the
+    sequential path is the pin, config ``async_wave_pipeline=false``).
     """
     L = num_leaves
     L1 = max(L - 1, 1)
@@ -766,6 +814,12 @@ def make_wave_grower(
         # the larger child from the per-leaf histogram state.  Skipped
         # when that state would exceed 512 MB (wide-F configs).
         use_sub = (L * int(np.prod(hist0.shape)) * 4) <= _SUB_STATE_CAP_BYTES
+        # async wave pipelining: active whenever there is deferred work to
+        # overlap — the per-leaf histogram-state scatter (use_sub) and/or
+        # the valid-row routing.  With neither, the sequential body IS the
+        # pipelined one (nothing to defer), so the pending carry is
+        # skipped entirely and the paths are the same trace.
+        pipeline = async_wave_pipeline and (use_sub or bool(valids))
         root_sum = sums_fn(g3)
         mask0 = _node_feature_mask(key, 0, base_mask, feature_fraction_bynode)
         mask0 = mask0 & allowed_features(jnp.zeros(F, bool))
@@ -788,6 +842,55 @@ def make_wave_grower(
         cconstr_const = (None if use_mc
                          else jnp.tile(no_constr, (2 * K, 1)))
 
+        # pipelined schedule: the pending no-op of round -1 — every index
+        # is a drop slot and every routing slot is dead (leaf id L matches
+        # no row), so the first body's drain is a bit-exact no-op
+        pend0 = {}
+        if pipeline:
+            pend0 = dict(
+                cidx=jnp.full(2 * K, L + 1, jnp.int32),
+                feats=jnp.zeros(K, jnp.int32),
+                thrs=jnp.zeros(K, jnp.int32),
+                dls=jnp.zeros(K, bool),
+                leafs=jnp.full(K, L, jnp.int32),
+                nls=jnp.zeros(K, jnp.int32),
+            )
+            if use_sub:
+                pend0["hist"] = jnp.zeros((2 * K,) + hist0.shape,
+                                          jnp.float32)
+            if use_cat:
+                pend0["iscats"] = jnp.zeros(K, bool)
+                pend0["bitsets"] = jnp.zeros((K, W), jnp.uint32)
+
+        def route_pending(p, vb, vl):
+            """Apply one pending round's split decisions to a valid set's
+            leaf ids — the DEFERRED analog of the in-round ``go_left_s``
+            valid routing, evaluated over the rank-order (K,) split
+            metadata (dead slots carry leaf id L and match no row).  The
+            per-row update terms are int32 — exact and summation-order
+            free — so deferral is bit-identical to in-round routing."""
+            feats_k, thrs_k, dls_k = p["feats"], p["thrs"], p["dls"]
+            leafs_k, nls_k = p["leafs"], p["nls"]
+            mt_k = meta.missing_type[feats_k][:, None]
+            bk = jax.vmap(lambda f: bins_of_fn(vb, f))(feats_k)
+            bk = bk.astype(jnp.int32)
+            na = ((mt_k == MISSING_NAN)
+                  & (bk == meta.nan_bin[feats_k][:, None])) | (
+                (mt_k == MISSING_ZERO)
+                & (bk == meta.zero_bin[feats_k][:, None]))
+            g = jnp.where(na, dls_k[:, None], bk <= thrs_k[:, None])
+            if use_cat:
+                word = jnp.zeros(bk.shape, jnp.uint32)
+                for wv in range(W):
+                    word = jnp.where((bk >> 5) == wv,
+                                     p["bitsets"][:, wv][:, None], word)
+                in_set = ((word >> (bk.astype(jnp.uint32) & 31)) & 1) == 1
+                g = jnp.where(p["iscats"][:, None], in_set, g)
+            mine = vl[None, :] == leafs_k[:, None]
+            go_rv = mine & (~g)
+            return vl + jnp.sum(
+                jnp.where(go_rv, nls_k[:, None] - vl[None, :], 0), axis=0)
+
         st = WaveState(
             leaf_id=leaf_id0,
             valid_lids=tuple(jnp.zeros(v.shape[1], jnp.int32)
@@ -804,6 +907,7 @@ def make_wave_grower(
                        else jnp.zeros((1, 1), bool)),
             num_leaves=jnp.asarray(1, jnp.int32),
             done=jnp.asarray(L <= 1),
+            pending=pend0,
         )
 
         kiota = jnp.arange(K, dtype=jnp.int32)
@@ -820,6 +924,27 @@ def make_wave_grower(
                 (jnp.max(store.gains(st.store)) > 0)
 
         def body(st: WaveState) -> WaveState:
+            # ---- pipelined drain of the PREVIOUS round's deferred work ----
+            # The leaf-histogram scatter and the valid-row routing of round
+            # r-1 are issued HERE, inside round r's computation: both are
+            # data-independent of this round's critical path (top-k →
+            # partition decision → histogram MXU pass → split scan), so the
+            # scheduler can overlap them with it — at the tail of body r-1
+            # the while-loop barrier would have serialized them instead.
+            # The subtraction below never waits on the drained scatter: its
+            # parent rows are value-forwarded from the pending commit.
+            if pipeline:
+                p_hist = st.pending.get("hist")
+                leaf_hist_in = (st.leaf_hist.at[st.pending["cidx"]]
+                                .set(p_hist, mode="drop")
+                                if use_sub else st.leaf_hist)
+                vlids_in = tuple(
+                    route_pending(st.pending, vb, vl)
+                    for vb, vl in zip(valids, st.valid_lids))
+            else:
+                leaf_hist_in = st.leaf_hist
+                vlids_in = st.valid_lids
+
             budget = L - st.num_leaves
             vals, leafs = _topk_by_rank(store.gains(st.store), K)  # (K,)
             valid = (vals > 0) & (kiota < budget)
@@ -920,13 +1045,18 @@ def make_wave_grower(
                         jnp.where(go_r, nls_s[:, None] - st.leaf_id[None, :],
                                   0), axis=0)
                     vl_new = []
-                    for vb, vl in zip(valids, st.valid_lids):
-                        gv = go_left_s(vb)
-                        mine_v = vl[None, :] == leafs_s[:, None]
-                        go_rv = mine_v & (~gv)
-                        vl_new.append(vl + jnp.sum(
-                            jnp.where(go_rv, nls_s[:, None] - vl[None, :], 0),
-                            axis=0))
+                    if not pipeline:
+                        # pipelined rounds defer valid routing to the next
+                        # body's drain (route_pending) — off this round's
+                        # critical path, bit-identical updates
+                        for vb, vl in zip(valids, st.valid_lids):
+                            gv = go_left_s(vb)
+                            mine_v = vl[None, :] == leafs_s[:, None]
+                            go_rv = mine_v & (~gv)
+                            vl_new.append(vl + jnp.sum(
+                                jnp.where(go_rv,
+                                          nls_s[:, None] - vl[None, :], 0),
+                                axis=0))
                     if use_sub:
                         # label only the SMALLER child of each split (known
                         # up front from the recorded left/right counts)
@@ -975,7 +1105,7 @@ def make_wave_grower(
             else:
                 outs = round_pass(slot_buckets[0])
             h_slot, hscale, leaf_id = outs[0], outs[1], outs[2]
-            new_vlids = tuple(outs[3:])
+            new_vlids = vlids_in if pipeline else tuple(outs[3:])
 
             cscale = None                   # per-child dequant (quant rounds)
             if use_sub:
@@ -983,9 +1113,24 @@ def make_wave_grower(
                 # quant rounds fold the per-slot dequantization into the
                 # subtraction pass (slot_scale); non-quant rounds carry
                 # all-ones scales and skip the multiply entirely
+                h_parent = None
+                if pipeline:
+                    # value forwarding: gather the parents from the ONE-
+                    # ROUND-STALE table and patch rows whose slot was
+                    # (over)written by the pending commit — identical
+                    # values to a post-scatter gather, but the subtracted
+                    # sibling's split scan starts without waiting for the
+                    # drained scatter (or the partition) to complete
+                    h_parent = st.leaf_hist[leafs]
+                    match = leafs[:, None] == st.pending["cidx"][None, :]
+                    hit = jnp.any(match, axis=1)
+                    src = jnp.argmax(match, axis=1)
+                    h_parent = jnp.where(hit[:, None, None, None],
+                                         p_hist[src], h_parent)
                 hist, h_left, h_right = subtract_child_hists(
-                    h_slot, st.leaf_hist, leafs, order_c, sm_left,
-                    slot_scale=hscale if quant_buckets else None)
+                    h_slot, leaf_hist_in, leafs, order_c, sm_left,
+                    slot_scale=hscale if quant_buckets else None,
+                    h_parent=h_parent)
             else:
                 ch_idx = jnp.stack([2 * order_c, 2 * order_c + 1],
                                    axis=1).reshape(2 * K)
@@ -1144,7 +1289,22 @@ def make_wave_grower(
                 num_leaves_new=st.num_leaves + n_split,
             ))
 
-            if use_sub:
+            if pipeline:
+                # this round's commits become the NEXT round's pending:
+                # the (already drained-in) table rides forward unchanged
+                # and the scatter + valid routing defer one round
+                leaf_hist = leaf_hist_in
+                new_pending = dict(
+                    cidx=cidx,
+                    feats=feats, thrs=thrs, dls=dls,
+                    leafs=jnp.where(valid, leafs, L), nls=nls,
+                )
+                if use_sub:
+                    new_pending["hist"] = hist
+                if use_cat:
+                    new_pending["iscats"] = iscats
+                    new_pending["bitsets"] = bitsets
+            elif use_sub:
                 # packed: ONE interleaved scatter at cidx (hist is already
                 # the rank-interleaved (2K, ...) child stack); legacy: the
                 # historical two half-scatters
@@ -1153,8 +1313,10 @@ def make_wave_grower(
                     if store.fused else
                     st.leaf_hist.at[lidx].set(h_left, mode="drop")
                     .at[nlidx].set(h_right, mode="drop"))
+                new_pending = st.pending
             else:
                 leaf_hist = st.leaf_hist
+                new_pending = st.pending
 
             return WaveState(
                 leaf_id=leaf_id,
@@ -1168,13 +1330,24 @@ def make_wave_grower(
                            if use_groups else st.leaf_used),
                 num_leaves=st.num_leaves + n_split,
                 done=st.done | (n_split == 0),
+                pending=new_pending,
             )
 
         if L > 1:
             st = lax.while_loop(cond, body, st)
         tree = store.finalize(st.store, st.num_leaves)
+        vlids_out = st.valid_lids
+        if pipeline and valids:
+            # drain: the final round's valid routing is still pending when
+            # the loop exits (the histogram-state scatter is dead — the
+            # table is intra-growth state).  After this the returned
+            # routing is exactly the sequential schedule's, so checkpoint
+            # and snapshot boundaries see fully-applied state and PR 6's
+            # kill-at-k bit-exact resume guarantee is unchanged.
+            vlids_out = tuple(route_pending(st.pending, vb, vl)
+                              for vb, vl in zip(valids, vlids_out))
         if valids:
-            return tree, st.leaf_id, root_sum, st.valid_lids
+            return tree, st.leaf_id, root_sum, vlids_out
         return tree, st.leaf_id, root_sum
 
     grow._supports_valids = True

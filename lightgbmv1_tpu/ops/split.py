@@ -421,33 +421,31 @@ def find_best_split(
                                 hist_scale)
 
 
-def _find_best_split(
-    hist, parent_sum, meta, feature_mask, params, constraint=None, depth=0,
-    monotone_penalty=0.0, parent_output=0.0, rand_key=None, cegb_penalty=None,
-    hist_scale=None,
-) -> SplitResult:
+def scan_left_sums(hist, meta, hist_scale=None):
+    """Phase 1 of the fused split scan: ONE cumulative-sum pass over the
+    bin axis plus the missing-mass adjustments, both scan directions
+    stacked into a single ``(2, F, B, 3)`` tensor (direction 0 =
+    missing/default right, direction 1 = missing joins the left side).
+
+    Dequantize-aware (stochastic-rounded int8 histograms,
+    ops/quantize.py): ``hist`` holds exact integer counts and
+    ``hist_scale`` the per-channel dequant multipliers.  The cumsum runs
+    in the INTEGER domain — exact, no f32 summation-order noise — and
+    ONE broadcast multiply dequantizes the prefix sums; the same scale
+    lands on the nan/zero missing-mass rows below.  The histogram is
+    consumed straight from HBM in quantized form: no separate
+    dequantization pass ever writes a real-valued copy back.
+
+    Returns ``(left2, hist)`` where ``hist`` is the (dequantized) input
+    for the point reads the categorical search and the missing-direction
+    bookkeeping still need.  Module-level so tools/phase_attrib.py can
+    time exactly this sub-phase of the scan the grower runs."""
     F, B, _ = hist.shape
-    total_g, total_h, total_c = parent_sum[0], parent_sum[1], parent_sum[2]
-
-    use_mc = bool(np.asarray(meta.monotone_type).any())
-    use_smooth = params.path_smooth > 0
-    if constraint is None:
-        constraint = jnp.asarray(NO_CONSTRAINT, jnp.float32)
-
-    # Dequantize-aware scan (stochastic-rounded int8 histograms,
-    # ops/quantize.py): ``hist`` holds exact integer counts and
-    # ``hist_scale`` the per-channel dequant multipliers.  The cumsum runs
-    # in the INTEGER domain — exact, no f32 summation-order noise — and
-    # ONE broadcast multiply dequantizes the prefix sums; the same scale
-    # lands on the nan/zero missing-mass rows below.  The histogram is
-    # consumed straight from HBM in quantized form: no separate
-    # dequantization pass ever writes a real-valued copy back.
     cum = jnp.cumsum(hist, axis=1)                    # (F, B, 3) inclusive
     if hist_scale is not None:
         cum = cum * hist_scale[None, None, :]
         hist = hist * hist_scale[None, None, :]       # point reads below
     t_idx = lax.broadcasted_iota(jnp.int32, (F, B), 1)
-    nb = meta.num_bins[:, None]                       # (F, 1)
 
     nan_contrib = jnp.take_along_axis(
         hist,
@@ -456,7 +454,6 @@ def _find_best_split(
     )[:, 0, :]                                        # (F, 3)
     is_nan_f = (meta.missing_type == MISSING_NAN)[:, None]     # (F, 1)
     is_zero_f = (meta.missing_type == MISSING_ZERO)[:, None]   # (F, 1)
-    has_miss_dir = is_nan_f | is_zero_f
 
     # MISSING_ZERO: the reference's two scans SKIP the default (zero) bin
     # while accumulating (FindBestThresholdSequentially SKIP_DEFAULT_BIN,
@@ -477,6 +474,34 @@ def _find_best_split(
         is_nan_f[..., None], nan_contrib[:, None, :],
         jnp.where((is_zero_f & (t_idx < zb))[..., None],
                   zero_contrib[:, None, :], 0.0))
+    return jnp.stack([left_a, left_b]), hist          # (2, F, B, 3)
+
+
+def scan_direction_gains(left2, parent_sum, meta, feature_mask, params,
+                         constraint=None, depth=0, monotone_penalty=0.0,
+                         parent_output=0.0, rand_key=None,
+                         cegb_penalty=None):
+    """Phase 2 of the fused split scan: gains of every (direction,
+    feature, bin) candidate in ONE stacked evaluation over the
+    ``(2, F, B, 3)`` left sums from :func:`scan_left_sums` — the gain
+    math (leaf_gain / smoothing / monotone clamps) is traced once on the
+    doubled tensor instead of once per direction, so the whole
+    cumsum → gain chain lowers as a single fused pass.
+
+    Returns ``(gains (2, F, B), shift)`` with gains RELATIVE (shift =
+    parent gain + min_gain_to_split already subtracted) and every
+    penalty applied.  Module-level for tools/phase_attrib.py."""
+    _, F, B, _ = left2.shape
+    total_g, total_h, total_c = parent_sum[0], parent_sum[1], parent_sum[2]
+    use_mc = bool(np.asarray(meta.monotone_type).any())
+    use_smooth = params.path_smooth > 0
+    if constraint is None:
+        constraint = jnp.asarray(NO_CONSTRAINT, jnp.float32)
+    t_idx = lax.broadcasted_iota(jnp.int32, (F, B), 1)
+    nb = meta.num_bins[:, None]                       # (F, 1)
+    is_nan_f = (meta.missing_type == MISSING_NAN)[:, None]     # (F, 1)
+    is_zero_f = (meta.missing_type == MISSING_ZERO)[:, None]   # (F, 1)
+    has_miss_dir = is_nan_f | is_zero_f
 
     def eval_direction(left):
         lg, lh, lc = left[..., 0], left[..., 1], left[..., 2]
@@ -521,10 +546,10 @@ def _find_best_split(
         u = jax.random.uniform(rand_key, (F,))
         rand_bin = (u * jnp.maximum(meta.num_bins - 1, 1)).astype(jnp.int32)
         base_valid = base_valid & (t_idx == rand_bin[:, None])
-    gain_a = jnp.where(base_valid, eval_direction(left_a), NEG_INF)
-    gain_b = jnp.where(
-        base_valid & has_miss_dir, eval_direction(left_b), NEG_INF
-    )
+    # both directions masked and evaluated in one shot: direction 1 only
+    # exists for features with a missing direction
+    valid2 = jnp.stack([base_valid, base_valid & has_miss_dir])
+    gains2 = jnp.where(valid2, eval_direction(left2), NEG_INF)
 
     if use_smooth:
         # reference: with smoothing the gain shift is the leaf's gain AT its
@@ -543,7 +568,7 @@ def _find_best_split(
     #      feature_histogram.hpp:94)
     #   2. CEGB DetlaGain subtract (serial_tree_learner.cpp:723-727)
     #   3. monotone depth-penalty multiply (:728-732)
-    gains = jnp.stack([gain_a, gain_b]) - shift       # (2, F, B)
+    gains = gains2 - shift                            # (2, F, B)
     finite = jnp.isfinite(gains)
     if meta.contri is not None:
         gains = jnp.where(finite, gains * meta.contri[None, :, None], gains)
@@ -553,17 +578,27 @@ def _find_best_split(
         factor = monotone_penalty_factor(jnp.asarray(depth), monotone_penalty)
         mono_f = (meta.monotone_type != 0)[None, :, None]
         gains = jnp.where(finite & mono_f, gains * factor, gains)
+    return gains, shift
 
-    # Tie-breaking (matters when gains plateau, e.g. under max_delta_step
-    # clamping).  The reference evaluates the REVERSE scan first and the
-    # forward scan replaces only on strictly greater gain
-    # (FuncForNumricalL3, feature_histogram.hpp:157-215), and each scan
-    # keeps the FIRST candidate seen (`current_gain > best_gain`,
-    # :928,1002): reverse = highest threshold, forward = lowest.  For
-    # missing-none (or 2-bin) features only the reverse scan runs, so our
-    # direction-0 candidates inherit its highest-threshold preference.
-    # Cross-feature ties pick the smaller feature (SplitInfo::operator>,
-    # split_info.hpp:147-152) — argmax first-occurrence order below.
+
+def scan_pick(gains, shift, meta):
+    """Phase 3 of the fused split scan: the tie-band preference argmax.
+
+    Tie-breaking (matters when gains plateau, e.g. under max_delta_step
+    clamping).  The reference evaluates the REVERSE scan first and the
+    forward scan replaces only on strictly greater gain
+    (FuncForNumricalL3, feature_histogram.hpp:157-215), and each scan
+    keeps the FIRST candidate seen (`current_gain > best_gain`,
+    :928,1002): reverse = highest threshold, forward = lowest.  For
+    missing-none (or 2-bin) features only the reverse scan runs, so our
+    direction-0 candidates inherit its highest-threshold preference.
+    Cross-feature ties pick the smaller feature (SplitInfo::operator>,
+    split_info.hpp:147-152) — argmax first-occurrence order below.
+
+    Returns ``(best_gain, feature, threshold, direction)``.  Module-level
+    for tools/phase_attrib.py."""
+    _, F, B = gains.shape
+    t_idx = lax.broadcasted_iota(jnp.int32, (F, B), 1)
     rev_like_a = ((meta.missing_type == MISSING_NONE)
                   | (meta.num_bins <= 2))[:, None]        # (F, 1)
     pref_a = jnp.where(rev_like_a, 2 * B + t_idx, B - 1 - t_idx)
@@ -585,9 +620,33 @@ def _find_best_split(
     best_gain = gains_f[feature, sel]
     direction = (sel // B).astype(jnp.int32)
     threshold = (sel % B).astype(jnp.int32)
+    return best_gain, feature, threshold, direction
 
-    left = jnp.where(direction == 0, left_a[feature, threshold],
-                     left_b[feature, threshold])
+
+def _find_best_split(
+    hist, parent_sum, meta, feature_mask, params, constraint=None, depth=0,
+    monotone_penalty=0.0, parent_output=0.0, rand_key=None, cegb_penalty=None,
+    hist_scale=None,
+) -> SplitResult:
+    # One fused scan pass (round-7 split-phase burn-down): cumsum +
+    # missing-mass adjust (scan_left_sums, dequantize fold included) →
+    # stacked both-direction gain evaluation (scan_direction_gains) →
+    # tie-band preference argmax (scan_pick).  The three stages are
+    # module-level so the phase-attribution harness times the exact code
+    # objects this search runs; candidate values are bit-identical to the
+    # historical per-direction evaluation (same formulas, elementwise).
+    F, B, _ = hist.shape
+    use_mc = bool(np.asarray(meta.monotone_type).any())
+    if constraint is None:
+        constraint = jnp.asarray(NO_CONSTRAINT, jnp.float32)
+
+    left2, hist = scan_left_sums(hist, meta, hist_scale)
+    gains, shift = scan_direction_gains(
+        left2, parent_sum, meta, feature_mask, params, constraint, depth,
+        monotone_penalty, parent_output, rand_key, cegb_penalty)
+    best_gain, feature, threshold, direction = scan_pick(gains, shift, meta)
+
+    left = left2[direction, feature, threshold]
 
     # categorical candidates (compiled in only when the dataset has any —
     # meta arrays are trace-time constants via the grower closure)

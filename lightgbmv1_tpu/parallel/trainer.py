@@ -260,6 +260,23 @@ def parse_forced_splits(filename: str, bin_mappers, num_leaves: int):
     return np.asarray(out, np.int64) if out else None
 
 
+def resolve_deep_dtype(requested: str, precision: str, backend: str) -> str:
+    """``hist_dtype_deep`` resolution policy, one pure function so the
+    tests can pin it per backend (tests/test_wave_pipeline.py).
+
+    ``"auto"`` (ROADMAP item 3a) resolves by backend: ``int8sr`` on TPU —
+    the int8 MXU path the mode was built for, with the default flip gated
+    on bench.py's ``precision_expt`` AUC-parity record — and full
+    ``bf16x2`` everywhere else (no int8 MXU economics off-TPU; full
+    precision is the honest default there).  Opt out by setting any
+    explicit dtype.  ``""`` keeps the legacy policy: bf16x2 drops to
+    single-pass bf16 on sustained rounds, any other explicit
+    ``hist_dtype`` is used unchanged."""
+    if requested == "auto":
+        requested = "int8sr" if backend == "tpu" else "bf16x2"
+    return requested or ("bf16" if precision == "bf16x2" else precision)
+
+
 def build_trainer(
     config: Config,
     binned_np: np.ndarray,           # (F, N) bins or (BF, N) EFB bundles
@@ -364,8 +381,8 @@ def build_trainer(
     # int8 deep was measured and REJECTED (-0.007 AUC).  Any other
     # explicit hist_dtype is respected everywhere; hist_dtype_deep
     # overrides (set hist_dtype_deep=bf16x2 to force full precision).
-    deep_precision = config.hist_dtype_deep or (
-        "bf16" if precision == "bf16x2" else precision)
+    deep_precision = resolve_deep_dtype(config.hist_dtype_deep, precision,
+                                        jax.default_backend())
     # hist_dtype_deep="int8sr": stochastic-rounded int8 histograms
     # (ops/quantize.py) — eligible wave rounds route to a separate
     # quantized pass (hist_wave_quant_fn below) instead of the plain deep
@@ -494,6 +511,7 @@ def build_trainer(
     wave_common["wave_size"] = wave_size
     wave_common["monotone_mode"] = mono_mode
     wave_common["fused_bookkeeping"] = config.fused_bookkeeping
+    wave_common["async_wave_pipeline"] = config.async_wave_pipeline
     # sequential-grower histogram pool cap (reference histogram_pool_size;
     # the wave/level growers use frontier-sized buffers and need no cap)
     lw_pool = dict(hist_pool_mb=config.histogram_pool_size, num_features=F)
@@ -994,6 +1012,7 @@ def build_trainer(
             # the wave grower implements intermediate-mode monotonicity;
             # the level-wise grower is basic-only (warned above)
             fp_kwargs["monotone_mode"] = mono_mode
+            fp_kwargs["async_wave_pipeline"] = config.async_wave_pipeline
         if levelwise:
             # feature-sharded frontier histograms + vmapped all_gather
             # argmax per leaf — the level-wise grower composes with the

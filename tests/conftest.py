@@ -22,6 +22,25 @@ jax.config.update("jax_platforms", "cpu")
 import numpy as np
 import pytest
 
+# Tier-1 wall-budget accounting (tools/tier1_budget.py): when
+# LGBMV1_T1_DURATIONS names a file, every test phase's duration is
+# appended as one JSON line, so the budget tool can project the tier-1
+# wall against the driver's 870 s budget and rank the worst offenders
+# without re-running the suite.
+_DUR_PATH = os.environ.get("LGBMV1_T1_DURATIONS")
+
+
+def pytest_runtest_logreport(report):
+    if _DUR_PATH:
+        import json
+
+        with open(_DUR_PATH, "a") as fh:
+            fh.write(json.dumps({
+                "nodeid": report.nodeid, "when": report.when,
+                "duration": round(report.duration, 4),
+                "outcome": report.outcome,
+            }) + "\n")
+
 
 @pytest.fixture
 def rng():

@@ -232,6 +232,9 @@ def test_cli_auto_resume_skips_torn_checkpoint(tmp_path):
         assert fh.read() == straight
 
 
+# tier-1 wall budget (tools/tier1_budget.py): slow-marked — still run by the full
+# suite and driver captures
+@pytest.mark.slow
 def test_cli_profile_dir_writes_trace(tmp_path):
     """profile_dir captures a jax.profiler device trace of training (the
     USE_TIMETAG analog; VERDICT r3 item 10) — the trace directory must be

@@ -130,6 +130,9 @@ def test_efb_data_parallel_parity():
     assert ((pa > 0.5) == (pb > 0.5)).mean() > 0.97
 
 
+# tier-1 wall budget (tools/tier1_budget.py): slow-marked — still run by the full
+# suite and driver captures
+@pytest.mark.slow
 def test_csr_input_no_densify():
     """Wide-sparse CSR input trains without a dense (F, N) matrix and with
     binned bytes proportional to the bundle count."""

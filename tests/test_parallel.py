@@ -194,6 +194,9 @@ def test_voting_selection_non_degenerate():
     assert root_vote in range(1, 1 + heroes)
 
 
+# tier-1 wall budget (tools/tier1_budget.py): slow-marked — still run by the full
+# suite and driver captures
+@pytest.mark.slow
 def test_feature_parallel_levelwise_matches_serial():
     """The level-wise grower composes with the feature-parallel learner
     (VERDICT r2 weak #6): feature-sharded frontier histograms + all_gather
@@ -228,7 +231,11 @@ def test_collective_knob_validated():
                           "data_parallel_collective": "ring"})
 
 
-@pytest.mark.parametrize("shards", [2, 8])
+# tier-1 wall budget: the 2-shard arm keeps the bit-identity contract in
+# tier-1; the 8-shard arm is slow-marked (the 8-device parity bar is also
+# hard-asserted by dryrun_multichip on every driver capture)
+@pytest.mark.parametrize("shards", [
+    2, pytest.param(8, marks=pytest.mark.slow)])
 def test_reduce_scatter_vs_allreduce_vs_serial_bit_identical(shards):
     """The three paths sum histograms in different orders (serial sum /
     psum / psum_scatter); the tie_tol band in the split argmax makes the
@@ -262,6 +269,9 @@ def test_reduce_scatter_feature_count_not_divisible():
         atol=1e-5)
 
 
+# tier-1 wall budget (tools/tier1_budget.py): slow-marked — still run by the full
+# suite and driver captures
+@pytest.mark.slow
 def test_reduce_scatter_levelwise_matches_serial():
     """The level-wise grower rides the same psum_scatter + SplitInfo-sync
     wrappers as the wave grower."""
@@ -281,6 +291,9 @@ def _train_int8sr_parallel(over, X, y, rounds=3):
     return _train(cfg, X, y, rounds)
 
 
+# tier-1 wall budget (tools/tier1_budget.py): slow-marked — still run by the full
+# suite and driver captures
+@pytest.mark.slow
 def test_int8sr_reduce_scatter_round_trains(monkeypatch):
     """An int8sr quantized round under the reduce-scatter collective:
     global (pmax'd) scales + raw int32 partial histograms through

@@ -184,6 +184,48 @@ def test_robustness_section_renders_chaos_fields():
     assert "chaos_ok=False" in txt and "| False |" in txt
 
 
+def test_split_breakdown_and_pipeline_render():
+    """The PR-7 fields render from the record: the split sub-phase line
+    inside the phase table, the pipeline-overlap A/B section, and the
+    int8sr AUC-parity experiment line — every figure greps to a BENCH
+    field; absent fields render nothing (older records stay stable)."""
+    import perf_report
+
+    rec = {
+        "phase_hist_ms": 66.78, "phase_partition_ms": 9.7,
+        "phase_valid_route_ms": 2.1, "phase_split_ms": 22.8,
+        "phase_other_ms": 50.48, "phase_total_measured_ms": 151.9,
+        "wave_rounds_per_tree": 10.4,
+        "phase_split_breakdown": {"split_cumsum_ms": 6.25,
+                                  "split_gain_ms": 9.12,
+                                  "split_pick_ms": 3.5},
+        "phase_split_unattributed_ms": 3.91,
+        "pipeline_ms_per_iter": 140.25, "pipeline_serialized_ms_per_iter":
+        151.88, "pipeline_overlap_ms": 11.63, "pipeline_ok": True,
+        "precision_expt": {"deep_int8sr": {
+            "auc": 0.91342, "auc_iters": 100,
+            "auc_delta_vs_default": -0.00012, "auc_parity": True,
+            "M_row_trees_per_s": 9.875,
+            "quant_buckets_active": [16, 63]}},
+        "auc": 0.91354,
+        "hist_achieved_tf_s": 1.0, "device_matmul_peak_tf_s": 2.0,
+        "hist_roofline_frac": 0.5, "hist_ms_per_iter": 60.0,
+    }
+    txt = perf_report.generate(rec, "BENCH_rTEST.json")
+    for needle in ("6.25", "9.12", "3.91",
+                   "## Wave pipelining", "140.25", "151.88", "11.63",
+                   "pipeline_ok=True", "tests/test_wave_pipeline.py",
+                   "auc_parity=True", "[16, 63]", "0.91342",
+                   "hist_dtype_deep=auto"):
+        assert needle in txt, needle
+    # absent fields: no pipeline section, no split line, no expt line —
+    # the on-disk PERF.md (generated from an r05-era record) stays stable
+    txt0 = perf_report.generate({"auc": 0.9}, "BENCH_rTEST.json")
+    assert "## Wave pipelining" not in txt0
+    assert "split_cumsum_ms" not in txt0
+    assert "AUC-parity experiment" not in txt0
+
+
 def test_comm_section_renders_in_perf_md():
     """PERF.md (generated output) must carry the Cross-chip comms section
     and its figures must grep to the analytic formula."""

@@ -169,6 +169,23 @@ def test_other_breakdown_covers_and_sums(tmp_path):
         rec["phase_other_unattributed_ms"] / wall_ms, abs=1e-3)
 
 
+def test_split_breakdown_names_fused_scan_stages():
+    """The split-phase decomposition (PR 7) drives the REAL fused-scan
+    stage helpers (ops/split.py scan_left_sums / scan_direction_gains /
+    scan_pick — the code objects _find_best_split composes), returns the
+    three named parts, and stays honest under PhaseBreakdown.record."""
+    from tools.phase_attrib import measure_split_breakdown
+
+    bd = measure_split_breakdown(F=6, B=16, K=4, rounds_per_iter=5.0,
+                                 probes=2)
+    for name in ("split_cumsum_ms", "split_gain_ms", "split_pick_ms"):
+        assert name in bd.parts and np.isfinite(bd.parts[name])
+        assert bd.parts[name] >= 0.0
+    rec = bd.record(10.0, 100.0)
+    s = sum(rec["phase_other_breakdown"].values())
+    assert abs(s + rec["phase_other_unattributed_ms"] - 10.0) < 2e-3
+
+
 def test_assembly_measures_real_store_codecs():
     """The assembly sub-phase must drive the SAME store code objects the
     grower runs — both layouts must execute and return sane times."""

@@ -98,6 +98,9 @@ def test_bucket_layout_covers_all_queries():
     assert (seen == 1).all()             # every row exactly once
 
 
+# tier-1 wall budget (tools/tier1_budget.py): slow-marked — still run by the full
+# suite and driver captures
+@pytest.mark.slow
 def test_mslr_shaped_scale():
     """MSLR/Yahoo-regime query widths (up to ~1300 docs/query): the
     bucketed gradients must fit in memory — the old global-pad layout

@@ -70,6 +70,9 @@ def test_leaf_lookup_debug_bounds(monkeypatch):
 # ---------------------------------------------------------------------------
 
 
+# tier-1 wall budget (tools/tier1_budget.py): slow-marked — still run by the full
+# suite and driver captures
+@pytest.mark.slow
 def test_levelwise_chunked_partition_bit_identical(monkeypatch):
     from lightgbmv1_tpu.models import grower as grower_mod
 

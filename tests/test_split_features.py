@@ -21,7 +21,13 @@ def _logloss(pred, y):
     return -np.mean(y * np.log(p) + (1 - y) * np.log(1 - p))
 
 
-@pytest.mark.parametrize("growth", ["leafwise", "levelwise"])
+# tier-1 wall budget (tools/tier1_budget.py): the levelwise variant is
+# the heavier arm of the same smoothing contract — slow-marked, still in
+# the full suite
+@pytest.mark.parametrize("growth", [
+    "leafwise",
+    pytest.param("levelwise", marks=pytest.mark.slow),
+])
 def test_path_smoothing_regularizes(growth):
     X, y = make_binary_problem(n=1500)
     b0 = lgb.train({**BASE, "tree_growth": growth},

@@ -63,6 +63,9 @@ def test_wave1_matches_sequential(params):
         assert abs(ra - rb) < 0.02 * max(ra, 1e-9)
 
 
+# tier-1 wall budget (tools/tier1_budget.py): slow-marked — still run by the full
+# suite and driver captures
+@pytest.mark.slow
 def test_wave1_multiclass_matches_sequential():
     """The multiclass parity config, pinned (tools/mc_gap_ab.py finding):
     at the multiclass bench shape the recorded mlogloss gap vs the

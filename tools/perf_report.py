@@ -386,6 +386,32 @@ def generate(rec, name, prev=None, prev_name=None):
               + ", ".join(f"{k} {fmt(v)}" for k, v in bd.items())
               + f"; unattributed {get(rec, 'phase_other_unattributed_ms')}"
               f" (ok={rec.get('phase_attrib_ok')}).")
+        sbd = rec.get("phase_split_breakdown")
+        if sbd:
+            w("")
+            w("`phase_split_ms` sub-phases (ops/split.py fused scan — "
+              "cumsum+missing-adjust / stacked gain eval / tie-band pick; "
+              "tools/phase_attrib.py): "
+              + ", ".join(f"{k} {fmt(v)}" for k, v in sbd.items())
+              + f"; remainder {get(rec, 'phase_split_unattributed_ms')} "
+              "(vmap plumbing + result assembly).")
+        w("")
+
+    if rec.get("pipeline_ok") is not None:
+        w("## Wave pipelining (async_wave_pipeline A/B)")
+        w("")
+        w(f"Pipelined {get(rec, 'pipeline_ms_per_iter')} ms/iter vs "
+          f"serialized legacy body "
+          f"{get(rec, 'pipeline_serialized_ms_per_iter')} ms/iter — "
+          f"overlap {get(rec, 'pipeline_overlap_ms')} ms/iter recovered "
+          f"(`pipeline_ok={rec.get('pipeline_ok')}`: the overlapped "
+          "per-iter total must not exceed the serialized sum; trivially "
+          "true on CPU captures, where the backend serializes "
+          "everything).  The pipelined schedule defers each round's "
+          "histogram-state scatter and valid-row routing into the next "
+          "round's computation (models/grower_wave.py) — bit-parity "
+          "against the serialized body is pinned in "
+          "tests/test_wave_pipeline.py.")
         w("")
 
     w("## Histogram kernel (bench config, measured same-session)")
@@ -409,6 +435,18 @@ def generate(rec, name, prev=None, prev_name=None):
       f"peak = **{get(rec, 'hist_roofline_frac', 4)}** fraction "
       f"(`hist_ms_per_iter` {get(rec, 'hist_ms_per_iter')} over the "
       "replayed round schedule).")
+    pe = (rec.get("precision_expt") or {}).get("deep_int8sr")
+    if pe:
+        w("")
+        w("int8sr AUC-parity experiment (the `hist_dtype_deep=auto` flip "
+          f"gate): auc {fmt(pe.get('auc'), 5)} vs default "
+          f"{get(rec, 'auc', 5)} at {fmt(pe.get('auc_iters'), 0)} iters "
+          f"(delta {fmt(pe.get('auc_delta_vs_default'), 6)}, "
+          f"auc_parity={pe.get('auc_parity')}), "
+          f"{fmt(pe.get('M_row_trees_per_s'), 3)} M row-trees/s, "
+          f"quantized buckets active: {pe.get('quant_buckets_active')} "
+          "(empty = the shape never reached the quantized gate — the "
+          "flip needs a device capture where it engages).")
     if prev is not None and prev.get("hist_roofline_frac") is not None:
         w("")
         w(f"Cross-record note ({prev_name} -> {name}): "

@@ -37,6 +37,12 @@ Everything not in this list stays in ``phase_other_unattributed_ms``;
 and flags the record when it exceeds 10% of the measured per-iteration
 wall — the residual can never silently regrow past the bar again.
 
+Round 12 adds the SPLIT-phase decomposition (``measure_split_breakdown``)
+— the 22.8 ms/iter ``phase_split_ms`` target from r05 broken into the
+fused scan's stages (ops/split.py ``scan_left_sums`` /
+``scan_direction_gains`` / ``scan_pick``), timed on the same real code
+objects the split search composes.
+
 Standalone: ``JAX_PLATFORMS=cpu python tools/phase_attrib.py`` prints a
 small-shape JSON breakdown (the CPU test drives the same entry point).
 """
@@ -373,6 +379,102 @@ def measure_loop_fixed_ms(L, n_buckets=3, n_rounds=10, reps=(4, 16),
     return scan_differential_ms(make, *reps, probes=probes) / n_rounds
 
 
+def measure_split_breakdown(*, F, B, K, rounds_per_iter, meta=None,
+                            params=None, num_class=1, reps=(8, 64),
+                            probes=5):
+    """Named decomposition of ``phase_split_ms`` into the fused scan's
+    three stages (ops/split.py — the REAL module-level code objects the
+    split search composes, so the attribution cannot drift from what
+    training runs), each vmapped over the 2K children of a wave round and
+    priced over the round schedule:
+
+    * ``split_cumsum_ms`` — ``scan_left_sums``: the cumulative-sum pass +
+      missing-mass adjustments building the (2, F, B, 3) stacked left
+      sums (the int8sr dequantize multiply folds here).
+    * ``split_gain_ms``   — ``scan_direction_gains``: the stacked
+      both-direction gain evaluation + penalty chain.
+    * ``split_pick_ms``   — ``scan_pick``: the tie-band preference argmax
+      and winner decode.
+
+    Returns a utils.timer.PhaseBreakdown; bench.py records it against
+    the measured ``phase_split_ms`` so the remainder (vmap plumbing,
+    result assembly, categorical search when present) is explicit."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from lightgbmv1_tpu.ops.split import (NO_CONSTRAINT, scan_direction_gains,
+                                          scan_left_sums, scan_pick)
+    from lightgbmv1_tpu.utils.timer import PhaseBreakdown, scan_differential_ms
+
+    if meta is None or params is None:
+        from lightgbmv1_tpu.ops.split import FeatureMeta, SplitParams
+
+        if params is None:
+            params = SplitParams()
+        if meta is None:
+            meta = FeatureMeta(
+                num_bins=jnp.full(F, B, jnp.int32),
+                missing_type=jnp.zeros(F, jnp.int32),
+                nan_bin=jnp.full(F, -1, jnp.int32),
+                zero_bin=jnp.zeros(F, jnp.int32),
+                is_categorical=jnp.zeros(F, bool),
+                usable=jnp.ones(F, bool),
+                monotone_type=jnp.zeros(F, jnp.int32),
+            )
+    rng = np.random.RandomState(12)
+    C = 2 * K                                  # children per round
+    h2k = jnp.asarray(np.abs(rng.randn(C, F, B, 3)).astype(np.float32))
+    parents = h2k.sum(axis=(1, 2))             # (C, 3)
+    mask = jnp.ones(F, bool)
+    nc = jnp.asarray(NO_CONSTRAINT, jnp.float32)
+    left2 = jax.vmap(lambda h: scan_left_sums(h, meta)[0])(h2k)
+    gains0, shift0 = jax.vmap(
+        lambda l2, p: scan_direction_gains(l2, p, meta, mask, params, nc)
+    )(left2, parents)
+
+    def make_stage(fn):
+        def make(r):
+            @jax.jit
+            def reps_fn():
+                def body(c, i):
+                    return c + fn(1.0 + 1e-6 * i.astype(jnp.float32)), None
+                s, _ = lax.scan(body, jnp.float32(0), jnp.arange(r))
+                return s
+            return reps_fn
+        return make
+
+    def cumsum_stage(pert):
+        l2, _ = jax.vmap(lambda h: scan_left_sums(h * pert, meta))(h2k)
+        return l2.sum()
+
+    def gain_stage(pert):
+        g, _ = jax.vmap(
+            lambda l2, p: scan_direction_gains(l2 * pert, p, meta, mask,
+                                               params, nc)
+        )(left2, parents)
+        return jnp.where(jnp.isfinite(g), g, 0.0).sum()
+
+    def pick_stage(pert):
+        bg, ft, th, dr = jax.vmap(
+            lambda g, s: scan_pick(g * pert, s, meta))(gains0, shift0)
+        return (jnp.where(jnp.isfinite(bg), bg, 0.0).sum()
+                + (ft + th + dr).sum().astype(jnp.float32))
+
+    R = float(rounds_per_iter) * num_class
+    bd = PhaseBreakdown()
+    bd.add("split_cumsum_ms",
+           scan_differential_ms(make_stage(cumsum_stage), *reps,
+                                probes=probes) * R)
+    bd.add("split_gain_ms",
+           scan_differential_ms(make_stage(gain_stage), *reps,
+                                probes=probes) * R)
+    bd.add("split_pick_ms",
+           scan_differential_ms(make_stage(pick_stage), *reps,
+                                probes=probes) * R)
+    return bd
+
+
 def measure_other_breakdown(*, N, F, B, L, K, rounds_per_iter,
                             n_buckets=3, n_valid=0, num_class=1,
                             objective=None, fused=True, use_sub=True,
@@ -413,8 +515,13 @@ def main():
     bd = measure_other_breakdown(N=20_000, F=8, B=16, L=31, K=8,
                                  rounds_per_iter=6.0, n_valid=2_000,
                                  probes=3)
+    sbd = measure_split_breakdown(F=8, B=16, K=8, rounds_per_iter=6.0,
+                                  probes=3)
     print(json.dumps({"phase_other_breakdown": bd.parts,
-                      "attributed_ms": round(bd.total_attributed(), 3)}))
+                      "attributed_ms": round(bd.total_attributed(), 3),
+                      "phase_split_breakdown": sbd.parts,
+                      "split_attributed_ms": round(
+                          sbd.total_attributed(), 3)}))
 
 
 if __name__ == "__main__":
