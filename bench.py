@@ -729,6 +729,80 @@ def measure_chaos():
     }
 
 
+def measure_stream(X, y, backend: str):
+    """Out-of-core streaming block (PR 8, data/ subsystem): write the
+    sharded block cache once, train from it with the row-block streaming
+    trainer, and compare against the resident trainer at the SAME
+    sequential schedule.
+
+    ``stream_ok`` is the acceptance guard: byte-identical model text
+    (the parity contract) AND ledger-accounted peak device bytes within
+    the analytic O(stream_block_rows · F) bound — i.e. bounded by block
+    size and leaf-sized state, never by dataset rows."""
+    import tempfile
+    import time as _time
+
+    import lightgbmv1_tpu as lgb
+
+    n = min(len(y), 20_000 if backend == "cpu" else 200_000)
+    Xs, ys = X[:n], y[:n]
+    F = Xs.shape[1]
+    iters = 3
+    block_rows = 4096
+    params = {
+        "objective": "binary", "num_leaves": 31, "max_bin": 63,
+        "learning_rate": 0.1, "min_data_in_leaf": 20, "verbosity": -1,
+        "tree_growth": "leafwise_masked", "seed": 7,
+        "bagging_fraction": 0.8, "bagging_freq": 2,
+        "feature_fraction": 0.9,
+    }
+    fields = {"stream_block_rows": block_rows, "stream_rows": n}
+
+    ds = lgb.Dataset(Xs, label=ys, params=dict(params))
+    ds.construct()
+    t0 = _time.perf_counter()
+    b_res = lgb.train(dict(params), ds, num_boost_round=iters,
+                      verbose_eval=False)
+    res_dt = (_time.perf_counter() - t0) / iters
+    text_res = b_res.model_to_string()
+    matrix_bytes = int(ds._binned.binned.nbytes)
+
+    with tempfile.TemporaryDirectory() as td:
+        cache = os.path.join(td, "blocks")
+        ds.save_block_cache(cache, block_rows=block_rows)
+        sds = lgb.Dataset(cache, params=dict(params))
+        t0 = _time.perf_counter()
+        b_str = lgb.train(dict(params), sds, num_boost_round=iters,
+                          verbose_eval=False)
+        str_dt = (_time.perf_counter() - t0) / iters
+        text_str = b_str.model_to_string()
+        peak = int(b_str._gbdt.stream_peak_device_bytes)
+        peak_tags = dict(b_str._gbdt._ledger.peak_tags)
+
+    parity_ok = text_res == text_str
+    # analytic device bound: leaf-sized state (pool + accumulators) +
+    # double-buffered blocks (bins + g3 + lid per block, 2 in flight) +
+    # one transient (N,)-draw per bagging period + slack for small state
+    B = 64
+    L = params["num_leaves"]
+    block_bytes = block_rows * (F + 12 + 4)
+    bound = (L + 3) * F * B * 3 * 4 + 4 * block_bytes + 8 * n + (1 << 20)
+    mem_ok = peak <= bound
+    fields.update({
+        "stream_ms_per_iter": round(str_dt * 1e3, 2),
+        "stream_resident_ms_per_iter": round(res_dt * 1e3, 2),
+        "stream_vs_resident_ratio": round(str_dt / max(res_dt, 1e-9), 3),
+        "stream_peak_device_bytes": peak,
+        "stream_peak_device_bound_bytes": int(bound),
+        "stream_resident_matrix_bytes": matrix_bytes,
+        "stream_peak_tags": {k: int(v) for k, v in peak_tags.items()},
+        "stream_parity_ok": bool(parity_ok),
+        "stream_mem_ok": bool(mem_ok),
+        "stream_ok": bool(parity_ok and mem_ok),
+    })
+    return fields
+
+
 def main():
     import jax
 
@@ -1207,6 +1281,15 @@ def main():
     except Exception as e:  # noqa: BLE001
         extra["chaos_error"] = f"{type(e).__name__}: {e}"[:200]
         extra["chaos_ok"] = False
+
+    # Out-of-core streaming block (PR 8, data/ subsystem): block cache +
+    # row-block trainer vs the resident trainer — byte parity AND the
+    # bounded-device-memory ledger guard, on every backend.
+    try:
+        extra.update(measure_stream(X, y, backend))
+    except Exception as e:  # noqa: BLE001
+        extra["stream_error"] = f"{type(e).__name__}: {e}"[:200]
+        extra["stream_ok"] = False
 
     # Cross-chip comm pricing (analytic, parallel/cluster.py — the same
     # single-source formula the trainer logs and dryrun_multichip

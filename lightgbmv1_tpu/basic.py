@@ -96,7 +96,23 @@ class Dataset:
         self.feature_name = feature_name
         self.categorical_feature = categorical_feature
 
-        if isinstance(data, (str, os.PathLike)) and \
+        from .data.block_cache import is_block_cache
+
+        if isinstance(data, (str, os.PathLike)) and is_block_cache(data):
+            # sharded block cache (data/ subsystem): metadata + mappers
+            # load resident, the binned row bulk streams per block during
+            # training (models/gbdt_stream.py) — the out-of-core path
+            from .data.streaming import StreamingDataset
+
+            self._binned = StreamingDataset(str(data))
+            self.data = None
+            meta = self._binned.metadata
+            label = meta.label if label is None else label
+            weight = meta.weight if weight is None else weight
+            group = meta.group if group is None else group
+            init_score = meta.init_score if init_score is None else init_score
+            self.feature_name = list(self._binned.feature_names)
+        elif isinstance(data, (str, os.PathLike)) and \
                 BinnedDataset.is_binary_file(str(data)):
             # binary dataset cache (reference LoadFromBinFile,
             # dataset_loader.cpp:273): skips parsing and binning entirely
@@ -247,6 +263,19 @@ class Dataset:
         Dataset::SaveBinaryFile)."""
         self.construct()
         self._binned.save_binary(str(filename))
+        return self
+
+    def save_block_cache(self, path: str,
+                         block_rows: Optional[int] = None) -> "Dataset":
+        """Write the sharded binary block cache (data/block_cache.py):
+        parse-once, then train out-of-core from ``path`` with the
+        row-block streaming trainer (``Dataset(path)`` streams it)."""
+        from .data.block_cache import write_block_cache
+
+        self.construct()
+        if block_rows is None:
+            block_rows = Config.from_dict(self.params).stream_block_rows
+        write_block_cache(self._binned, str(path), block_rows=block_rows)
         return self
 
     # ------------------------------------------------------------------

@@ -11,7 +11,10 @@ Usage matches the reference:
     python -m lightgbmv1_tpu config=train.conf [key=value ...]
 
 Tasks: ``train`` (default), ``predict`` / ``prediction``, ``refit``,
-``convert_model``, and ``serve`` (the online serving subsystem,
+``convert_model``, ``save_binary`` (parse -> bin -> write the sharded
+block cache, from which ``train`` streams out-of-core; reference CLI
+parity for Application task save_binary), and ``serve`` (the online
+serving subsystem,
 ``serve/``: deadline-aware micro-batching over the device inference
 engine behind a stdlib HTTP endpoint — no reference equivalent; the
 reference stops at the batch file->file Predictor).  The reference's
@@ -44,8 +47,14 @@ def _config_to_params(config: Config) -> dict:
 def _load_dataset(config: Config, path: str,
                   reference: Optional[Dataset] = None,
                   init_score_file: str = "") -> Dataset:
+    from .data.block_cache import is_block_cache
     from .io.dataset import BinnedDataset
 
+    if is_block_cache(path):
+        # sharded block cache (task=save_binary output): streams during
+        # training — no re-parse, no re-bin, bounded device working set
+        return Dataset(path, params=_config_to_params(config),
+                       reference=reference)
     if BinnedDataset.is_binary_file(path):
         return Dataset(path, params=_config_to_params(config),
                        reference=reference)
@@ -258,6 +267,24 @@ def run_train(config: Config) -> Booster:
     return booster
 
 
+def run_save_binary(config: Config) -> str:
+    """``task=save_binary`` (reference CLI parity: Application task
+    save_binary → Dataset::SaveBinaryFile): parse → bin → write the
+    SHARDED block cache, from which ``task=train`` (auto-detected) or
+    ``stream_enable`` trains out-of-core without re-parsing.  Output
+    directory: ``stream_cache_dir`` or ``<data>.blocks``."""
+    if not config.data:
+        log_fatal("No data to convert: set data=<file>")
+    out = config.stream_cache_dir or (config.data + ".blocks")
+    t0 = time.time()
+    train_set = _load_dataset(config, config.data,
+                              init_score_file=config.initscore_filename)
+    train_set.save_block_cache(out, block_rows=config.stream_block_rows)
+    log_info(f"Finished save_binary in {time.time() - t0:.3f}s: "
+             f"train with data={out}")
+    return out
+
+
 def run_predict(config: Config) -> None:
     """reference: Application::Predict → Predictor, predictor.hpp:29-160.
 
@@ -408,6 +435,8 @@ def main(argv: Optional[List[str]] = None) -> int:
     task = config.task
     if task == "train":
         run_train(config)
+    elif task == "save_binary":
+        run_save_binary(config)
     elif task in ("predict", "prediction", "test"):
         run_predict(config)
     elif task == "serve":

@@ -370,6 +370,30 @@ class Config:
     # explicit copies when armed (models/gbdt.py _save_rollback_state).
     # No-op on the CPU backend (XLA:CPU ignores donation).
     donate_buffers: bool = True
+    # -- out-of-core streaming training (data/ subsystem) --------------
+    # stream_enable=true trains through the row-block streaming trainer
+    # (models/gbdt_stream.py) even on resident in-memory data: the binned
+    # matrix reaches the device one block at a time (double-buffered H2D)
+    # and per-row score/gradient/routing state stays host-side, so peak
+    # device bytes are O(stream_block_rows * num_features) instead of
+    # O(num_data * num_features).  Training data that IS a block-cache
+    # directory (task=save_binary output) streams automatically.  With a
+    # fixed block order the streamed run's model text is byte-identical
+    # to the resident trainer at the sequential best-first schedule
+    # (the parity contract, tests/test_stream_train.py).
+    stream_enable: bool = False
+    # rows per cache block / per H2D transfer.  The device working-set
+    # knob; also the shard size task=save_binary writes.  For the strict
+    # onehot-method parity contract keep it a multiple of 16384 (the
+    # resident one-hot pass's own accumulation chunk); scatter (the CPU
+    # oracle) is exact at any block size.
+    stream_block_rows: int = 65536
+    # double-buffer host->device block transfers: the next block's
+    # device_put is issued before the current block's histogram pass is
+    # consumed (the PR-4 predict-path overlap, applied to training)
+    stream_prefetch: bool = True
+    # task=save_binary output directory ("" = <data>.blocks)
+    stream_cache_dir: str = ""
     # Cross-chip collective of the row-sharded (data/voting) learners:
     # "reduce_scatter" (default) maps the reference's ReduceScatter of
     # histogram blocks faithfully — each device reduces and KEEPS only its
@@ -617,6 +641,8 @@ class Config:
                              "(0 disables the watchdog)")
         if self.serve_probe_rows < 0:
             raise ValueError("serve_probe_rows must be >= 0")
+        if self.stream_block_rows < 1:
+            raise ValueError("stream_block_rows must be >= 1")
         if self.snapshot_keep < 2:
             raise ValueError("snapshot_keep must be >= 2 (a torn newest "
                              "snapshot needs an intact predecessor)")

@@ -1,0 +1,26 @@
+"""Out-of-core data subsystem: sharded binary block cache + streaming
+dataset (ROADMAP item 2 — training at dataset scales beyond HBM).
+
+* :mod:`~lightgbmv1_tpu.data.block_cache` — the on-disk format: the binned
+  matrix written once as fixed-row-count block shards with a manifest
+  (format version, schema digest, per-block SHA-256), each block loadable
+  independently without re-parsing (the reference's ``two_round``
+  DatasetLoader semantics, persisted).
+* :mod:`~lightgbmv1_tpu.data.streaming` — :class:`StreamingDataset`
+  presents the same surface the engine consumes (row count, feature meta,
+  label/weight access) plus a verified block iterator; the row-block
+  trainer (models/gbdt_stream.py) consumes either a cache on disk or an
+  in-memory :class:`~lightgbmv1_tpu.io.dataset.BinnedDataset` wrapped
+  into blocks.
+"""
+
+from .block_cache import (BLOCK_CACHE_MAGIC, BlockCacheError, is_block_cache,
+                          load_manifest, write_block_cache)
+from .streaming import (DeviceLedger, InMemoryBlockSource, StreamingDataset,
+                        block_source_for)
+
+__all__ = [
+    "BLOCK_CACHE_MAGIC", "BlockCacheError", "is_block_cache",
+    "load_manifest", "write_block_cache", "StreamingDataset",
+    "InMemoryBlockSource", "DeviceLedger", "block_source_for",
+]

@@ -1,0 +1,208 @@
+"""Sharded binary block cache — the out-of-core training format.
+
+The binned matrix is written ONCE (from the existing parse/bin pipeline or
+an in-memory :class:`~lightgbmv1_tpu.io.dataset.BinnedDataset`) as
+fixed-row-count block shards under a cache directory:
+
+    <dir>/manifest.json     format version, shapes, block table with
+                            per-block SHA-256 digests, schema digest
+    <dir>/meta.npz          bin mappers + label/weight/group/init_score
+                            (the reference Metadata, small — rows are the
+                            bulk, per-row 4-byte fields stay host-sized)
+    <dir>/block_00000.bin   raw C-order bytes of binned[:, a:b] (F, rows)
+
+Every file goes through ``fileio.atomic_write_bytes`` (tmp+fsync+rename),
+so a torn cache FAILS LOUDLY at load instead of training on garbage: the
+manifest names every section's digest, and readers verify before use
+(reference: Dataset::SaveBinaryFile / LoadFromBinFile,
+src/io/dataset_loader.cpp:273 — which trusted the file; this format does
+not).  Blocks load independently — the streaming trainer's device working
+set is O(block_rows · F) regardless of dataset rows.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import io
+import json
+import os
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..utils.fileio import atomic_write_bytes, exists, open_file
+from ..utils.log import log_info
+
+BLOCK_CACHE_MAGIC = "lightgbmv1_tpu.block_cache"
+BLOCK_CACHE_VERSION = 1
+MANIFEST_NAME = "manifest.json"
+META_NAME = "meta.npz"
+
+
+class BlockCacheError(RuntimeError):
+    """Torn, corrupted, or incompatible block cache — raised at open/load
+    time so a damaged cache can never silently train garbage."""
+
+
+def _sha256(data: bytes) -> str:
+    return hashlib.sha256(data).hexdigest()
+
+
+def _mapper_arrays(ds) -> Dict[str, np.ndarray]:
+    """Flat-array serialization of the bin mappers (the same wire format
+    BinnedDataset.save_binary uses — BinMapper.to_arrays/from_arrays)."""
+    ubounds = [np.asarray(m.bin_upper_bound, np.float64)
+               for m in ds.bin_mappers]
+    cats = [np.asarray(m.bin_2_categorical, np.int64)
+            for m in ds.bin_mappers]
+    scalars = np.array(
+        [[m.num_bin, m.missing_type, m.bin_type, int(m.is_trivial)]
+         for m in ds.bin_mappers], dtype=np.int64)
+    floats = np.array(
+        [[m.sparse_rate, m.min_value, m.max_value]
+         for m in ds.bin_mappers], dtype=np.float64)
+    meta = ds.metadata
+    return dict(
+        mapper_scalars=scalars,
+        mapper_floats=floats,
+        ubound_flat=(np.concatenate(ubounds) if ubounds else np.zeros(0)),
+        ubound_offsets=np.cumsum([0] + [len(u) for u in ubounds]),
+        cat_flat=(np.concatenate(cats) if cats else np.zeros(0, np.int64)),
+        cat_offsets=np.cumsum([0] + [len(c) for c in cats]),
+        feature_names=np.array(ds.feature_names),
+        max_bin=np.int64(ds.max_bin),
+        label=(meta.label if meta.label is not None else np.zeros(0)),
+        weight=(meta.weight if meta.weight is not None else np.zeros(0)),
+        group=(meta.group if meta.group is not None
+               else np.zeros(0, np.int64)),
+        init_score=(meta.init_score if meta.init_score is not None
+                    else np.zeros(0)),
+    )
+
+
+def write_block_cache(ds, path: str, block_rows: int = 65536) -> dict:
+    """Write ``ds`` (a dense-binned BinnedDataset) as a sharded block
+    cache at directory ``path``; returns the manifest dict.
+
+    The binned matrix must be the plain dense (F, N) representation: EFB
+    bundle-only (sparse-path) datasets are refused — the streaming trainer
+    speaks original features (bundling trades HBM for compute the
+    streaming path already bounds)."""
+    if ds.binned is None:
+        raise BlockCacheError(
+            "write_block_cache requires a dense-binned dataset (EFB "
+            "bundle-only sparse datasets are not streamable); load dense "
+            "data or set enable_bundle=false")
+    if block_rows < 1:
+        raise BlockCacheError(f"block_rows must be >= 1 (got {block_rows})")
+    os.makedirs(path, exist_ok=True)
+
+    buf = io.BytesIO()
+    np.savez_compressed(buf, **_mapper_arrays(ds))
+    meta_bytes = buf.getvalue()
+    atomic_write_bytes(os.path.join(path, META_NAME), meta_bytes,
+                       site="block_cache_meta")
+
+    N = ds.num_data
+    binned = np.ascontiguousarray(ds.binned)
+    blocks: List[dict] = []
+    for i, a in enumerate(range(0, N, block_rows)):
+        b = min(a + block_rows, N)
+        blk = np.ascontiguousarray(binned[:, a:b])
+        data = blk.tobytes()
+        fname = f"block_{i:05d}.bin"
+        atomic_write_bytes(os.path.join(path, fname), data,
+                           site=f"block_cache_block_{i}")
+        blocks.append({"file": fname, "row_begin": int(a),
+                       "rows": int(b - a), "sha256": _sha256(data),
+                       "nbytes": len(data)})
+
+    manifest = {
+        "magic": BLOCK_CACHE_MAGIC,
+        "format_version": BLOCK_CACHE_VERSION,
+        "num_rows": int(N),
+        "num_features": int(ds.num_features),
+        "block_rows": int(block_rows),
+        "dtype": str(binned.dtype),
+        "meta_file": META_NAME,
+        "meta_sha256": _sha256(meta_bytes),
+        # schema digest: load-time incompatibility (different binning of
+        # the "same" data) fails loudly instead of mis-binning predictions
+        "schema_digest": _sha256(meta_bytes)[:16],
+        "blocks": blocks,
+    }
+    atomic_write_bytes(os.path.join(path, MANIFEST_NAME),
+                       json.dumps(manifest, indent=1).encode(),
+                       site="block_cache_manifest")
+    log_info(f"Wrote block cache to {path}: {N} rows x {ds.num_features} "
+             f"features in {len(blocks)} blocks of {block_rows} rows")
+    return manifest
+
+
+def is_block_cache(path) -> bool:
+    """True when ``path`` is a directory holding a block-cache manifest."""
+    p = os.path.join(str(path), MANIFEST_NAME)
+    if not exists(p):
+        return False
+    try:
+        with open_file(p) as fh:
+            return json.load(fh).get("magic") == BLOCK_CACHE_MAGIC
+    except Exception:
+        return False
+
+
+def load_manifest(path: str) -> dict:
+    """Load + validate the manifest and the meta shard's digest."""
+    mp = os.path.join(str(path), MANIFEST_NAME)
+    if not exists(mp):
+        raise BlockCacheError(f"{path}: no {MANIFEST_NAME} (not a block "
+                              "cache)")
+    try:
+        with open_file(mp) as fh:
+            manifest = json.load(fh)
+    except (json.JSONDecodeError, UnicodeDecodeError) as e:
+        raise BlockCacheError(f"{mp}: torn or corrupt manifest ({e})")
+    if manifest.get("magic") != BLOCK_CACHE_MAGIC:
+        raise BlockCacheError(f"{mp}: wrong magic "
+                              f"{manifest.get('magic')!r}")
+    version = int(manifest.get("format_version", -1))
+    if version != BLOCK_CACHE_VERSION:
+        raise BlockCacheError(
+            f"{mp}: unsupported format_version {version} (this build "
+            f"reads version {BLOCK_CACHE_VERSION})")
+    for key in ("num_rows", "num_features", "dtype", "blocks",
+                "meta_sha256"):
+        if key not in manifest:
+            raise BlockCacheError(f"{mp}: missing manifest field {key!r}")
+    return manifest
+
+
+def read_meta_arrays(path: str, manifest: dict) -> Dict[str, np.ndarray]:
+    mp = os.path.join(str(path), manifest.get("meta_file", META_NAME))
+    with open_file(mp, "rb") as fh:
+        raw = fh.read()
+    if _sha256(raw) != manifest["meta_sha256"]:
+        raise BlockCacheError(f"{mp}: meta shard digest mismatch (torn or "
+                              "corrupt cache)")
+    with np.load(io.BytesIO(raw), allow_pickle=False) as z:
+        return {k: z[k] for k in z.files}
+
+
+def read_block(path: str, manifest: dict, index: int) -> np.ndarray:
+    """Load ONE block shard -> (F, rows) array, digest-verified."""
+    blocks = manifest["blocks"]
+    if not (0 <= index < len(blocks)):
+        raise BlockCacheError(f"block index {index} out of range "
+                              f"(cache has {len(blocks)} blocks)")
+    entry = blocks[index]
+    bp = os.path.join(str(path), entry["file"])
+    with open_file(bp, "rb") as fh:
+        raw = fh.read()
+    if len(raw) != int(entry["nbytes"]) or _sha256(raw) != entry["sha256"]:
+        raise BlockCacheError(
+            f"{bp}: block digest mismatch (torn or corrupt cache); "
+            "rebuild with task=save_binary")
+    F = int(manifest["num_features"])
+    rows = int(entry["rows"])
+    return np.frombuffer(raw, dtype=np.dtype(manifest["dtype"])) \
+        .reshape(F, rows)

@@ -1,0 +1,232 @@
+"""StreamingDataset + block sources + the device-byte ledger.
+
+:class:`StreamingDataset` subclasses BinnedDataset with ``binned=None``:
+it presents the exact surface the engine consumes (num_data, feature
+meta, bin mappers, label/weight/group access) while the row bulk stays on
+disk in the sharded block cache (data/block_cache.py).  The streaming
+trainer (models/gbdt_stream.py) iterates verified blocks; each block is
+digest-checked on every load, so bit rot or a torn shard aborts training
+instead of silently corrupting histograms.
+
+:class:`InMemoryBlockSource` wraps a resident BinnedDataset into the same
+block interface — ``stream_enable=true`` on in-memory data exercises the
+identical trainer code path (the parity tests' streamed side, and a
+useful working-set bound when host RAM holds rows HBM cannot).
+
+:class:`DeviceLedger` is the honest accounting behind the memory-guard
+contract: every device buffer the streaming trainer creates is recorded
+(bytes, tag) with explicit release, and ``peak_bytes`` is asserted to
+scale with ``stream_block_rows`` — not dataset rows — by
+tests/test_stream_train.py and the BENCH ``stream_ok`` guard.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterator, List, Optional, Tuple
+
+import numpy as np
+
+from ..io.binning import BinMapper
+from ..io.dataset import BinnedDataset, Metadata
+from ..utils.log import log_info
+from .block_cache import (BlockCacheError, load_manifest, read_block,
+                          read_meta_arrays)
+
+
+class DeviceLedger:
+    """Named device-byte accounting for the streaming trainer.
+
+    jax gives no portable peak-HBM counter on CPU backends, so the
+    trainer itself declares every device allocation it makes (block
+    uploads, gradient slices, histogram accumulators, the L-sized
+    histogram pool) and releases them as they retire.  ``peak_bytes`` is
+    therefore an upper-bound ledger of streaming-owned device memory —
+    the quantity the O(block_rows · F) contract speaks about."""
+
+    def __init__(self):
+        self._live: Dict[int, Tuple[str, int]] = {}
+        self._next = 0
+        self.live_bytes = 0
+        self.peak_bytes = 0
+        self.peak_tags: Dict[str, int] = {}
+
+    def hold(self, tag: str, nbytes: int) -> int:
+        h = self._next
+        self._next += 1
+        self._live[h] = (tag, int(nbytes))
+        self.live_bytes += int(nbytes)
+        if self.live_bytes > self.peak_bytes:
+            self.peak_bytes = self.live_bytes
+            by_tag: Dict[str, int] = {}
+            for t, b in self._live.values():
+                by_tag[t] = by_tag.get(t, 0) + b
+            self.peak_tags = by_tag
+        return h
+
+    def hold_array(self, tag: str, arr) -> int:
+        return self.hold(tag, int(np.dtype(arr.dtype).itemsize)
+                         * int(np.prod(arr.shape)))
+
+    def release(self, handle: Optional[int]) -> None:
+        if handle is None or handle not in self._live:
+            return
+        _, b = self._live.pop(handle)
+        self.live_bytes -= b
+
+    def reset(self) -> None:
+        self._live.clear()
+        self.live_bytes = 0
+        self.peak_bytes = 0
+        self.peak_tags = {}
+
+
+class _BlockSource:
+    """Block iteration interface: contiguous row ranges, host arrays."""
+
+    num_rows: int = 0
+    num_features: int = 0
+    block_dtype = np.uint8
+    ranges: List[Tuple[int, int]] = []
+
+    def load_block(self, index: int) -> np.ndarray:   # (F, rows)
+        raise NotImplementedError
+
+    @property
+    def num_blocks(self) -> int:
+        return len(self.ranges)
+
+
+class InMemoryBlockSource(_BlockSource):
+    """Resident (F, N) matrix sliced into fixed-row blocks — the
+    stream_enable=true path for in-memory datasets."""
+
+    def __init__(self, binned: np.ndarray, block_rows: int):
+        if block_rows < 1:
+            raise ValueError("stream_block_rows must be >= 1")
+        self._binned = binned
+        F, N = binned.shape
+        self.num_rows = N
+        self.num_features = F
+        self.block_dtype = binned.dtype
+        self.block_rows = int(block_rows)
+        self.ranges = [(a, min(a + block_rows, N))
+                       for a in range(0, N, block_rows)]
+
+    def load_block(self, index: int) -> np.ndarray:
+        a, b = self.ranges[index]
+        return np.ascontiguousarray(self._binned[:, a:b])
+
+
+class _CacheBlockSource(_BlockSource):
+    def __init__(self, path: str, manifest: dict):
+        self._path = path
+        self._manifest = manifest
+        self.num_rows = int(manifest["num_rows"])
+        self.num_features = int(manifest["num_features"])
+        self.block_dtype = np.dtype(manifest["dtype"])
+        self.block_rows = int(manifest["block_rows"])
+        self.ranges = [(int(e["row_begin"]),
+                        int(e["row_begin"]) + int(e["rows"]))
+                       for e in manifest["blocks"]]
+        # block table sanity: contiguous, covering, ordered
+        pos = 0
+        for a, b in self.ranges:
+            if a != pos or b <= a:
+                raise BlockCacheError(
+                    f"{path}: block table is not contiguous at row {pos}")
+            pos = b
+        if pos != self.num_rows:
+            raise BlockCacheError(
+                f"{path}: block table covers {pos} rows, manifest says "
+                f"{self.num_rows}")
+
+    def load_block(self, index: int) -> np.ndarray:
+        return read_block(self._path, self._manifest, index)
+
+
+class StreamingDataset(BinnedDataset):
+    """Dataset view over a sharded block cache: feature meta + labels
+    resident (small), the binned row bulk loaded block-by-block.
+
+    Presents the BinnedDataset surface (``binned is None``, like the
+    sparse-input path) so growers' metadata plumbing, valid-set reference
+    alignment, and model-text feature infos all work unchanged."""
+
+    is_streaming = True
+
+    def __init__(self, path: str):
+        self.cache_path = str(path)
+        manifest = load_manifest(self.cache_path)
+        z = read_meta_arrays(self.cache_path, manifest)
+        scalars = z["mapper_scalars"]
+        floats = z["mapper_floats"]
+        uoff = z["ubound_offsets"]
+        coff = z["cat_offsets"]
+        mappers = []
+        for j in range(scalars.shape[0]):
+            mappers.append(BinMapper.from_arrays({
+                "bin_upper_bound": z["ubound_flat"][uoff[j]:uoff[j + 1]],
+                "num_bin": scalars[j, 0],
+                "missing_type": scalars[j, 1],
+                "bin_type": scalars[j, 2],
+                "is_trivial": scalars[j, 3],
+                "sparse_rate": floats[j, 0],
+                "min_value": floats[j, 1],
+                "max_value": floats[j, 2],
+                "bin_2_categorical": z["cat_flat"][coff[j]:coff[j + 1]],
+            }))
+        meta = Metadata()
+        if z["label"].size:
+            meta.label = z["label"].astype(np.float32)
+        if z["weight"].size:
+            meta.weight = z["weight"].astype(np.float32)
+        if z["group"].size:
+            meta.set_group(z["group"])
+        if z["init_score"].size:
+            meta.init_score = z["init_score"]
+        super().__init__(None, mappers, meta,
+                         feature_names=[str(s) for s in z["feature_names"]],
+                         max_bin=int(z["max_bin"]),
+                         num_data=int(manifest["num_rows"]))
+        if len(mappers) != int(manifest["num_features"]):
+            raise BlockCacheError(
+                f"{path}: meta shard has {len(mappers)} mappers, manifest "
+                f"says {manifest['num_features']} features")
+        self.source = _CacheBlockSource(self.cache_path, manifest)
+        self.manifest = manifest
+        log_info(f"Opened block cache {path}: {self.num_data} rows, "
+                 f"{self.num_features} features, "
+                 f"{self.source.num_blocks} blocks")
+
+    # the trainer must never materialize the matrix implicitly
+    @property
+    def train_matrix(self):
+        return None
+
+    def iter_blocks(self) -> Iterator[Tuple[int, int, np.ndarray]]:
+        for i, (a, b) in enumerate(self.source.ranges):
+            yield a, b, self.source.load_block(i)
+
+    def materialize(self) -> BinnedDataset:
+        """Densify into a resident BinnedDataset (tests / small data)."""
+        full = np.empty((self.num_features, self.num_data),
+                        dtype=self.source.block_dtype)
+        for a, b, blk in self.iter_blocks():
+            full[:, a:b] = blk
+        ds = BinnedDataset(full, self.bin_mappers, self.metadata,
+                           feature_names=list(self.feature_names),
+                           max_bin=self.max_bin)
+        return ds
+
+
+def block_source_for(train_set, block_rows: int) -> _BlockSource:
+    """The trainer's source resolution: a StreamingDataset streams its
+    cache blocks; a resident dense BinnedDataset is wrapped in-memory at
+    ``stream_block_rows`` granularity."""
+    if getattr(train_set, "is_streaming", False):
+        return train_set.source
+    if train_set.binned is None:
+        raise BlockCacheError(
+            "stream_enable requires dense bins (EFB bundle-only sparse "
+            "datasets are not streamable)")
+    return InMemoryBlockSource(train_set.binned, block_rows)

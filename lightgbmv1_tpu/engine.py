@@ -37,7 +37,16 @@ def train(
     evals_result: Optional[Dict] = None,
     verbose_eval: Union[bool, int] = True,
 ) -> Booster:
-    """Train a gradient boosting model (reference engine.py:18)."""
+    """Train a gradient boosting model (reference engine.py:18).
+
+    ``train_set`` may wrap a resident matrix, a binary cache file, or a
+    sharded BLOCK cache directory (data/ subsystem): block caches (and
+    any dataset under ``stream_enable=true``) train through the
+    out-of-core row-block streaming trainer — device working set
+    O(stream_block_rows · features), model text byte-identical to the
+    resident trainer at the sequential schedule (models/gbdt_stream.py).
+    Valid sets stay resident (small) and must share the training bins:
+    build them with ``reference=train_set`` as usual."""
     params = dict(params or {})
     # rounds aliases behave like the reference: params win over the kwarg
     for alias in ("num_iterations", "num_iteration", "n_iter", "num_tree",

@@ -412,6 +412,18 @@ class BinnedDataset:
     # bin-finding allgather would exchange, dataset_loader.cpp:913-996).
     # ------------------------------------------------------------------
     BINARY_MAGIC = "lightgbmv1_tpu.dataset.v1"
+    # format_version 2 (PR 8): per-section SHA-256 digests + atomic write
+    # — a torn or bit-rotted cache fails LOUDLY at load instead of
+    # training on garbage.  Version-1 caches (no digests) still load,
+    # with a warning.
+    BINARY_FORMAT_VERSION = 2
+
+    @staticmethod
+    def _section_digest(arr: np.ndarray) -> str:
+        import hashlib
+
+        return hashlib.sha256(np.ascontiguousarray(arr).tobytes()
+                              ).hexdigest()
 
     def save_binary(self, path: str) -> None:
         ubounds = [np.asarray(m.bin_upper_bound, np.float64)
@@ -425,13 +437,14 @@ class BinnedDataset:
             [[m.sparse_rate, m.min_value, m.max_value]
              for m in self.bin_mappers], dtype=np.float64)
         meta = self.metadata
-        from ..utils.fileio import open_file
+        import io as _io
 
-        fh = open_file(path, "wb")  # keep the exact filename (savez appends
+        from ..utils.fileio import atomic_write_bytes
+
+        fh = _io.BytesIO()          # keep the exact filename (savez appends
                                     # .npz to bare string paths)
         bl = self.bundle_layout
-        np.savez_compressed(
-            fh,
+        sections = dict(
             magic=np.frombuffer(self.BINARY_MAGIC.encode(), dtype=np.uint8),
             # sparse-path datasets carry only the EFB bundle matrix;
             # load_binary reconstructs whichever representation was saved
@@ -466,8 +479,23 @@ class BinnedDataset:
             init_score=(meta.init_score if meta.init_score is not None
                         else np.zeros(0)),
         )
-        fh.close()
-        log_info(f"Saved binary dataset cache to {path}")
+        digest_keys = sorted(k for k in sections if k != "magic")
+        digests = np.array([self._section_digest(sections[k])
+                            for k in digest_keys])
+        np.savez_compressed(
+            fh,
+            format_version=np.int64(self.BINARY_FORMAT_VERSION),
+            digest_keys=np.array(digest_keys),
+            digest_values=digests,
+            **sections,
+        )
+        # atomic (tmp+fsync+rename): a kill mid-save leaves the previous
+        # cache intact; the ``file_write`` fault-injection seam rides along
+        # (tests/test_stream_cache.py corrupts/tears through it)
+        atomic_write_bytes(path, fh.getvalue(), site=path)
+        log_info(f"Saved binary dataset cache to {path} "
+                 f"(format v{self.BINARY_FORMAT_VERSION}, "
+                 f"{len(digest_keys)} digest-pinned sections)")
 
     @classmethod
     def is_binary_file(cls, path: str) -> bool:
@@ -490,12 +518,51 @@ class BinnedDataset:
 
     @classmethod
     def load_binary(cls, path: str) -> "BinnedDataset":
-        from ..utils.fileio import open_file
+        import zipfile
 
+        from ..utils.fileio import open_file
+        from ..utils.log import LightGBMError
+
+        try:
+            return cls._load_binary_inner(path, open_file)
+        except LightGBMError:
+            raise
+        except (zipfile.BadZipFile, ValueError, OSError, KeyError,
+                EOFError) as e:
+            # a torn/truncated/corrupt cache must fail LOUDLY here — the
+            # pre-v2 reader could hand back garbage arrays from a half
+            # written zip
+            log_fatal(f"{path}: torn or corrupt binary dataset cache "
+                      f"({type(e).__name__}: {e}); re-create it with "
+                      "save_binary")
+
+    @classmethod
+    def _load_binary_inner(cls, path: str, open_file) -> "BinnedDataset":
         with open_file(path, "rb") as fh, \
                 np.load(fh, allow_pickle=False) as z:
             if bytes(z["magic"]).decode() != cls.BINARY_MAGIC:
                 log_fatal(f"{path} is not a lightgbmv1_tpu binary dataset")
+            version = (int(z["format_version"])
+                       if "format_version" in z else 1)
+            if version > cls.BINARY_FORMAT_VERSION:
+                log_fatal(
+                    f"{path}: binary cache format v{version} is newer "
+                    f"than this build reads "
+                    f"(v{cls.BINARY_FORMAT_VERSION}); re-create it with "
+                    "save_binary")
+            if version >= 2:
+                keys = [str(s) for s in z["digest_keys"]]
+                vals = [str(s) for s in z["digest_values"]]
+                for k, want in zip(keys, vals):
+                    if k not in z or cls._section_digest(z[k]) != want:
+                        log_fatal(
+                            f"{path}: binary cache section {k!r} digest "
+                            "mismatch — torn or corrupt cache; re-create "
+                            "it with save_binary")
+            else:
+                log_warning(f"{path}: legacy v1 binary cache (no section "
+                            "digests); re-save to enable corruption "
+                            "detection")
             scalars = z["mapper_scalars"]
             floats = z["mapper_floats"]
             uoff = z["ubound_offsets"]

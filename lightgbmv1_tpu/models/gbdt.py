@@ -75,6 +75,10 @@ class _ScoreUpdater:
 class GBDT:
     """Gradient Boosting Decision Tree driver (reference: class GBDT, gbdt.h:34)."""
 
+    # out-of-core row-block training (models/gbdt_stream.py sets True):
+    # the binned matrix is NEVER uploaded whole — blocks stream per pass
+    _is_streaming = False
+
     def __init__(
         self,
         config: Config,
@@ -102,7 +106,7 @@ class GBDT:
         # device-resident training data (the EFB bundle matrix when
         # bundling applied — trees and meta always speak ORIGINAL features)
         self._bundle = None
-        if train_set.bundle_layout is not None:
+        if not self._is_streaming and train_set.bundle_layout is not None:
             from ..io.bundle import BundleArrays
 
             incompatible = (config.tree_learner in ("voting", "feature")
@@ -125,17 +129,25 @@ class GBDT:
         # binned matrix in HBM and the hist pass's dominant read stream.
         # Pallas-path only; feature-parallel shards features, not bytes.
         self._packed = False
-        self._host_matrix = train_set.train_matrix
-        method = default_hist_method(config.hist_method,
-                                     self._host_matrix.dtype)
-        if (self._bundle is None and method == "pallas"
-                and train_set.num_total_bin <= 16
-                and config.tree_learner != "feature"):
-            from ..ops.hist_pallas import pack4bit
+        if self._is_streaming:
+            # the row bulk never lands on device whole: blocks stream per
+            # histogram pass (models/grower_stream.py); EFB / 4-bit
+            # packing are resident-trainer representations
+            self._host_matrix = None
+        else:
+            self._host_matrix = train_set.train_matrix
+            method = default_hist_method(config.hist_method,
+                                         self._host_matrix.dtype)
+            if (self._bundle is None and method == "pallas"
+                    and train_set.num_total_bin <= 16
+                    and config.tree_learner != "feature"):
+                from ..ops.hist_pallas import pack4bit
 
-            self._packed = True
-            self._host_matrix = pack4bit(self._host_matrix)
-        if getattr(train_set, "is_row_sharded", False):
+                self._packed = True
+                self._host_matrix = pack4bit(self._host_matrix)
+        if self._is_streaming:
+            self.binned = None
+        elif getattr(train_set, "is_row_sharded", False):
             # process-sharded training data: the global device array is
             # assembled from per-process shards by the trainer
             # (parallel/dist_data.py make_process_sharded)
@@ -178,13 +190,15 @@ class GBDT:
         if init_raw_scores is not None:
             base = np.asarray(init_raw_scores, dtype=np.float64).reshape(
                 self.num_data, self.num_class)
-            self._train_scores = _ScoreUpdater(self.num_data, self.num_class, base)
+            self._train_scores = self._new_score_store(
+                self.num_data, self.num_class, base)
             self._used_init_score = True
         elif meta_init is not None:
             init = np.asarray(meta_init, dtype=np.float64).reshape(self.num_data, -1)
             base = np.zeros((self.num_data, self.num_class))
             base[:, : init.shape[1]] = init
-            self._train_scores = _ScoreUpdater(self.num_data, self.num_class, base)
+            self._train_scores = self._new_score_store(
+                self.num_data, self.num_class, base)
             self._used_init_score = True
         else:
             if self.objective is not None:
@@ -195,7 +209,7 @@ class GBDT:
                         "Start training from score "
                         + " ".join(f"{s:.6f}" for s in self._init_scores)
                     )
-            self._train_scores = _ScoreUpdater(
+            self._train_scores = self._new_score_store(
                 self.num_data, self.num_class, self._init_scores[None, :]
             )
             self._used_init_score = False
@@ -252,6 +266,12 @@ class GBDT:
         # aliasing directly (tests/test_wave_pipeline.py).
         self._donate = bool(config.donate_buffers) and \
             jax.default_backend() != "cpu"
+
+    # ------------------------------------------------------------------
+    def _new_score_store(self, num_data, num_class, init):
+        """Train-score cache factory — the streaming trainer overrides
+        this with a host-backed store (block-sharded per-row state)."""
+        return _ScoreUpdater(num_data, num_class, init)
 
     # ------------------------------------------------------------------
     @property
@@ -1748,6 +1768,13 @@ class RF(GBDT):
 def create_boosting(config: Config, train_set: BinnedDataset, **kw) -> GBDT:
     """reference: Boosting::CreateBoosting, src/boosting/boosting.cpp:37-44."""
     kind = config.boosting
+    if getattr(train_set, "is_streaming", False) or config.stream_enable:
+        # out-of-core row-block trainer (models/gbdt_stream.py): a block
+        # cache streams from disk; stream_enable=true wraps resident data
+        # into the same block path (bounded device working set)
+        from .gbdt_stream import create_streaming_boosting
+
+        return create_streaming_boosting(config, train_set, **kw)
     if kind in ("gbdt", "gbrt"):
         return GBDT(config, train_set, **kw)
     if kind == "dart":

@@ -256,7 +256,15 @@ def make_leafwise_grower(
 
     if sums_fn is None:
         def sums_fn(g3):
-            return g3.sum(axis=0)
+            # ordered scatter fold into one slot, NOT jnp.sum: scatter-add
+            # applies the row additions sequentially in row order, which
+            # the out-of-core row-block trainer CONTINUES across blocks
+            # bit-exactly (ops/histogram.sums_accum) — jnp.sum's internal
+            # reduction tree is shape-dependent and not streamable.  Same
+            # mechanism as the histogram pass itself; value differs from
+            # jnp.sum only in the last ulp.
+            return jnp.zeros((1, 3), jnp.float32).at[
+                jnp.zeros(g3.shape[0], jnp.int32)].add(g3)[0]
 
     if bins_of_fn is None:
         def bins_of_fn(binned, feat):

@@ -105,6 +105,8 @@ def hist_leaves_onehot(
     num_bins: int,
     precision: str = "bf16x2",
     row_chunk: int = 16384,
+    init: Optional[jax.Array] = None,   # (Lp*3, F*B) carry — streamed
+                                        # accumulation (hist_one_leaf_accum)
 ) -> jax.Array:             # (L, F, B, 3)
     F, N = binned.shape
     L, B = num_leaves, num_bins
@@ -136,7 +138,8 @@ def hist_leaves_onehot(
         h = _matmul_hist(lg, onehot, precision)                 # (Lp*3, F*B)
         return acc + h, None
 
-    init = jnp.zeros((Lp * 3, F * B), jnp.float32)
+    if init is None:
+        init = jnp.zeros((Lp * 3, F * B), jnp.float32)
     h, _ = lax.scan(chunk_body, init, (binned_c, g3_c, leaf_c))
     h = h.reshape(Lp, 3, F, B).transpose(0, 2, 3, 1)             # (Lp, F, B, 3)
     return h[:L]
@@ -179,6 +182,87 @@ def hist_one_leaf(
             return hist_leaves_onehot(binned, g3m, zeros, 1, num_bins,
                                       precision)[0]
         return hist_leaves_scatter(binned, g3m, zeros, 1, num_bins)[0]
+
+
+# ---------------------------------------------------------------------------
+# Streamed (row-block) accumulation — out-of-core training (data/ subsystem)
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.jit, static_argnames=())
+def _scatter_accum(acc, binned, g3m):
+    """Scatter one row block's masked gradient rows INTO ``acc`` (F, B, 3).
+
+    Bit-exactness contract: XLA's scatter-add applies updates sequentially
+    in index order, so scattering block b's rows into the accumulator
+    CONTINUES the same left-fold of row-order additions that one
+    ``hist_leaves_scatter`` pass over the concatenated rows performs —
+    the streamed histogram is bit-identical to the resident one (pinned
+    by tests/test_stream_train.py).  Summing per-block PARTIAL histograms
+    instead would re-associate the f32 adds and break the parity."""
+    def per_feature(args):
+        af, bins_f = args
+        return af.at[bins_f.astype(jnp.int32)].add(g3m)
+
+    return lax.map(per_feature, (acc, binned))
+
+
+def _onehot_layout(acc, num_bins):
+    """(F, B, 3) accumulator -> the (Lp*3, F*B) layout of the
+    hist_leaves_onehot chunk scan, leaf slot 0 (Lp = 2: slot 1 is the
+    sacrificial pad-row slot, zero here)."""
+    F, B, _ = acc.shape
+    h = jnp.zeros((2, 3, F, B), jnp.float32).at[0].set(acc.transpose(2, 0, 1))
+    return h.reshape(2 * 3, F * B)
+
+
+@functools.partial(jax.jit, static_argnames=("num_bins", "precision"))
+def _onehot_accum(acc, binned, g3m, num_bins, precision):
+    F, B = binned.shape[0], num_bins
+    h = hist_leaves_onehot(
+        binned, g3m, jnp.zeros(binned.shape[1], jnp.int32), 1, num_bins,
+        precision, 16384, init=_onehot_layout(acc, num_bins))
+    return h[0]
+
+
+def hist_one_leaf_accum(
+    acc: jax.Array,         # (F, B, 3) running accumulator
+    binned: jax.Array,      # (F, n) one row block's bins
+    g3: jax.Array,          # (n, 3)
+    leaf_id: jax.Array,     # (n,) int32 — this block's current leaf routing
+    target_leaf,            # scalar
+    num_bins: int,
+    method: str = "scatter",
+    precision: str = "bf16x2",
+) -> jax.Array:
+    """Streamed continuation of :func:`hist_one_leaf`: fold one row block
+    into ``acc``.  Folding every block in fixed block-sequential order
+    reproduces the resident full-matrix pass bit-for-bit on the
+    ``scatter`` method (update-order continuation, see ``_scatter_accum``)
+    and on ``onehot`` when the block size is a multiple of the 16384-row
+    chunk (the resident pass's own accumulation granularity).  ``pallas``
+    blocks fall back to partial-sum accumulation: deterministic at fixed
+    block order, but not bit-equal to the resident kernel."""
+    with jax.named_scope("lgbm.hist_stream"):
+        mask = (leaf_id == target_leaf).astype(jnp.float32)
+        g3m = g3 * mask[:, None]
+        if method == "onehot":
+            return _onehot_accum(acc, binned, g3m, num_bins, precision)
+        if method == "pallas":
+            return acc + hist_one_leaf(binned, g3m,
+                                       jnp.zeros_like(leaf_id),
+                                       jnp.asarray(0, jnp.int32), num_bins,
+                                       method=method, precision=precision)
+        return _scatter_accum(acc, binned, g3m)
+
+
+@jax.jit
+def sums_accum(acc, g3):
+    """Streamed continuation of the sequential grower's ordered-scatter
+    root-sum fold (models/grower.py sums_fn): scatter block rows into the
+    (1, 3) carry slot — update order continues the resident fold exactly,
+    so the streamed root statistics are bit-identical."""
+    return acc.at[jnp.zeros(g3.shape[0], jnp.int32)].add(g3)
 
 
 def hist_frontier(

@@ -184,6 +184,44 @@ def test_robustness_section_renders_chaos_fields():
     assert "chaos_ok=False" in txt and "| False |" in txt
 
 
+def test_streaming_section_renders_stream_fields():
+    """The Streaming section (PR 8) is generated from the BENCH stream_*
+    fields (bench.py measure_stream, data/ block cache + row-block
+    trainer): clocks, the ledger peak vs the analytic bound, and the
+    stream_ok guard grep to record fields."""
+    import perf_report
+
+    rec = {
+        "stream_ok": True, "stream_parity_ok": True, "stream_mem_ok": True,
+        "stream_rows": 20000, "stream_block_rows": 4096,
+        "stream_ms_per_iter": 812.5, "stream_resident_ms_per_iter": 401.3,
+        "stream_vs_resident_ratio": 2.025,
+        "stream_peak_device_bytes": 1234567,
+        "stream_peak_device_bound_bytes": 2345678,
+        "stream_resident_matrix_bytes": 560000,
+    }
+    lines = []
+    perf_report.streaming_section(lines.append, rec)
+    txt = "\n".join(lines)
+    assert "## Streaming" in txt
+    for needle in ("stream_ok=True", "stream_parity_ok=True",
+                   "stream_mem_ok=True", "byte-identical", "812.5",
+                   "1234567", "2345678", "4096-row blocks",
+                   "not dataset rows"):
+        assert needle in txt, needle
+    # no capture yet -> placeholder, never dies
+    lines = []
+    perf_report.streaming_section(lines.append, {})
+    assert "No stream fields" in "\n".join(lines)
+    # a parity/memory failure surfaces on the guard line
+    rec["stream_ok"] = False
+    rec["stream_parity_ok"] = False
+    lines = []
+    perf_report.streaming_section(lines.append, rec)
+    txt = "\n".join(lines)
+    assert "stream_ok=False" in txt and "stream_parity_ok=False" in txt
+
+
 def test_split_breakdown_and_pipeline_render():
     """The PR-7 fields render from the record: the split sub-phase line
     inside the phase table, the pipeline-overlap A/B section, and the

@@ -1,0 +1,318 @@
+"""Out-of-core row-block streaming trainer (PR 8 tentpole).
+
+The contract under test: with a fixed block order, streaming training
+produces BYTE-IDENTICAL model text to the resident trainer at the same
+sequential best-first schedule (``tree_growth=leafwise_masked`` — the
+parity configuration), across binary / multiclass / DART including
+bagging, feature_fraction, categorical/NaN and valid sets — while the
+streaming trainer's ledger-accounted peak device bytes scale with
+``stream_block_rows``, never with dataset rows (the memory guard).
+
+The mechanism is arithmetic-order preservation (not tolerance): streamed
+histogram folds continue the resident scatter pass's update order
+(ops/histogram.hist_one_leaf_accum), the root sum is the ordered-scatter
+fold on both sides (models/grower.py sums_fn), per-row score/gradient
+ops are elementwise, and DART keeps the padded drop-matmul shape.
+
+Tier-1 wall budget: binary parity + block-edge invariance + the memory
+guard + checkpoint resume run in tier-1; the heavier multiclass / DART
+variants are ``slow``-marked (full-suite coverage; the streamed code
+path they exercise is shared with the binary pin).
+"""
+
+import os
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import lightgbmv1_tpu as lgb
+from lightgbmv1_tpu.utils.log import LightGBMError
+
+BASE = {
+    "num_leaves": 12, "learning_rate": 0.1, "min_data_in_leaf": 5,
+    "verbosity": -1, "tree_growth": "leafwise_masked", "seed": 7,
+}
+
+
+def make_data(n=600, f=10, seed=3, n_class=None):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, f)
+    X[:, 7] = rng.randint(0, 6, n)          # categorical
+    X[rng.rand(n) < 0.1, 2] = np.nan        # missing
+    if n_class:
+        y = rng.randint(0, n_class, n).astype(float)
+    else:
+        y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(float)
+    return X, y
+
+
+def train_text(params, X, y, Xv=None, yv=None, rounds=6):
+    ds = lgb.Dataset(X, label=y, params=dict(params),
+                     categorical_feature=[7])
+    valid = None
+    evals = {}
+    if Xv is not None:
+        valid = [ds.create_valid(Xv, label=yv)]
+    bst = lgb.train(dict(params), ds, num_boost_round=rounds,
+                    valid_sets=valid, evals_result=evals,
+                    verbose_eval=False)
+    return bst.model_to_string(), evals, bst
+
+
+def test_stream_parity_binary_full_features():
+    """Binary + bagging + feature_fraction + categorical + NaN + a valid
+    set, streamed in ragged 96-row blocks: byte-identical model text AND
+    identical per-iteration valid metrics."""
+    X, y = make_data(n=450)
+    Xv, yv = make_data(n=150, seed=9)
+    params = {**BASE, "objective": "binary", "bagging_fraction": 0.7,
+              "bagging_freq": 2, "feature_fraction": 0.8,
+              "metric": "binary_logloss"}
+    t_res, ev_res, _ = train_text(params, X, y, Xv, yv, rounds=5)
+    p2 = {**params, "stream_enable": True, "stream_block_rows": 96}
+    t_str, ev_str, bst = train_text(p2, X, y, Xv, yv, rounds=5)
+    assert t_res == t_str
+    assert ev_res == ev_str
+    from lightgbmv1_tpu.models.gbdt_stream import StreamingGBDT
+
+    assert isinstance(bst._gbdt, StreamingGBDT)
+
+
+@pytest.mark.slow
+def test_stream_parity_multiclass():
+    X, y = make_data(n=450, n_class=3)
+    Xv, yv = make_data(n=150, seed=11, n_class=3)
+    params = {**BASE, "objective": "multiclass", "num_class": 3,
+              "num_leaves": 8}
+    t_res, _, _ = train_text(params, X, y, Xv, yv, rounds=5)
+    p2 = {**params, "stream_enable": True, "stream_block_rows": 128}
+    t_str, _, _ = train_text(p2, X, y, Xv, yv, rounds=5)
+    assert t_res == t_str
+
+
+@pytest.mark.slow
+def test_stream_parity_dart():
+    """DART with real drops (drop_rate 0.5 over 8 rounds) + bagging + a
+    valid set: the streamed drop removal/restore (recorded leaf-id
+    gathers, padded drop matmul) must reproduce the resident fused DART
+    iteration byte-for-byte."""
+    X, y = make_data()
+    Xv, yv = make_data(n=200, seed=9)
+    params = {**BASE, "objective": "binary", "boosting": "dart",
+              "drop_rate": 0.5, "bagging_fraction": 0.8,
+              "bagging_freq": 1, "metric": "binary_logloss"}
+    t_res, ev_res, _ = train_text(params, X, y, Xv, yv, rounds=8)
+    p2 = {**params, "stream_enable": True, "stream_block_rows": 96}
+    t_str, ev_str, bst = train_text(p2, X, y, Xv, yv, rounds=8)
+    assert t_res == t_str
+    assert ev_res == ev_str
+    from lightgbmv1_tpu.models.gbdt_stream import StreamingDART
+
+    assert isinstance(bst._gbdt, StreamingDART)
+
+
+@pytest.mark.slow
+def test_stream_block_edges_and_disk_cache(tmp_path):
+    """Block-boundary edges: ragged tail, single-block degenerate,
+    block_rows > N — every block size produces the SAME bytes (the
+    scatter fold is block-boundary-invariant), from memory and from a
+    digest-verified disk cache.  Tier-1 keeps the cache-format edge
+    cases (test_stream_cache.py) + the CLI disk-cache training smoke;
+    this heavier all-block-sizes sweep runs in the full suite."""
+    X, y = make_data(n=300)
+    params = {**BASE, "objective": "binary"}
+    t_res, _, _ = train_text(params, X, y, rounds=3)
+    for block_rows in (97, 300, 1000):
+        p2 = {**params, "stream_enable": True,
+              "stream_block_rows": block_rows}
+        t_str, _, _ = train_text(p2, X, y, rounds=3)
+        assert t_str == t_res, f"block_rows={block_rows}"
+    # disk cache path (written blocks, digest-verified loads)
+    ds = lgb.Dataset(X, label=y, params=dict(params),
+                     categorical_feature=[7])
+    cache = str(tmp_path / "blocks")
+    ds.save_block_cache(cache, block_rows=97)
+    bst = lgb.train(dict(params), lgb.Dataset(cache, params=dict(params)),
+                    num_boost_round=3, verbose_eval=False)
+    assert bst.model_to_string() == t_res
+
+
+def test_hist_accum_continues_resident_fold():
+    """Unit pin of the parity mechanism: folding blocks into the scatter
+    accumulator reproduces the resident full-matrix pass BIT-exactly at
+    ANY block split (scatter-add applies updates in row order), and the
+    ordered root-sum fold continues the same way."""
+    import jax.numpy as jnp
+
+    from lightgbmv1_tpu.ops.histogram import (hist_one_leaf,
+                                              hist_one_leaf_accum,
+                                              sums_accum)
+
+    rng = np.random.RandomState(0)
+    N, F, B = 500, 4, 8
+    bins = rng.randint(0, B, (F, N)).astype(np.uint8)
+    g3 = rng.randn(N, 3).astype(np.float32)
+    lid = rng.randint(0, 2, N).astype(np.int32)
+    full = np.asarray(hist_one_leaf(jnp.asarray(bins), jnp.asarray(g3),
+                                    jnp.asarray(lid), jnp.asarray(0), B))
+    for block in (64, 100, 500, 1000):
+        acc = jnp.zeros((F, B, 3), jnp.float32)
+        rs = jnp.zeros((1, 3), jnp.float32)
+        for a in range(0, N, block):
+            b = min(a + block, N)
+            acc = hist_one_leaf_accum(acc, jnp.asarray(bins[:, a:b]),
+                                      jnp.asarray(g3[a:b]),
+                                      jnp.asarray(lid[a:b]),
+                                      jnp.asarray(0), B)
+            rs = sums_accum(rs, jnp.asarray(g3[a:b]))
+        assert np.array_equal(full, np.asarray(acc)), block
+        # the ordered scatter fold is block-invariant too
+        one = sums_accum(jnp.zeros((1, 3), jnp.float32), jnp.asarray(g3))
+        assert np.array_equal(np.asarray(rs), np.asarray(one)), block
+
+
+@pytest.mark.slow
+def test_stream_parity_onehot_single_block():
+    """The onehot (MXU) histogram method streams bit-exactly when block
+    boundaries align with its 16384-row accumulation chunks — trivially
+    true for the single-block degenerate case pinned here (CPU-sized);
+    the general alignment rule is documented in BASELINE.md.  Slow-marked
+    for the tier-1 wall: the streamed-fold mechanism itself is pinned in
+    tier-1 by test_hist_accum_continues_resident_fold."""
+    X, y = make_data(n=200)
+    params = {**BASE, "objective": "binary", "hist_method": "onehot",
+              "num_leaves": 6}
+    t_res, _, _ = train_text(params, X, y, rounds=2)
+    p2 = {**params, "stream_enable": True, "stream_block_rows": 4096}
+    t_str, _, _ = train_text(p2, X, y, rounds=2)
+    assert t_res == t_str
+
+
+def test_stream_memory_guard():
+    """THE bounded-memory contract: ledger-accounted peak device bytes
+    scale with stream_block_rows, NOT dataset rows — tripling the rows
+    at fixed block size leaves the peak unchanged, while growing the
+    block grows it; and the peak obeys the analytic
+    O(block_rows·F) + leaf-state bound."""
+    def peak_for(n, block_rows):
+        rng = np.random.RandomState(0)
+        X = rng.randn(n, 20)
+        y = (X[:, 0] > 0).astype(float)
+        params = {**BASE, "objective": "binary", "num_leaves": 7,
+                  "max_bin": 15, "stream_enable": True,
+                  "stream_block_rows": block_rows}
+        ds = lgb.Dataset(X, label=y, params=dict(params))
+        bst = lgb.train(dict(params), ds, num_boost_round=1,
+                        verbose_eval=False)
+        return bst._gbdt.stream_peak_device_bytes
+
+    # two runs with IDENTICAL shapes (only the block count differs), so
+    # the second prices a run, not a recompile — tier-1 wall discipline
+    p_small = peak_for(2048, 256)
+    p_big_n = peak_for(6144, 256)
+    # rows tripled, block fixed: peak identical — device memory does not
+    # scale with dataset rows
+    assert p_big_n == p_small
+    # analytic bound: leaf-sized state (pool + accumulators for L=7,
+    # F=20, padded B=16) + 2 double-buffered blocks (bins + g3 + lid);
+    # the block term dominating the bound is what stream_block_rows
+    # scaling means (the BENCH stream_mem_ok guard re-checks the bound
+    # at 4096-row blocks every capture)
+    F, B, L = 20, 16, 7
+    for n, block, peak in ((2048, 256, p_small), (6144, 256, p_big_n)):
+        bound = (L + 3) * F * B * 3 * 4 + 4 * block * (F + 16) + 64 * 1024
+        assert peak <= bound, (n, block, peak, bound)
+        # and the peak genuinely contains the per-block transfers
+        assert peak > 2 * block * F
+
+
+@pytest.mark.slow
+def test_stream_checkpoint_resume_bit_exact(tmp_path):
+    """Streaming + kill-at-k + resume (composes with the PR 6 bundles):
+    the resumed streamed run's final model text is byte-identical to the
+    uninterrupted streamed run.  Slow-marked for the tier-1 wall (the
+    PR 6 binary resume pin stays in tier-1; the restore path here is the
+    same io/checkpoint machinery plus the np-score/lid overrides, which
+    test_stream_parity_binary_full_features exercises every tier-1 run
+    via the identical state plumbing)."""
+    # N a multiple of the block size and the same (num_leaves, shapes) as
+    # the parity test above: the per-block jits are already compiled, so
+    # this test prices three streamed RUNS, not three compiles.  Resident
+    # parity of this exact config class is pinned by the tests above; the
+    # property under test here is straight == kill-at-k + resume.
+    X, y = make_data(n=288)
+    params = {**BASE, "objective": "binary",
+              "feature_fraction": 0.7, "bagging_fraction": 0.8,
+              "bagging_freq": 1, "stream_enable": True,
+              "stream_block_rows": 96}
+    t_straight, _, _ = train_text(params, X, y, rounds=4)
+
+    part = lgb.train(dict(params),
+                     lgb.Dataset(X, label=y, params=dict(params),
+                                 categorical_feature=[7]),
+                     num_boost_round=2, verbose_eval=False)
+    ckpt = str(tmp_path / "state.ckpt")
+    part.save_checkpoint(ckpt)
+    del part
+    resumed = lgb.train(dict(params),
+                        lgb.Dataset(X, label=y, params=dict(params),
+                                    categorical_feature=[7]),
+                        num_boost_round=2, init_model=ckpt,
+                        verbose_eval=False)
+    assert resumed.model_to_string() == t_straight
+
+
+@pytest.mark.slow
+def test_stream_checkpoint_resume_dart(tmp_path):
+    """DART streaming resume: drop RNG, tree weights and the recorded
+    leaf assignments restore host-side; resumed text byte-identical."""
+    X, y = make_data(n=400)
+    params = {**BASE, "objective": "binary", "boosting": "dart",
+              "drop_rate": 0.5, "stream_enable": True,
+              "stream_block_rows": 128}
+    t_straight, _, _ = train_text(params, X, y, rounds=6)
+    part = lgb.train(dict(params),
+                     lgb.Dataset(X, label=y, params=dict(params),
+                                 categorical_feature=[7]),
+                     num_boost_round=3, verbose_eval=False)
+    ckpt = str(tmp_path / "state.ckpt")
+    part.save_checkpoint(ckpt)
+    resumed = lgb.train(dict(params),
+                        lgb.Dataset(X, label=y, params=dict(params),
+                                    categorical_feature=[7]),
+                        num_boost_round=3, init_model=ckpt,
+                        verbose_eval=False)
+    assert resumed.model_to_string() == t_straight
+
+
+def test_stream_rejects_unsupported_configs():
+    """Not-streamable configurations fail LOUDLY at construction, never
+    silently train something else."""
+    X, y = make_data(n=200)
+    base = {**BASE, "objective": "binary", "stream_enable": True,
+            "stream_block_rows": 64}
+
+    def build(extra, y_=y, group=None):
+        p = {**base, **extra}
+        ds = lgb.Dataset(X, label=y_, group=group, params=dict(p))
+        return lgb.train(p, ds, num_boost_round=1, verbose_eval=False)
+
+    with pytest.raises(LightGBMError, match="streaming"):
+        build({"boosting": "goss"})
+    with pytest.raises(LightGBMError, match="streaming"):
+        build({"tree_learner": "data"})
+    with pytest.raises(LightGBMError, match="leaf-wise"):
+        build({"tree_growth": "levelwise"})
+    with pytest.raises(LightGBMError):
+        build({"objective": "lambdarank"},
+              y_=np.clip(y, 0, 3), group=np.full(8, 25))
+    with pytest.raises(LightGBMError, match="renews leaf values"):
+        build({"objective": "regression_l1"})
+    with pytest.raises(LightGBMError, match="fobj"):
+        ds = lgb.Dataset(X, label=y, params=dict(base))
+        lgb.train(dict(base), ds, num_boost_round=1,
+                  fobj=lambda preds, d: (preds, np.ones_like(preds)),
+                  verbose_eval=False)

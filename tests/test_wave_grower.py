@@ -38,8 +38,13 @@ def test_wave1_matches_sequential(params):
     computes both children directly), so near-tie splits may flip in later
     trees; the first tree must match structurally split-for-split, and the
     whole 5-tree model must agree on quality.
+
+    n=2000: the schedule property is size-independent (the documented-
+    arbitrary 4000-row scale was shrunk at constant structure for the
+    tier-1 wall budget, the PR-6/7 discipline; the slow multiclass
+    variant below keeps a bigger shape in the full suite).
     """
-    X, y = make_problem()
+    X, y = make_problem(n=2000)
     params = {**params, "verbosity": -1}
     a = lgb.train({**params, "tree_growth": "leafwise_serial"},
                   lgb.Dataset(X, label=y, categorical_feature=[7]),

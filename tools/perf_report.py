@@ -228,6 +228,47 @@ def serving_section(w, rec):
         w("")
 
 
+def streaming_section(w, rec):
+    """Streaming: the out-of-core block-cache trainer record (PR 8 —
+    bench.py measure_stream, data/ subsystem).  Every figure greps to a
+    BENCH stream_* field; placeholder until the first capture carrying
+    them."""
+    w("## Streaming (out-of-core row-block training, data/ block cache)")
+    w("")
+    if rec.get("stream_ok") is None:
+        w("No stream fields in this record yet — the next driver capture "
+          "runs bench.py's measure_stream (sharded block cache written "
+          "once, row-block streaming trainer vs the resident trainer at "
+          "the same sequential schedule) and this section renders the "
+          "per-iteration clocks, the ledger-accounted peak device bytes "
+          "against the O(stream_block_rows · F) bound, and the "
+          "`stream_ok` guard (byte-identical model text AND bounded "
+          "memory).")
+        w("")
+        return
+    w(f"{get(rec, 'stream_rows', 0)} rows streamed in "
+      f"{get(rec, 'stream_block_rows', 0)}-row blocks:")
+    w("")
+    w("| stream ms/iter | resident ms/iter | ratio | peak device bytes | "
+      "bound | resident matrix bytes |")
+    w("|---|---|---|---|---|---|")
+    w(f"| {get(rec, 'stream_ms_per_iter', 2)} | "
+      f"{get(rec, 'stream_resident_ms_per_iter', 2)} | "
+      f"{get(rec, 'stream_vs_resident_ratio', 3)} | "
+      f"{get(rec, 'stream_peak_device_bytes', 0)} | "
+      f"{get(rec, 'stream_peak_device_bound_bytes', 0)} | "
+      f"{get(rec, 'stream_resident_matrix_bytes', 0)} |")
+    w("")
+    w(f"Guard `stream_ok={rec.get('stream_ok')}`: model text "
+      f"byte-identical to the resident trainer "
+      f"(`stream_parity_ok={rec.get('stream_parity_ok')}` — the fixed-"
+      "block-order parity contract, BASELINE.md) AND ledger-accounted "
+      "peak device bytes within the analytic block-scaled bound "
+      f"(`stream_mem_ok={rec.get('stream_mem_ok')}`): the device "
+      "working set scales with `stream_block_rows`, not dataset rows.")
+    w("")
+
+
 def robustness_section(w, rec):
     """Robustness: the scripted chaos-suite record (PR 6 — bench.py
     measure_chaos via tools/chaos.py).  Each row is one injected-fault
@@ -507,6 +548,8 @@ def generate(rec, name, prev=None, prev_name=None):
     prediction_section(w, rec)
 
     serving_section(w, rec)
+
+    streaming_section(w, rec)
 
     robustness_section(w, rec)
 
