@@ -35,6 +35,37 @@ from .utils.log import LightGBMError, log_fatal, log_info, log_warning
 _NATIVE_PREDICT_MIN_WORK = 500_000
 
 
+class _IterObs:
+    """Lazily bound per-iteration training telemetry (obs registry)."""
+
+    __slots__ = ("hist", "count")
+
+    def __init__(self):
+        from .obs.metrics import default_registry
+
+        reg = default_registry()
+        self.hist = reg.histogram(
+            "train_iteration_ms", "Wall time of one boosting iteration",
+            buckets=(1, 2, 5, 10, 20, 50, 100, 200, 500, 1000, 2000,
+                     5000, 10000, 60000))
+        self.count = reg.counter(
+            "train_iterations_total", "Boosting iterations completed")
+
+    def observe(self, ms: float) -> None:
+        self.hist.observe(ms)
+        self.count.inc()
+
+
+_obs_iter: Optional[_IterObs] = None
+
+
+def _obs_iteration_metrics() -> _IterObs:
+    global _obs_iter
+    if _obs_iter is None:
+        _obs_iter = _IterObs()
+    return _obs_iter
+
+
 def _is_scipy_sparse(data) -> bool:
     return type(data).__module__.split(".")[0] == "scipy" and hasattr(
         data, "tocsr")
@@ -479,10 +510,13 @@ class Booster:
                fobj: Optional[Callable] = None) -> bool:
         """One boosting iteration; returns True when no further splits are
         possible (reference basic.py:2315 update / __boost :2381)."""
+        from .obs import trace
+
         if self._gbdt is None:
             log_fatal("Cannot update a loaded model")
         if train_set is not None:
             log_fatal("Resetting train_set is not supported")
+        t0_ns = trace.now_ns()
         if fobj is None:
             finished = self._gbdt.train_one_iter()
         else:
@@ -496,6 +530,14 @@ class Booster:
         # finite_guard=warn|raise: one scalar device read per iteration
         # boundary; off (default) costs nothing (models/gbdt.py)
         self._gbdt.check_finite_boundary()
+        # observability: per-iteration wall into the shared registry
+        # (always on — one histogram observe vs a ms-scale iteration);
+        # an armed tracer additionally gets the iteration span (+ the
+        # estimated phase children when a profile is installed)
+        _obs_iteration_metrics().observe(
+            (trace.now_ns() - t0_ns) / 1e6)
+        if trace.enabled():
+            trace.iteration_span_end(t0_ns, self._gbdt.iter - 1)
         return finished
 
     def rollback_one_iter(self) -> "Booster":

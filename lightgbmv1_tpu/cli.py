@@ -214,11 +214,40 @@ def run_train(config: Config) -> Booster:
     n_iter = max(config.num_iterations - done_iters, 0)
     t0 = time.time()
     profiling = False
+    tracing = False
+    if config.obs_trace or config.trace_out:
+        # host-side span tracer (obs/trace.py); composes with the jax
+        # profiler knob below — profile_dir captures the DEVICE trace,
+        # trace_out the HOST span timeline (documented precedence: both
+        # write their own artifact; neither disables the other)
+        from .obs import trace as obs_trace
+
+        obs_trace.arm(ring_events=config.obs_ring_events)
+        tracing = True
     if config.profile_dir:
         import jax
 
         jax.profiler.start_trace(config.profile_dir)
         profiling = True
+
+    def _finish_trace():
+        # export + disarm exactly once — on clean completion (after the
+        # final model save, so its materialization span is captured) or
+        # on the way out of a dying run (partial trace beats none)
+        nonlocal tracing
+        if not tracing:
+            return
+        tracing = False
+        from .obs import trace as obs_trace
+
+        if config.trace_out:
+            doc = obs_trace.export_chrome(config.trace_out)
+            log_info(f"Wrote host span trace to {config.trace_out} "
+                     f"({len(doc['traceEvents'])} events, "
+                     f"{doc['otherData']['dropped_events']} dropped; "
+                     "open at https://ui.perfetto.dev)")
+        obs_trace.disarm()
+
     try:
         for i in range(n_iter):
             finished = booster.update()
@@ -255,14 +284,23 @@ def run_train(config: Config) -> Booster:
                 faults.fire("snapshot", site=str(total_i))
             if finished:
                 break
+    except BaseException:
+        _finish_trace()
+        raise
     finally:
         if profiling:
             import jax
 
             jax.profiler.stop_trace()
             log_info(f"Wrote device trace to {config.profile_dir}")
-    if config.output_model:
-        booster.save_model(config.output_model)
+    try:
+        if config.output_model:
+            # still inside the traced region: the final model save
+            # (host-tree materialization + model-text write) is part of
+            # the run's timeline
+            booster.save_model(config.output_model)
+    finally:
+        _finish_trace()
     log_info("Finished training")
     return booster
 
@@ -358,6 +396,14 @@ def run_serve(config: Config):
 
     if not config.input_model:
         log_fatal("No model file: set input_model=<file>")
+    tracing = False
+    if config.obs_trace or config.trace_out:
+        # same knob as task=train: arm the span tracer for the serving
+        # window; trace_out (when set) gets the Chrome JSON at shutdown
+        from .obs import trace as obs_trace
+
+        obs_trace.arm(ring_events=config.obs_ring_events)
+        tracing = True
     booster = Booster(params=_config_to_params(config),
                       model_file=config.input_model)
     server = build_server(booster, config)
@@ -378,6 +424,14 @@ def run_serve(config: Config):
         http.shutdown()
         snap = server.metrics_snapshot()
         server.close()
+        if tracing:
+            from .obs import trace as obs_trace
+
+            if config.trace_out:
+                doc = obs_trace.export_chrome(config.trace_out)
+                log_info(f"serve: wrote span trace to {config.trace_out} "
+                         f"({len(doc['traceEvents'])} events)")
+            obs_trace.disarm()
         log_info("serve: final metrics " + _json.dumps(snap))
     return server, http
 

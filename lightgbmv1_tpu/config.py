@@ -488,6 +488,23 @@ class Config:
                                    # training here; hist/split/partition
                                    # phases carry lgbm.* named scopes (the
                                    # USE_TIMETAG analog, utils/common.h)
+    # -- observability (obs/ subsystem) --------------------------------
+    # arm the host-side span tracer (obs/trace.py) for the run: nested
+    # spans (iteration / streaming block pipeline / checkpoint / serve
+    # request legs) into a bounded ring, exported as Chrome trace-event
+    # JSON.  HARD-OFF by default: the disarmed path is one flag check.
+    obs_trace: bool = False
+    # task=train: write the Chrome trace JSON here at the end of the run
+    # (atomic tmp+fsync+rename, fileio.atomic_write_bytes).  Setting it
+    # implies obs_trace=true.  Composes with profile_dir — profile_dir
+    # captures the DEVICE trace via jax.profiler, trace_out the HOST
+    # span timeline; set both to line the two up in Perfetto.  When both
+    # tracers would contend (they don't share state), profile_dir wins
+    # nothing: precedence is simply "each writes its own artifact".
+    trace_out: str = ""
+    # span ring capacity while armed; the OLDEST events are overwritten
+    # under sustained load and the export reports the dropped count
+    obs_ring_events: int = 65536
 
     # -- IO -----------------------------------------------------------------
     max_bin: int = 255
@@ -646,6 +663,12 @@ class Config:
         if self.snapshot_keep < 2:
             raise ValueError("snapshot_keep must be >= 2 (a torn newest "
                              "snapshot needs an intact predecessor)")
+        if self.obs_ring_events < 16:
+            raise ValueError("obs_ring_events must be >= 16")
+        if self.trace_out:
+            # the artifact path is the arming intent (documented knob
+            # precedence: trace_out implies obs_trace)
+            self.obs_trace = True
         if self.predict_cache_entries < 2:
             raise ValueError("predict_cache_entries must be >= 2 (the "
                              "walk and its score executable share a "

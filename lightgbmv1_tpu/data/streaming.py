@@ -33,6 +33,20 @@ from .block_cache import (BlockCacheError, load_manifest, read_block,
                           read_meta_arrays)
 
 
+_peak_gauge = None
+
+
+def _obs_peak_gauge():
+    global _peak_gauge
+    if _peak_gauge is None:
+        from ..obs.metrics import default_registry
+
+        _peak_gauge = default_registry().gauge(
+            "stream_peak_device_bytes",
+            "Ledger-accounted peak streaming device working set")
+    return _peak_gauge
+
+
 class DeviceLedger:
     """Named device-byte accounting for the streaming trainer.
 
@@ -61,6 +75,10 @@ class DeviceLedger:
             for t, b in self._live.values():
                 by_tag[t] = by_tag.get(t, 0) + b
             self.peak_tags = by_tag
+            # unified observability: the peak device working set is a
+            # first-class gauge (new-peak-only writes keep this off the
+            # per-block fast path)
+            _obs_peak_gauge().set(self.peak_bytes)
         return h
 
     def hold_array(self, tag: str, arr) -> int:

@@ -80,10 +80,34 @@ def decode_rng_state(d: Dict[str, Any]) -> tuple:
 # ---------------------------------------------------------------------------
 
 
+def _obs_ckpt_hist(name: str, help_text: str):
+    from ..obs.metrics import default_registry
+
+    return default_registry().histogram(
+        name, help_text,
+        buckets=(5, 10, 20, 50, 100, 200, 500, 1000, 2000, 5000, 10000))
+
+
 def write_checkpoint(path: str, manifest: Dict[str, Any],
                      arrays: Dict[str, np.ndarray], model_text: str,
                      base_model_text: str = "") -> None:
     """Serialize and atomically write one bundle."""
+    from ..obs import trace
+
+    t0_ns = trace.now_ns()
+    _write_checkpoint_impl(path, manifest, arrays, model_text,
+                           base_model_text)
+    ms = (trace.now_ns() - t0_ns) / 1e6
+    _obs_ckpt_hist("checkpoint_save_ms",
+                   "Wall time of one checkpoint-bundle write").observe(ms)
+    if trace.enabled():
+        trace.add_span("checkpoint.save", t0_ns, trace.now_ns() - t0_ns,
+                       cat="checkpoint", args={"path": str(path)})
+
+
+def _write_checkpoint_impl(path: str, manifest: Dict[str, Any],
+                           arrays: Dict[str, np.ndarray], model_text: str,
+                           base_model_text: str = "") -> None:
     buf = io.BytesIO()
     np.savez(buf, **arrays)
     arrays_bytes = buf.getvalue()
@@ -137,6 +161,20 @@ def load_checkpoint(path: str) -> Dict[str, Any]:
 
     Returns ``{"manifest", "arrays", "model_text", "base_model_text"}``.
     """
+    from ..obs import trace
+
+    t0_ns = trace.now_ns()
+    out = _load_checkpoint_impl(path)
+    ms = (trace.now_ns() - t0_ns) / 1e6
+    _obs_ckpt_hist("checkpoint_load_ms",
+                   "Wall time of one validated checkpoint load").observe(ms)
+    if trace.enabled():
+        trace.add_span("checkpoint.load", t0_ns, trace.now_ns() - t0_ns,
+                       cat="checkpoint", args={"path": str(path)})
+    return out
+
+
+def _load_checkpoint_impl(path: str) -> Dict[str, Any]:
     try:
         with fileio.open_file(str(path), "rb") as fh:
             raw = fh.read()

@@ -31,6 +31,7 @@ from ..config import Config
 from ..io.dataset import BinnedDataset
 from ..metrics import Metric, create_metrics
 from ..objectives import ObjectiveFunction, create_objective
+from ..obs import trace as obs_trace
 from ..ops.histogram import default_hist_method, hist_one_leaf
 from ..ops.split import SplitParams, make_feature_meta
 from ..utils.log import log_fatal, log_info, log_warning
@@ -545,6 +546,7 @@ class GBDT:
         ]))
         vscores = tuple(vs.score for vs in self._valid_scores)
         self._save_rollback_state()
+        t0_ns = obs_trace.now_ns()
         with global_timer.section("GBDT::TrainIters(dispatch)"):
             new_train, new_valid, trees, self._cegb_used = self._scan(
                 self._grow_binned, tuple(self._valid_binned),
@@ -555,6 +557,13 @@ class GBDT:
         self._train_scores.score = new_train
         for vs, s in zip(self._valid_scores, new_valid):
             vs.score = s
+        if obs_trace.enabled():
+            # the scanned block is ONE device dispatch — the host cannot
+            # see iteration boundaries inside it, so the trace carries
+            # one block span (args say how many iterations it amortized)
+            obs_trace.add_span(
+                "train.iterations", t0_ns, obs_trace.now_ns() - t0_ns,
+                cat="train", args={"n": n, "start_iter": int(self.iter)})
         for i in range(n):
             for k in range(K):
                 self._device_trees.append(
@@ -854,7 +863,9 @@ class GBDT:
         """Fetch all not-yet-materialized trees in one batched transfer."""
         idxs = [i for i, m in enumerate(self.models) if m is None]
         if idxs:
-            with global_timer.section("GBDT::MaterializeHostTrees"):
+            with obs_trace.span("train.materialize_host_trees",
+                                cat="train"), \
+                    global_timer.section("GBDT::MaterializeHostTrees"):
                 fetched = jax.device_get([self._device_trees[i] for i in idxs])
             for i, arrays in zip(idxs, fetched):
                 ht = HostTree(arrays)

@@ -372,15 +372,22 @@ class StreamGrower:
     def _upload(self, i: int, g3_host, lid_host=None):
         """device_put one block's shards (async — the double-buffer leg);
         returns (bins, g3, lid, handles)."""
+        from ..obs import trace
+
         a, b = self.source.ranges[i]
-        bins = jax.device_put(self.source.load_block(i))
-        g3 = jax.device_put(np.ascontiguousarray(g3_host[a:b]))
-        handles = [self.ledger.hold_array("block_bins", bins),
-                   self.ledger.hold_array("block_g3", g3)]
-        lid = None
-        if lid_host is not None:
-            lid = jax.device_put(np.ascontiguousarray(lid_host[a:b]))
-            handles.append(self.ledger.hold_array("block_lid", lid))
+        with trace.span("stream.fetch_block", cat="stream",
+                        args={"block": i} if trace.enabled() else None):
+            blk = self.source.load_block(i)
+        with trace.span("stream.h2d_block", cat="stream",
+                        args={"block": i} if trace.enabled() else None):
+            bins = jax.device_put(blk)
+            g3 = jax.device_put(np.ascontiguousarray(g3_host[a:b]))
+            handles = [self.ledger.hold_array("block_bins", bins),
+                       self.ledger.hold_array("block_g3", g3)]
+            lid = None
+            if lid_host is not None:
+                lid = jax.device_put(np.ascontiguousarray(lid_host[a:b]))
+                handles.append(self.ledger.hold_array("block_lid", lid))
         return bins, g3, lid, handles
 
     def _release(self, handles):
@@ -396,6 +403,8 @@ class StreamGrower:
         """Run ``fn(i, a, b, bins, g3, lid)`` per block with the next
         block's H2D transfer in flight behind the current block's compute
         (the PR-4 chunked double-buffer pattern)."""
+        from ..obs import trace
+
         nb = self.source.num_blocks
         nxt = None
         for i in range(nb):
@@ -405,7 +414,10 @@ class StreamGrower:
                    if (self.prefetch and i + 1 < nb) else None)
             bins, g3, lid, handles = cur
             a, b = self.source.ranges[i]
-            fn(i, a, b, bins, g3, lid)
+            with trace.span("stream.accumulate", cat="stream",
+                            args=({"block": i, "rows": b - a}
+                                  if trace.enabled() else None)):
+                fn(i, a, b, bins, g3, lid)
             self._release(handles)
 
     def _zero_hist(self, tag):

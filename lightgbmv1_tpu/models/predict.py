@@ -379,6 +379,26 @@ def serving_leaf_binned(sm: ServingArrays, codes, n_steps: int,
 # ---------------------------------------------------------------------------
 
 
+_obs_cache = {}
+
+
+def _obs_cache_counter(event: str):
+    """Process-wide predictor-cache counters in the unified registry
+    (``predict_cache_events_total{event=hits|misses|evictions}``) — the
+    per-instance ``cache_info()`` integers stay the test surface; these
+    aggregate across predictors for scraping."""
+    c = _obs_cache.get(event)
+    if c is None:
+        from ..obs.metrics import default_registry
+
+        metric = default_registry().counter(
+            "predict_cache_events_total",
+            "Compiled-walk cache hits/misses/evictions",
+            label_names=("event",))
+        c = _obs_cache[event] = metric.labels(event=event)
+    return c
+
+
 def _next_pow2(n: int) -> int:
     return 1 << max(int(n) - 1, 0).bit_length()
 
@@ -481,8 +501,10 @@ class BatchPredictor:
         if fn is not None:
             self._cache.move_to_end(key)
             self.cache_hits += 1
+            _obs_cache_counter("hits").inc()
         else:
             self.cache_misses += 1
+            _obs_cache_counter("misses").inc()
         return fn
 
     def _cache_put(self, key, fn):
@@ -491,6 +513,7 @@ class BatchPredictor:
         while len(self._cache) > self.cache_capacity:
             self._cache.popitem(last=False)
             self.cache_evictions += 1
+            _obs_cache_counter("evictions").inc()
         return fn
 
     def cache_stats(self) -> Dict[str, int]:

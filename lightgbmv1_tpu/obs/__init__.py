@@ -1,0 +1,30 @@
+"""Unified observability layer (span tracing + one metrics registry).
+
+Three pillars (ISSUE 9), replacing the five one-off telemetry mechanisms
+that grew PR by PR (phase timers, JSON-only serve counters, analytic comm
+tables, the streaming DeviceLedger, differential attribution) with one
+schema that crosses the train/serve boundary:
+
+* :mod:`~lightgbmv1_tpu.obs.trace` — a low-overhead nested-span tracer
+  (thread-local span stack, monotonic clocks, ring-buffered events,
+  hard-off by default) exporting Chrome trace-event JSON viewable in
+  Perfetto; serving requests carry a propagated trace id end to end.
+* :mod:`~lightgbmv1_tpu.obs.metrics` — counters / gauges / histograms
+  with labels in one registry; JSON snapshots for the existing BENCH
+  plumbing and Prometheus text exposition for everything else.
+* ``tools/bench_trend.py`` — the regression sentinel over the
+  ``BENCH_r*.json`` / ``MULTICHIP_r*.json`` trajectory (guard flips and
+  >10% regressions exit non-zero so captures can be gated).
+
+Contract: tracing is OFF by default and its off-path must cost nothing
+measurable (one module-level flag check, no allocation); armed tracing
+must stay within 2% of train wall (the BENCH ``obs_ok`` guard measures
+both).  Metrics are always on — counter bumps are nanoseconds against
+millisecond iterations and requests.
+"""
+
+from . import metrics, trace
+from .metrics import Registry, default_registry
+from .trace import span
+
+__all__ = ["metrics", "trace", "Registry", "default_registry", "span"]

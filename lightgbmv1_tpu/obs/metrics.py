@@ -1,0 +1,340 @@
+"""One metrics registry: counters / gauges / histograms with labels.
+
+The pre-obs repo had four disjoint metric surfaces (serve JSON counters,
+BENCH record fields, analytic comm tables, the streaming DeviceLedger);
+this module is the single schema they now publish through.  Two read
+surfaces, one store:
+
+* ``snapshot()`` — a flat JSON-able dict (the existing BENCH / serve
+  plumbing keeps consuming JSON);
+* ``prometheus_text()`` — Prometheus text exposition (format 0.0.4:
+  ``# HELP`` / ``# TYPE`` headers, escaped label values, cumulative
+  ``_bucket{le=...}`` histogram series ending at ``+Inf``), served by
+  ``GET /metrics`` content negotiation in serve/http.py.
+
+Design constraints:
+
+* **Thread-safe, cheap writes.**  One registry lock guards structure
+  (metric creation); each metric carries its own lock for value updates
+  — an ``inc()`` is a lock + float add, nanoseconds against the
+  millisecond requests and iterations it counts, so metrics stay ON
+  always (unlike tracing, which is opt-in).
+* **Get-or-create registration.**  ``registry.counter(name, ...)``
+  returns the existing metric when the name is already registered —
+  module-level instrumentation can run under re-imports and repeated
+  server construction without double-registration errors.
+* **Exact quantiles where the consumer needs them.**  A histogram may
+  keep a bounded window of raw observations (``sample_window``) from
+  which ``quantile(q)`` answers exactly over the window — the serving
+  p999 and loadgen latency figures keep their existing precision while
+  the bucket counts feed Prometheus.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+# Default latency buckets (ms): roughly logarithmic from sub-ms to 10 s.
+DEFAULT_MS_BUCKETS = (0.5, 1, 2, 5, 10, 20, 50, 100, 200, 500,
+                      1000, 2000, 5000, 10000)
+
+
+def escape_label_value(v: str) -> str:
+    """Prometheus text-format label escaping: backslash, quote, newline."""
+    return (str(v).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def _fmt_value(v: float) -> str:
+    f = float(v)
+    if f == int(f) and abs(f) < 1e15:
+        return str(int(f))
+    return repr(f)
+
+
+def _label_str(names: Sequence[str], values: Sequence[str]) -> str:
+    if not names:
+        return ""
+    inner = ",".join(f'{n}="{escape_label_value(v)}"'
+                     for n, v in zip(names, values))
+    return "{" + inner + "}"
+
+
+class _Child:
+    """One labeled time series of a metric."""
+
+    __slots__ = ("_metric", "_key", "value", "sum", "count", "buckets",
+                 "_window", "_wpos")
+
+    def __init__(self, metric: "_Metric", key: Tuple[str, ...]):
+        self._metric = metric
+        self._key = key
+        self.value = 0.0
+        self.sum = 0.0
+        self.count = 0
+        self.buckets = ([0] * len(metric.bucket_bounds)
+                        if metric.kind == "histogram" else None)
+        self._window: List[float] = []
+        self._wpos = 0
+
+    # -- counter / gauge -------------------------------------------------
+    def inc(self, amount: float = 1.0) -> None:
+        if self._metric.kind == "counter" and amount < 0:
+            raise ValueError("counters only go up (use a gauge)")
+        with self._metric.lock:
+            self.value += amount
+
+    def set(self, value: float) -> None:
+        if self._metric.kind != "gauge":
+            raise ValueError(f"set() on a {self._metric.kind}")
+        with self._metric.lock:
+            self.value = float(value)
+
+    def set_max(self, value: float) -> None:
+        """Gauge high-water-mark helper (queue_depth_max and friends)."""
+        if self._metric.kind != "gauge":
+            raise ValueError(f"set_max() on a {self._metric.kind}")
+        with self._metric.lock:
+            if value > self.value:
+                self.value = float(value)
+
+    def get(self) -> float:
+        with self._metric.lock:
+            return self.value
+
+    # -- histogram -------------------------------------------------------
+    def observe(self, value: float) -> None:
+        if self._metric.kind != "histogram":
+            raise ValueError(f"observe() on a {self._metric.kind}")
+        v = float(value)
+        m = self._metric
+        with m.lock:
+            self.sum += v
+            self.count += 1
+            for i, ub in enumerate(m.bucket_bounds):
+                if v <= ub:
+                    self.buckets[i] += 1
+                    break
+            else:
+                pass   # lands only in +Inf (the implicit final bucket)
+            w = m.sample_window
+            if w:
+                if len(self._window) < w:
+                    self._window.append(v)
+                else:
+                    self._window[self._wpos] = v
+                    self._wpos = (self._wpos + 1) % w
+
+    def quantile(self, q: float) -> Optional[float]:
+        """Exact quantile over the retained sample window (None when the
+        histogram keeps no window or saw no observations)."""
+        with self._metric.lock:
+            vals = sorted(self._window)
+        if not vals:
+            return None
+        i = min(int(q * len(vals)), len(vals) - 1)
+        return vals[i]
+
+    def window_len(self) -> int:
+        with self._metric.lock:
+            return len(self._window)
+
+    def _reset(self) -> None:
+        self.value = 0.0
+        self.sum = 0.0
+        self.count = 0
+        if self.buckets is not None:
+            self.buckets = [0] * len(self.buckets)
+        self._window = []
+        self._wpos = 0
+
+
+class _Metric:
+    def __init__(self, name: str, help_text: str, kind: str,
+                 label_names: Sequence[str] = (),
+                 buckets: Sequence[float] = (),
+                 sample_window: int = 0):
+        self.name = name
+        self.help = help_text
+        self.kind = kind
+        self.label_names = tuple(label_names)
+        self.bucket_bounds = tuple(sorted(float(b) for b in buckets))
+        self.sample_window = int(sample_window)
+        self.lock = threading.Lock()
+        self._children: Dict[Tuple[str, ...], _Child] = {}
+        if not self.label_names:
+            self._children[()] = _Child(self, ())
+
+    def labels(self, **kv: str) -> _Child:
+        if set(kv) != set(self.label_names):
+            raise ValueError(
+                f"{self.name}: labels() got {sorted(kv)}, declared "
+                f"{sorted(self.label_names)}")
+        key = tuple(str(kv[n]) for n in self.label_names)
+        with self.lock:
+            child = self._children.get(key)
+            if child is None:
+                child = self._children[key] = _Child(self, key)
+            return child
+
+    # bare-metric convenience (unlabeled): forward to the () child
+    def _solo(self) -> _Child:
+        if self.label_names:
+            raise ValueError(f"{self.name} has labels "
+                             f"{self.label_names}; use .labels()")
+        return self._children[()]
+
+    def inc(self, amount: float = 1.0) -> None:
+        self._solo().inc(amount)
+
+    def set(self, value: float) -> None:
+        self._solo().set(value)
+
+    def set_max(self, value: float) -> None:
+        self._solo().set_max(value)
+
+    def get(self) -> float:
+        return self._solo().get()
+
+    def observe(self, value: float) -> None:
+        self._solo().observe(value)
+
+    def quantile(self, q: float) -> Optional[float]:
+        return self._solo().quantile(q)
+
+    def window_len(self) -> int:
+        return self._solo().window_len()
+
+    def children(self) -> List[Tuple[Tuple[str, ...], _Child]]:
+        with self.lock:
+            return sorted(self._children.items())
+
+
+class Registry:
+    """A set of named metrics; see the module docstring for the read
+    surfaces.  ``default_registry()`` is the process-wide instance the
+    trainer-side instrumentation publishes into; the serving subsystem
+    gives each ``Server`` its own (test isolation + one registry per
+    replica is the Prometheus model anyway)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._metrics: Dict[str, _Metric] = {}
+
+    def _register(self, name: str, help_text: str, kind: str,
+                  label_names: Sequence[str], buckets: Sequence[float] = (),
+                  sample_window: int = 0) -> _Metric:
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is not None:
+                if m.kind != kind or m.label_names != tuple(label_names):
+                    raise ValueError(
+                        f"metric {name!r} re-registered as {kind}"
+                        f"{tuple(label_names)}; existing is {m.kind}"
+                        f"{m.label_names}")
+                return m
+            m = _Metric(name, help_text, kind, label_names, buckets,
+                        sample_window)
+            self._metrics[name] = m
+            return m
+
+    def counter(self, name: str, help_text: str = "",
+                label_names: Sequence[str] = ()) -> _Metric:
+        return self._register(name, help_text, "counter", label_names)
+
+    def gauge(self, name: str, help_text: str = "",
+              label_names: Sequence[str] = ()) -> _Metric:
+        return self._register(name, help_text, "gauge", label_names)
+
+    def histogram(self, name: str, help_text: str = "",
+                  label_names: Sequence[str] = (),
+                  buckets: Sequence[float] = DEFAULT_MS_BUCKETS,
+                  sample_window: int = 0) -> _Metric:
+        return self._register(name, help_text, "histogram", label_names,
+                              buckets, sample_window)
+
+    def get(self, name: str) -> Optional[_Metric]:
+        with self._lock:
+            return self._metrics.get(name)
+
+    def _sorted_metrics(self) -> List[_Metric]:
+        with self._lock:
+            return [self._metrics[k] for k in sorted(self._metrics)]
+
+    def reset(self, names: Optional[Iterable[str]] = None) -> None:
+        """Zero the named metrics (all when ``names`` is None).  Serving
+        uses this for its bench-window reset; Prometheus counters are
+        conceptually monotonic, so production exporters should not."""
+        wanted = set(names) if names is not None else None
+        for m in self._sorted_metrics():
+            if wanted is not None and m.name not in wanted:
+                continue
+            with m.lock:
+                for child in m._children.values():
+                    child._reset()
+
+    # -- read surfaces ---------------------------------------------------
+    def snapshot(self) -> Dict[str, object]:
+        """Flat JSON-able dict: scalar metrics map name -> value; labeled
+        metrics map ``name{a=x,b=y}`` -> value; histograms report
+        ``_count`` / ``_sum``."""
+        out: Dict[str, object] = {}
+        for m in self._sorted_metrics():
+            for key, child in m.children():
+                suffix = _label_str(m.label_names, key)
+                with m.lock:
+                    if m.kind == "histogram":
+                        out[f"{m.name}_count{suffix}"] = child.count
+                        out[f"{m.name}_sum{suffix}"] = round(child.sum, 6)
+                    else:
+                        v = child.value
+                        out[f"{m.name}{suffix}"] = (
+                            int(v) if float(v) == int(v) else round(v, 6))
+        return out
+
+    def prometheus_text(self) -> str:
+        """Prometheus text exposition (content type
+        ``text/plain; version=0.0.4``)."""
+        lines: List[str] = []
+        for m in self._sorted_metrics():
+            if m.help:
+                lines.append(f"# HELP {m.name} "
+                             + m.help.replace("\\", "\\\\")
+                             .replace("\n", "\\n"))
+            lines.append(f"# TYPE {m.name} {m.kind}")
+            for key, child in m.children():
+                with m.lock:
+                    if m.kind == "histogram":
+                        cum = 0
+                        for ub, c in zip(m.bucket_bounds, child.buckets):
+                            cum += c
+                            ls = _label_str(m.label_names + ("le",),
+                                            key + (_fmt_value(ub),))
+                            lines.append(f"{m.name}_bucket{ls} {cum}")
+                        ls = _label_str(m.label_names + ("le",),
+                                        key + ("+Inf",))
+                        lines.append(f"{m.name}_bucket{ls} {child.count}")
+                        base = _label_str(m.label_names, key)
+                        lines.append(f"{m.name}_sum{base} "
+                                     f"{_fmt_value(child.sum)}")
+                        lines.append(f"{m.name}_count{base} {child.count}")
+                    else:
+                        ls = _label_str(m.label_names, key)
+                        lines.append(f"{m.name}{ls} "
+                                     f"{_fmt_value(child.value)}")
+        return "\n".join(lines) + "\n"
+
+
+_default: Optional[Registry] = None
+_default_lock = threading.Lock()
+
+
+def default_registry() -> Registry:
+    """The process-wide registry (trainer / streaming / checkpoint /
+    predictor-cache instrumentation publishes here)."""
+    global _default
+    with _default_lock:
+        if _default is None:
+            _default = Registry()
+        return _default

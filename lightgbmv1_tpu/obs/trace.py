@@ -1,0 +1,326 @@
+"""Low-overhead nested-span tracer with Chrome trace-event export.
+
+Design points (the ISSUE-9 contract):
+
+* **Hard-off by default.**  ``span()`` checks ONE module-level flag and
+  returns a shared no-op context manager when tracing is disarmed — no
+  dict, no object, no clock read is allocated on the off path
+  (tests/test_obs.py pins the zero-allocation property with
+  tracemalloc).  Hot paths that want to skip even argument construction
+  guard with ``trace.enabled()``.
+* **Monotonic clocks.**  All timestamps are ``time.perf_counter_ns()``
+  — immune to wall-clock steps; the export rebases to the arm instant.
+* **Thread-local span stack.**  Nesting needs no global coordination;
+  concurrent serving threads trace independently and the export keys
+  events by OS thread id, which is exactly how Perfetto lanes them.
+* **Ring-buffered events.**  A fixed-capacity ring (``arm(ring_events=
+  ...)``) overwrites the OLDEST events under sustained load — tracing
+  can be left armed on a serving replica without unbounded growth; the
+  export reports how many events were dropped.
+* **Trace ids.**  ``new_trace_id()`` mints a 16-hex-char id; the serving
+  path propagates it request -> admission queue -> micro-batch ->
+  predictor walk -> ``X-Trace-Id`` response header, so one p999 outlier
+  decomposes into its queue / batch / walk spans by grepping the id in
+  the exported trace.
+
+Export is the Chrome trace-event JSON format (``{"traceEvents": [...]}``
+of ``"ph": "X"`` complete events) — open the file at https://ui.perfetto.dev
+or chrome://tracing.
+
+Within-dispatch training phases (top-k / partition / histogram / split)
+run inside ONE jitted ``lax.while_loop`` the host cannot observe
+per-round; when a phase profile is installed (``set_phase_profile`` —
+bench.py installs the measured ``phase_attrib`` breakdown), iteration
+spans additionally emit wave-round and phase child spans laid out
+proportionally to the ATTRIBUTED milliseconds and flagged
+``{"estimated": true}``, so the Perfetto view and the ``phase_attrib``
+figures agree by construction.  Without a profile, iteration spans have
+only the host-observable children (dispatch / materialize / eval).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Dict, List, Optional
+
+DEFAULT_RING_EVENTS = 65536
+
+_armed = False                  # THE hot-path flag: checked once per span
+_lock = threading.Lock()        # guards the ring and arm/disarm
+_ring: List[tuple] = []         # (name, cat, t0_ns, dur_ns, tid, args)
+_ring_cap = DEFAULT_RING_EVENTS
+_ring_pos = 0                   # next slot when the ring has wrapped
+_dropped = 0
+_t_arm_ns = 0                   # export rebases timestamps to this
+_phase_profile: Optional[Dict] = None
+
+_tls = threading.local()
+
+
+def enabled() -> bool:
+    """True while the tracer is armed (the off path is one global read)."""
+    return _armed
+
+
+def arm(ring_events: int = DEFAULT_RING_EVENTS) -> None:
+    """Arm the tracer with a fresh ring of ``ring_events`` capacity."""
+    global _armed, _ring, _ring_cap, _ring_pos, _dropped, _t_arm_ns
+    with _lock:
+        _ring = []
+        _ring_cap = max(int(ring_events), 16)
+        _ring_pos = 0
+        _dropped = 0
+        _t_arm_ns = time.perf_counter_ns()
+        _armed = True
+
+
+def disarm() -> None:
+    global _armed
+    _armed = False
+
+
+def reset() -> None:
+    """Disarm and drop all buffered events / the phase profile."""
+    global _armed, _ring, _ring_pos, _dropped, _phase_profile
+    with _lock:
+        _armed = False
+        _ring = []
+        _ring_pos = 0
+        _dropped = 0
+        _phase_profile = None
+
+
+def _record(name: str, cat: str, t0_ns: int, dur_ns: int,
+            args: Optional[dict]) -> None:
+    global _ring_pos, _dropped
+    ev = (name, cat, t0_ns, dur_ns, threading.get_ident(), args)
+    with _lock:
+        if len(_ring) < _ring_cap:
+            _ring.append(ev)
+        else:
+            _ring[_ring_pos] = ev
+            _ring_pos = (_ring_pos + 1) % _ring_cap
+            _dropped += 1
+
+
+class _NoopSpan:
+    """Shared do-nothing context manager: the disarmed ``span()`` return
+    value.  A singleton, so the off path allocates nothing."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NOOP = _NoopSpan()
+
+
+class _Span:
+    __slots__ = ("name", "cat", "args", "t0")
+
+    def __init__(self, name: str, cat: str, args: Optional[dict]):
+        self.name = name
+        self.cat = cat
+        self.args = args
+        self.t0 = 0
+
+    def __enter__(self):
+        stack = getattr(_tls, "stack", None)
+        if stack is None:
+            stack = _tls.stack = []
+        stack.append(self)
+        self.t0 = time.perf_counter_ns()
+        return self
+
+    def __exit__(self, *exc):
+        t1 = time.perf_counter_ns()
+        stack = getattr(_tls, "stack", None)
+        if stack and stack[-1] is self:
+            stack.pop()
+        if _armed:   # disarmed mid-span: drop, never crash
+            tid = current_trace_id()
+            args = self.args
+            if tid is not None:
+                args = dict(args) if args else {}
+                args["trace_id"] = tid
+            _record(self.name, self.cat, self.t0, t1 - self.t0, args)
+        return False
+
+
+def span(name: str, cat: str = "app", args: Optional[dict] = None):
+    """Context manager timing a nested span.  ``args`` is an optional
+    dict rendered into the Chrome event (pass a literal dict only when
+    armed-path cost is acceptable; the disarmed call allocates nothing)."""
+    if not _armed:
+        return _NOOP
+    return _Span(name, cat, args)
+
+
+def depth() -> int:
+    """Current thread's span-nesting depth (tests / debugging)."""
+    stack = getattr(_tls, "stack", None)
+    return len(stack) if stack else 0
+
+
+def add_span(name: str, t0_ns: int, dur_ns: int, cat: str = "app",
+             args: Optional[dict] = None) -> None:
+    """Record a span measured elsewhere (retro-recording: the serving
+    dispatcher records each request's queue wait AFTER the batch is
+    collected, from timestamps it already holds)."""
+    if not _armed:
+        return
+    _record(name, cat, int(t0_ns), max(int(dur_ns), 0), args)
+
+
+def instant(name: str, cat: str = "app", args: Optional[dict] = None) -> None:
+    """Zero-duration marker event."""
+    if not _armed:
+        return
+    _record(name, cat, time.perf_counter_ns(), 0, args)
+
+
+def now_ns() -> int:
+    return time.perf_counter_ns()
+
+
+# ---------------------------------------------------------------------------
+# trace ids (request-scoped correlation, independent of arming)
+# ---------------------------------------------------------------------------
+
+def new_trace_id() -> str:
+    """16 hex chars from the OS entropy pool — unique per request at any
+    realistic request rate, cheap enough to mint unconditionally."""
+    return os.urandom(8).hex()
+
+
+def set_trace_id(trace_id: Optional[str]) -> None:
+    """Bind ``trace_id`` to the current thread; spans recorded while
+    bound carry it in their args.  ``None`` clears."""
+    _tls.trace_id = trace_id
+
+
+def current_trace_id() -> Optional[str]:
+    return getattr(_tls, "trace_id", None)
+
+
+# ---------------------------------------------------------------------------
+# estimated phase children (the attributed within-dispatch decomposition)
+# ---------------------------------------------------------------------------
+
+def set_phase_profile(parts: Optional[Dict[str, float]],
+                      rounds_per_iter: Optional[float] = None) -> None:
+    """Install the attributed per-iteration phase decomposition
+    (``{"hist": ms, "partition": ms, "split": ms, ...}``).  Iteration
+    spans emitted via :func:`iteration_span_end` then carry wave-round
+    and phase child spans proportional to these parts, flagged
+    ``estimated`` — the host cannot observe phases inside the jitted
+    while-loop, so the trace renders the same attribution that
+    ``tools/phase_attrib.py`` and the BENCH phase fields report."""
+    global _phase_profile
+    if parts is None:
+        _phase_profile = None
+        return
+    clean = {str(k): float(v) for k, v in parts.items() if v and v > 0}
+    _phase_profile = {
+        "parts": clean,
+        "rounds": max(float(rounds_per_iter or 0.0), 0.0),
+    } if clean else None
+
+
+def phase_profile() -> Optional[Dict]:
+    return _phase_profile
+
+
+def iteration_span_end(t0_ns: int, iteration: int,
+                       cat: str = "train") -> None:
+    """Record one training-iteration span ending NOW, plus the estimated
+    wave-round/phase children when a phase profile is installed."""
+    if not _armed:
+        return
+    t1 = time.perf_counter_ns()
+    _record("train.iteration", cat, t0_ns, t1 - t0_ns,
+            {"iteration": int(iteration)})
+    prof = _phase_profile
+    if not prof:
+        return
+    parts = prof["parts"]
+    total = sum(parts.values())
+    if total <= 0:
+        return
+    span_ns = t1 - t0_ns
+    n_rounds = int(round(prof["rounds"])) if prof["rounds"] >= 2 else 1
+    round_ns = span_ns // n_rounds
+    for r in range(n_rounds):
+        r0 = t0_ns + r * round_ns
+        if n_rounds > 1:
+            _record("wave.round", cat, r0, round_ns,
+                    {"round": r, "estimated": True})
+        cursor = r0
+        for name, ms in parts.items():
+            dur = int(round_ns * (ms / total))
+            _record(f"phase.{name}", cat, cursor, dur,
+                    {"estimated": True, "attributed_ms": ms})
+            cursor += dur
+
+
+# ---------------------------------------------------------------------------
+# export
+# ---------------------------------------------------------------------------
+
+def drain() -> Dict:
+    """Snapshot the ring (oldest -> newest) without disturbing it:
+    ``{"events": [...], "dropped": n, "t0_ns": arm_instant}``."""
+    with _lock:
+        if len(_ring) < _ring_cap or _ring_pos == 0:
+            events = list(_ring)
+        else:
+            events = _ring[_ring_pos:] + _ring[:_ring_pos]
+        return {"events": events, "dropped": _dropped, "t0_ns": _t_arm_ns}
+
+
+def export_chrome(path: Optional[str] = None) -> Dict:
+    """Chrome trace-event JSON of the buffered spans (Perfetto-viewable).
+    When ``path`` is given the JSON is written via
+    ``fileio.atomic_write_bytes`` — a crash mid-export leaves the old
+    file, never a torn one — and the dict is returned either way."""
+    import json
+
+    snap = drain()
+    t0 = snap["t0_ns"]
+    events = []
+    tids = {}
+    for name, cat, t_ns, dur_ns, tid, args in snap["events"]:
+        tids.setdefault(tid, len(tids))
+        ev = {
+            "name": name,
+            "cat": cat,
+            "ph": "X",
+            "ts": (t_ns - t0) / 1e3,       # microseconds
+            "dur": dur_ns / 1e3,
+            "pid": os.getpid(),
+            "tid": tid,
+        }
+        if args:
+            ev["args"] = args
+        events.append(ev)
+    for tid, i in tids.items():
+        events.append({"name": "thread_name", "ph": "M", "pid": os.getpid(),
+                       "tid": tid, "args": {"name": f"thread-{i}"}})
+    doc = {
+        "traceEvents": events,
+        "displayTimeUnit": "ms",
+        "otherData": {"dropped_events": snap["dropped"],
+                      "exporter": "lightgbmv1_tpu.obs.trace"},
+    }
+    if path:
+        from ..utils import fileio
+
+        fileio.atomic_write_bytes(
+            str(path), json.dumps(doc).encode("utf-8"), site="trace_out")
+    return doc

@@ -246,6 +246,24 @@ def comm_table_per_round(learner: str, collective: str, *, k: float,
     return out
 
 
+def publish_comm_metrics(learner: str, table: dict) -> None:
+    """Publish one learner's analytic per-round comm table into the
+    unified obs registry (gauges labeled ``{learner, part}``) — the same
+    numbers the trainer logs at build and dryrun_multichip records, now
+    scrapeable from ``GET /metrics`` alongside everything else."""
+    from ..obs.metrics import default_registry
+
+    g = default_registry().gauge(
+        "comm_bytes_per_round",
+        "Analytic per-device collective payload per wave round",
+        label_names=("learner", "part"))
+    for part in ("hist_bytes", "split_sync_bytes", "vote_bytes",
+                 "total_bytes"):
+        if table.get(part) is not None:
+            g.labels(learner=learner,
+                     part=part[:-6]).set(float(table[part]))
+
+
 def predict_comm_table(n_rows: int, num_features: int, ndev: int, *,
                        itemsize: int = 4, K: int = 1) -> dict:
     """Per-device payloads of one row-sharded predict batch (the serving

@@ -72,7 +72,7 @@ from ..ops.histogram import (default_hist_method, hist_one_leaf, hist_wave,
 from ..ops.split import (FeatureMeta, SplitParams, SplitResult,
                          find_best_split, leaf_gain, tie_tol)
 from ..utils.log import log_fatal, log_info, log_warning
-from .cluster import comm_table_per_round, make_mesh
+from .cluster import comm_table_per_round, make_mesh, publish_comm_metrics
 
 try:  # jax >= 0.6 exposes shard_map at top level
     _shard_map = jax.shard_map
@@ -595,11 +595,12 @@ def build_trainer(
         log_info(f"Voting-parallel training over {ndev} devices "
                  f"(top_k={top_k}, {sel_k} features reduced per split, "
                  f"{config.data_parallel_collective} selective reduce)")
-        log_info("comm/round (analytic, K=%d wave): %s" % (wave_size,
-                 comm_table_per_round(
-                     "voting", config.data_parallel_collective, k=wave_size,
-                     F=F, B=B, ndev=ndev, sel_k=sel_k,
-                     int8sr=use_int8sr)))
+        _comm_tbl = comm_table_per_round(
+            "voting", config.data_parallel_collective, k=wave_size,
+            F=F, B=B, ndev=ndev, sel_k=sel_k, int8sr=use_int8sr)
+        log_info("comm/round (analytic, K=%d wave): %s"
+                 % (wave_size, _comm_tbl))
+        publish_comm_metrics("voting", _comm_tbl)
 
         def hist_fn(binned, g3, leaf_id, target):
             # local histogram only — the reduce happens per-split in split_fn
@@ -770,10 +771,12 @@ def build_trainer(
                  f"{jax.process_count()} processes, {collective} collective"
                  + (", process-sharded storage" if row_sharded else "")
                  + ")")
-        log_info("comm/round (analytic, K=%d wave): %s" % (wave_size,
-                 comm_table_per_round("data", collective, k=wave_size,
-                                      F=FH, B=Bh, ndev=ndev,
-                                      int8sr=use_int8sr)))
+        _comm_tbl = comm_table_per_round("data", collective, k=wave_size,
+                                         F=FH, B=Bh, ndev=ndev,
+                                         int8sr=use_int8sr)
+        log_info("comm/round (analytic, K=%d wave): %s"
+                 % (wave_size, _comm_tbl))
+        publish_comm_metrics("data", _comm_tbl)
 
         def _scatter_keep(h, int_domain=False):
             """The reference's ReduceScatter of histogram blocks
@@ -943,9 +946,11 @@ def build_trainer(
         )
         log_info(f"Feature-parallel training over {ndev} devices "
                  f"({F_loc} features/device)")
-        log_info("comm/round (analytic, K=%d wave): %s" % (wave_size,
-                 comm_table_per_round("feature", "allreduce", k=wave_size,
-                                      F=F, B=B, ndev=ndev)))
+        _comm_tbl = comm_table_per_round("feature", "allreduce",
+                                         k=wave_size, F=F, B=B, ndev=ndev)
+        log_info("comm/round (analytic, K=%d wave): %s"
+                 % (wave_size, _comm_tbl))
+        publish_comm_metrics("feature", _comm_tbl)
 
         def hist_fn(binned, g3, leaf_id, target):
             # build histograms only for this device's feature block, placed

@@ -17,12 +17,21 @@ Endpoints:
 
 * ``POST /predict``  body ``{"rows": [[...], ...]}`` ->
   ``{"values": [[...], ...], "version": "v2", "degraded": false,
-  "latency_ms": 1.9}``
-* ``GET /metrics``   the ServeMetrics snapshot (+ version history)
+  "latency_ms": 1.9, "trace_id": "..."}``.  Every response echoes an
+  ``X-Trace-Id`` header — the inbound header when the client sent one,
+  a freshly minted id otherwise — and the id rides the request through
+  admission queue -> micro-batch -> predictor walk, so an armed tracer
+  (obs/trace.py) decomposes any response's latency by grepping the id.
+* ``GET /metrics``   content negotiation over ONE store
+  (obs/metrics.py): the JSON ServeMetrics snapshot by default (the
+  pre-obs contract), Prometheus text exposition when the request has
+  ``Accept: text/plain`` or ``?format=prometheus``.
 * ``GET /healthz``   liveness, not process-up: 200 with
-  ``{"ok": true, "version", "dispatcher_alive", "published"}`` only
-  when the dispatcher thread is alive AND a model is published; 503
-  otherwise — a wedged replica must fall out of its load balancer.
+  ``{"ok": true, "version", "dispatcher_alive", "published",
+  "server_version", "uptime_s"}`` only when the dispatcher thread is
+  alive AND a model is published; 503 otherwise — a wedged replica must
+  fall out of its load balancer.  ``version`` is the ACTIVE MODEL tag,
+  ``server_version`` the package build.
 """
 
 from __future__ import annotations
@@ -34,6 +43,8 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from .server import (DispatcherStalled, RequestTimeout, ServeError, Server,
                      ServerClosed, ServerOverloaded)
 
+PROM_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
 
 def _make_handler(server: Server):
     class Handler(BaseHTTPRequestHandler):
@@ -42,18 +53,41 @@ def _make_handler(server: Server):
         def log_message(self, fmt, *args):  # noqa: A003 — silence stderr
             pass
 
-        def _reply(self, code: int, payload: dict) -> None:
+        def _reply(self, code: int, payload: dict,
+                   headers: dict = None) -> None:
             body = json.dumps(payload).encode()
             self.send_response(code)
             self.send_header("Content-Type", "application/json")
             self.send_header("Content-Length", str(len(body)))
+            for k, v in (headers or {}).items():
+                self.send_header(k, v)
             self.end_headers()
             self.wfile.write(body)
 
+        def _reply_text(self, code: int, text: str,
+                        content_type: str) -> None:
+            body = text.encode("utf-8")
+            self.send_response(code)
+            self.send_header("Content-Type", content_type)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def _wants_prometheus(self) -> bool:
+            if "format=prometheus" in (self.path.split("?", 1) + [""])[1]:
+                return True
+            accept = self.headers.get("Accept", "")
+            return "text/plain" in accept or "openmetrics" in accept
+
         def do_GET(self):  # noqa: N802 — BaseHTTPRequestHandler API
-            if self.path == "/metrics":
-                self._reply(200, server.metrics_snapshot())
-            elif self.path == "/healthz":
+            route = self.path.split("?", 1)[0]
+            if route == "/metrics":
+                if self._wants_prometheus():
+                    self._reply_text(200, server.metrics.prometheus_text(),
+                                     PROM_CONTENT_TYPE)
+                else:
+                    self._reply(200, server.metrics_snapshot())
+            elif route == "/healthz":
                 health = server.health()
                 self._reply(200 if health["ok"] else 503, health)
             else:
@@ -63,6 +97,11 @@ def _make_handler(server: Server):
             if self.path != "/predict":
                 self._reply(404, {"error": f"no route {self.path}"})
                 return
+            from ..obs import trace as _trace
+
+            trace_id = (self.headers.get("X-Trace-Id", "").strip()
+                        or _trace.new_trace_id())
+            tid_hdr = {"X-Trace-Id": trace_id}
             try:
                 n = int(self.headers.get("Content-Length", 0))
                 req = json.loads(self.rfile.read(n) or b"{}")
@@ -74,45 +113,56 @@ def _make_handler(server: Server):
                 if not isinstance(rows, list) or not rows:
                     raise ValueError("'rows' must be a non-empty list")
             except KeyError as e:
-                self._reply(400, {"error": f"missing field {e}"})
+                self._reply(400, {"error": f"missing field {e}"},
+                            headers=tid_hdr)
                 return
             except (ValueError, TypeError) as e:
-                self._reply(400, {"error": f"bad request body: {e}"})
+                self._reply(400, {"error": f"bad request body: {e}"},
+                            headers=tid_hdr)
                 return
             try:
-                res = server.submit(rows)
+                res = server.submit(rows, trace_id=trace_id)
             except ServerOverloaded as e:
-                self._reply(503, {"error": str(e), "shed": True})
+                self._reply(503, {"error": str(e), "shed": True},
+                            headers=tid_hdr)
                 return
             except RequestTimeout as e:
-                self._reply(504, {"error": str(e), "timeout": True})
+                self._reply(504, {"error": str(e), "timeout": True},
+                            headers=tid_hdr)
                 return
             except (DispatcherStalled, ServerClosed) as e:
                 # retryable-elsewhere: the replica is wedged or draining
-                self._reply(503, {"error": f"{type(e).__name__}: {e}"})
+                self._reply(503, {"error": f"{type(e).__name__}: {e}"},
+                            headers=tid_hdr)
                 return
             except (ValueError, TypeError) as e:
                 # client-input failures from row coercion/shape checks
                 # (non-numeric cells, wrong feature count, ragged rows)
-                self._reply(400, {"error": f"{type(e).__name__}: {e}"})
+                self._reply(400, {"error": f"{type(e).__name__}: {e}"},
+                            headers=tid_hdr)
                 return
             except ServeError as e:
-                self._reply(503, {"error": f"{type(e).__name__}: {e}"})
+                self._reply(503, {"error": f"{type(e).__name__}: {e}"},
+                            headers=tid_hdr)
                 return
             except RuntimeError as e:
                 # e.g. "no model published yet" — not ready, not a bug
-                self._reply(503, {"error": str(e)})
+                self._reply(503, {"error": str(e)}, headers=tid_hdr)
                 return
             except Exception as e:  # noqa: BLE001 — structured 500, not
                 # an unhandled-traceback page
-                self._reply(500, {"error": f"{type(e).__name__}: {e}"})
+                self._reply(500, {"error": f"{type(e).__name__}: {e}"},
+                            headers=tid_hdr)
                 return
             self._reply(200, {
                 "values": res.values.tolist(),
                 "version": res.version,
                 "degraded": res.degraded,
                 "latency_ms": round(res.latency_ms, 3),
-            })
+                "trace_id": res.trace_id,
+                "queue_ms": round(res.queue_ms, 3),
+                "walk_ms": round(res.walk_ms, 3),
+            }, headers=tid_hdr)
 
     return Handler
 

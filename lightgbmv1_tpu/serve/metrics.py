@@ -1,174 +1,191 @@
-"""Serve metrics core — the observability half of the online subsystem.
+"""Serve metrics — a thin adapter over the unified obs registry.
 
-The reference ships no serving telemetry at all (its Predictor is a batch
-file->file application); a service answering live traffic needs the four
-questions answered continuously: how much (QPS), how fast (latency
-quantiles), how full (batch occupancy / queue depth), and how degraded
-(sheds, timeouts, degraded answers).  This module keeps those counters
-cheap enough to update per request under the batcher lock and snapshots
-them as one JSON-able dict — ``bench.py``'s serve block and
-``tools/perf_report.py``'s "Serving" section render the same fields.
+Until ISSUE 9 this module kept its own ad-hoc counters; the store is now
+:class:`lightgbmv1_tpu.obs.metrics.Registry` — every serving counter,
+gauge and the latency histogram are ordinary registry metrics, so
+``GET /metrics`` can serve Prometheus text exposition straight from the
+same store (serve/http.py content negotiation) while ``snapshot()``
+keeps emitting the EXACT JSON dict the pre-obs module did —
+``bench.py``'s serve block, ``tools/perf_report.py``'s "Serving"
+section and the serve tests consume those keys unchanged.
 
-Latency quantiles come from a fixed-size ring of the most recent
-``window`` completions (exact over the window, O(window log window) only
-at snapshot time) — a bounded-memory stand-in for a streaming sketch
-that is exact for the smoke/bench populations we record.
+Latency quantiles stay exact over the most recent ``window``
+completions: the registry histogram retains a bounded raw-sample window
+(``sample_window``) alongside its Prometheus buckets, so the p999 the
+JSON reports and the bucket series Prometheus scrapes come from the
+same observations.
+
+Each ``ServeMetrics`` gets its OWN registry by default (one registry
+per replica is the Prometheus model, and concurrent test servers stay
+isolated); pass ``registry=`` to aggregate several servers into one.
 """
 
 from __future__ import annotations
 
 import threading
 import time
-from typing import Dict, List, Optional
+from typing import Dict, Optional
+
+from ..obs.metrics import DEFAULT_MS_BUCKETS, Registry
+
+_COUNTERS = (
+    ("submitted", "serve_submitted_total", "Requests admitted to the queue"),
+    ("completed", "serve_completed_total", "Requests answered"),
+    ("shed", "serve_shed_total", "Requests shed by admission control"),
+    ("timeouts", "serve_timeouts_total", "Requests expired in queue"),
+    ("errors", "serve_errors_total", "Requests failed by batch errors"),
+    ("degraded", "serve_degraded_total",
+     "Requests answered by the truncated-tree overload predictor"),
+    ("swaps", "serve_swaps_total", "Model version swaps (incl. rollbacks)"),
+    ("rollbacks", "serve_rollbacks_total", "Registry rollbacks"),
+    ("retries", "serve_retries_total", "Transient batch errors retried"),
+    ("breaker_trips", "serve_breaker_trips_total",
+     "Circuit-breaker auto-rollbacks"),
+    ("watchdog_failures", "serve_watchdog_failures_total",
+     "Requests failed by the stalled-batch watchdog"),
+    ("dispatcher_restarts", "serve_dispatcher_restarts_total",
+     "Dead dispatcher threads restarted"),
+    ("publish_rejects", "serve_publish_rejects_total",
+     "Candidate versions refused by publish validation"),
+    ("batches", "serve_batches_total", "Device batches dispatched"),
+    ("batch_rows", "serve_batch_rows_total",
+     "Real rows across dispatched batches"),
+    ("batch_capacity", "serve_batch_capacity_total",
+     "Bucket capacity across dispatched batches"),
+)
 
 
-def _quantile(sorted_vals: List[float], q: float) -> Optional[float]:
-    if not sorted_vals:
-        return None
-    i = min(int(q * len(sorted_vals)), len(sorted_vals) - 1)
-    return sorted_vals[i]
+def _quantile(child, q: float) -> Optional[float]:
+    return child.quantile(q)
 
 
 class ServeMetrics:
-    """Thread-safe counters + a latency ring; ``snapshot()`` is the one
-    read surface (everything else is write-only on the hot path)."""
+    """Thread-safe serving telemetry over one obs Registry;
+    ``snapshot()`` is the one JSON read surface (everything else is
+    write-only on the hot path) and ``registry.prometheus_text()`` the
+    exposition surface."""
 
-    def __init__(self, window: int = 8192):
-        self._lock = threading.Lock()
+    def __init__(self, window: int = 8192,
+                 registry: Optional[Registry] = None):
         self.window = max(int(window), 16)
-        self.reset()
+        self.registry = registry if registry is not None else Registry()
+        self._c = {attr: self.registry.counter(name, help_text)
+                   for attr, name, help_text in _COUNTERS}
+        self._queue_depth = self.registry.gauge(
+            "serve_queue_depth", "Backlogged rows at last submit/batch")
+        self._queue_depth_max = self.registry.gauge(
+            "serve_queue_depth_max", "High-water backlog (rows)")
+        self._latency = self.registry.histogram(
+            "serve_latency_ms", "End-to-end request latency (ms)",
+            buckets=DEFAULT_MS_BUCKETS, sample_window=self.window)
+        self._lock = threading.Lock()   # guards only the QPS timestamps
+        self._t0: Optional[float] = None
+        self._t_last: Optional[float] = None
 
     def reset(self) -> None:
+        self.registry.reset(
+            [m.name for m in self._c.values()]
+            + ["serve_queue_depth", "serve_queue_depth_max",
+               "serve_latency_ms"])
         with self._lock:
-            self._lat_ms: List[float] = []
-            self._lat_pos = 0
-            self.submitted = 0
-            self.completed = 0
-            self.shed = 0
-            self.timeouts = 0
-            self.errors = 0
-            self.degraded = 0
-            self.swaps = 0
-            self.rollbacks = 0
-            self.retries = 0            # transient batch errors retried
-            self.breaker_trips = 0      # circuit-breaker auto-rollbacks
-            self.watchdog_failures = 0  # requests failed by the watchdog
-            self.dispatcher_restarts = 0
-            self.publish_rejects = 0    # candidate versions refused
-            self.batches = 0
-            self.batch_rows = 0
-            self.batch_capacity = 0
-            self.queue_depth = 0
-            self.queue_depth_max = 0
-            self._t0: Optional[float] = None
-            self._t_last: Optional[float] = None
+            self._t0 = None
+            self._t_last = None
 
     # -- hot-path writers ------------------------------------------------
     def on_submit(self, n_rows: int, queue_depth: int) -> None:
         with self._lock:
-            now = time.monotonic()
             if self._t0 is None:
-                self._t0 = now
-            self.submitted += 1
-            self.queue_depth = queue_depth
-            if queue_depth > self.queue_depth_max:
-                self.queue_depth_max = queue_depth
+                self._t0 = time.monotonic()
+        self._c["submitted"].inc()
+        self._queue_depth.set(queue_depth)
+        self._queue_depth_max.set_max(queue_depth)
 
     def on_shed(self) -> None:
-        with self._lock:
-            self.shed += 1
+        self._c["shed"].inc()
 
     def on_timeout(self) -> None:
-        with self._lock:
-            self.timeouts += 1
+        self._c["timeouts"].inc()
 
     def on_error(self) -> None:
-        with self._lock:
-            self.errors += 1
+        self._c["errors"].inc()
 
     def on_swap(self, rollback: bool = False) -> None:
-        with self._lock:
-            self.swaps += 1
-            if rollback:
-                self.rollbacks += 1
+        self._c["swaps"].inc()
+        if rollback:
+            self._c["rollbacks"].inc()
 
     def on_retry(self) -> None:
-        with self._lock:
-            self.retries += 1
+        self._c["retries"].inc()
 
     def on_breaker(self) -> None:
-        with self._lock:
-            self.breaker_trips += 1
+        self._c["breaker_trips"].inc()
 
     def on_watchdog(self, n: int = 1) -> None:
-        with self._lock:
-            self.watchdog_failures += n
+        self._c["watchdog_failures"].inc(n)
 
     def on_dispatcher_restart(self) -> None:
-        with self._lock:
-            self.dispatcher_restarts += 1
+        self._c["dispatcher_restarts"].inc()
 
     def on_publish_reject(self) -> None:
-        with self._lock:
-            self.publish_rejects += 1
+        self._c["publish_rejects"].inc()
 
     def on_batch(self, rows: int, bucket: int, queue_depth: int) -> None:
         """One dispatched device batch: ``rows`` real rows padded into a
         ``bucket``-row executable (occupancy = rows / bucket)."""
-        with self._lock:
-            self.batches += 1
-            self.batch_rows += rows
-            self.batch_capacity += max(bucket, 1)
-            self.queue_depth = queue_depth
+        self._c["batches"].inc()
+        self._c["batch_rows"].inc(rows)
+        self._c["batch_capacity"].inc(max(bucket, 1))
+        self._queue_depth.set(queue_depth)
 
     def on_complete(self, latency_ms: float, degraded: bool = False) -> None:
         with self._lock:
-            self.completed += 1
             self._t_last = time.monotonic()
-            if degraded:
-                self.degraded += 1
-            if len(self._lat_ms) < self.window:
-                self._lat_ms.append(latency_ms)
-            else:
-                self._lat_ms[self._lat_pos] = latency_ms
-                self._lat_pos = (self._lat_pos + 1) % self.window
+        self._c["completed"].inc()
+        if degraded:
+            self._c["degraded"].inc()
+        self._latency.observe(latency_ms)
 
     # -- read surface ----------------------------------------------------
+    def prometheus_text(self) -> str:
+        return self.registry.prometheus_text()
+
     def snapshot(self) -> Dict[str, object]:
         """One JSON-able dict; the serve_* BENCH fields are computed from
-        exactly these keys (bench.py measure_serve)."""
+        exactly these keys (bench.py measure_serve).  Key set and value
+        semantics are byte-compatible with the pre-registry module."""
+        v = {attr: int(c.get()) for attr, c in self._c.items()}
+        lat = self._latency._solo()
         with self._lock:
-            lat = sorted(self._lat_ms)
             span = ((self._t_last - self._t0)
                     if self._t0 is not None and self._t_last is not None
                     and self._t_last > self._t0 else None)
-            total = self.submitted + self.shed
-            return {
-                "submitted": self.submitted,
-                "completed": self.completed,
-                "shed": self.shed,
-                "timeouts": self.timeouts,
-                "errors": self.errors,
-                "degraded": self.degraded,
-                "swaps": self.swaps,
-                "rollbacks": self.rollbacks,
-                "retries": self.retries,
-                "breaker_trips": self.breaker_trips,
-                "watchdog_failures": self.watchdog_failures,
-                "dispatcher_restarts": self.dispatcher_restarts,
-                "publish_rejects": self.publish_rejects,
-                "batches": self.batches,
-                "qps": (round(self.completed / span, 2) if span else None),
-                "p50_ms": _quantile(lat, 0.50),
-                "p99_ms": _quantile(lat, 0.99),
-                "p999_ms": _quantile(lat, 0.999),
-                "batch_occupancy": (round(self.batch_rows
-                                          / self.batch_capacity, 4)
-                                    if self.batch_capacity else None),
-                "mean_batch_rows": (round(self.batch_rows / self.batches, 1)
-                                    if self.batches else None),
-                "queue_depth": self.queue_depth,
-                "queue_depth_max": self.queue_depth_max,
-                "shed_frac": (round(self.shed / total, 4) if total else 0.0),
-                "latency_window": len(lat),
-            }
+        total = v["submitted"] + v["shed"]
+        return {
+            "submitted": v["submitted"],
+            "completed": v["completed"],
+            "shed": v["shed"],
+            "timeouts": v["timeouts"],
+            "errors": v["errors"],
+            "degraded": v["degraded"],
+            "swaps": v["swaps"],
+            "rollbacks": v["rollbacks"],
+            "retries": v["retries"],
+            "breaker_trips": v["breaker_trips"],
+            "watchdog_failures": v["watchdog_failures"],
+            "dispatcher_restarts": v["dispatcher_restarts"],
+            "publish_rejects": v["publish_rejects"],
+            "batches": v["batches"],
+            "qps": (round(v["completed"] / span, 2) if span else None),
+            "p50_ms": _quantile(lat, 0.50),
+            "p99_ms": _quantile(lat, 0.99),
+            "p999_ms": _quantile(lat, 0.999),
+            "batch_occupancy": (round(v["batch_rows"]
+                                      / v["batch_capacity"], 4)
+                                if v["batch_capacity"] else None),
+            "mean_batch_rows": (round(v["batch_rows"] / v["batches"], 1)
+                                if v["batches"] else None),
+            "queue_depth": int(self._queue_depth.get()),
+            "queue_depth_max": int(self._queue_depth_max.get()),
+            "shed_frac": (round(v["shed"] / total, 4) if total else 0.0),
+            "latency_window": lat.window_len(),
+        }

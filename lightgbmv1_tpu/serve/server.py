@@ -35,6 +35,7 @@ from typing import Any, Dict, List, Optional
 
 import numpy as np
 
+from ..obs import trace
 from ..utils import faults
 from ..utils.log import log_info, log_warning
 from .metrics import ServeMetrics
@@ -118,13 +119,17 @@ class ServeResult:
     latency_ms: float
     degraded: bool = False
     batch_rows: int = 0         # rows in the device batch that carried it
+    trace_id: str = ""          # propagated end-to-end (X-Trace-Id)
+    queue_ms: float = 0.0       # enqueue -> batch collected
+    walk_ms: float = 0.0        # device predict leg of the carrying batch
 
 
 class _Request:
     __slots__ = ("rows", "n", "t_enq", "deadline", "event", "result",
-                 "error")
+                 "error", "trace_id")
 
-    def __init__(self, rows: np.ndarray, deadline: Optional[float]):
+    def __init__(self, rows: np.ndarray, deadline: Optional[float],
+                 trace_id: Optional[str] = None):
         self.rows = rows
         self.n = rows.shape[0]
         self.t_enq = time.monotonic()
@@ -132,6 +137,10 @@ class _Request:
         self.event = threading.Event()
         self.result: Optional[ServeResult] = None
         self.error: Optional[BaseException] = None
+        # every request carries a trace id whether or not the tracer is
+        # armed — the X-Trace-Id echo and the latency decomposition in
+        # ServeResult are always-on; only SPAN RECORDING is gated
+        self.trace_id = trace_id or trace.new_trace_id()
 
 
 class Server:
@@ -141,6 +150,7 @@ class Server:
     def __init__(self, model=None, config: Optional[ServeConfig] = None,
                  registry: Optional[ModelRegistry] = None):
         self.config = config or ServeConfig()
+        self._t_start = time.monotonic()
         self.metrics = ServeMetrics(window=self.config.metrics_window)
         self.registry = registry or ModelRegistry(
             metrics=self.metrics,
@@ -186,10 +196,14 @@ class Server:
         return self.registry.current_tag()
 
     # -- request path ----------------------------------------------------
-    def submit(self, rows, timeout_ms: Optional[float] = None) -> ServeResult:
+    def submit(self, rows, timeout_ms: Optional[float] = None,
+               trace_id: Optional[str] = None) -> ServeResult:
         """Block until the rows are scored; raises
         :class:`ServerOverloaded` (queue full), :class:`RequestTimeout`
-        (deadline expired in queue) or :class:`ServerClosed`."""
+        (deadline expired in queue) or :class:`ServerClosed`.
+        ``trace_id`` (e.g. an inbound ``X-Trace-Id`` header) is carried
+        through queue -> batch -> walk and echoed in the result; one is
+        minted when absent."""
         mv = self.registry.current()          # raises before queueing when
         X = np.asarray(rows, np.float64)      # nothing is published yet
         if X.ndim == 1:
@@ -200,7 +214,7 @@ class Server:
                 f"features; the serving model has {mv.num_features}")
         t_ms = self.config.timeout_ms if timeout_ms is None else timeout_ms
         deadline = (time.monotonic() + t_ms / 1e3) if t_ms > 0 else None
-        req = _Request(X, deadline)
+        req = _Request(X, deadline, trace_id)
         with self._cond:
             if self._closed:
                 raise ServerClosed("server is shut down")
@@ -228,14 +242,23 @@ class Server:
     def dispatcher_alive(self) -> bool:
         return self._dispatcher.is_alive() and not self._closed
 
+    def uptime_s(self) -> float:
+        return time.monotonic() - self._t_start
+
     def health(self) -> Dict[str, Any]:
         """Liveness the /healthz endpoint reports: a wedged or dead
         dispatcher and an empty registry are NOT healthy, even though
-        the process is up."""
+        the process is up.  ``version`` stays the ACTIVE MODEL tag (the
+        pre-obs contract every client reads); ``server_version`` is the
+        package build and ``uptime_s`` the replica age."""
+        from .. import __version__
+
         alive = self.dispatcher_alive()
         tag = self.registry.current_tag()
         return {"ok": bool(alive and tag is not None), "version": tag,
-                "dispatcher_alive": alive, "published": tag is not None}
+                "dispatcher_alive": alive, "published": tag is not None,
+                "server_version": __version__,
+                "uptime_s": round(self.uptime_s(), 3)}
 
     def close(self) -> None:
         """Stop the dispatcher; pending requests fail with ServerClosed."""
@@ -390,6 +413,8 @@ class Server:
         X = (live[0].rows if len(live) == 1
              else np.concatenate([r.rows for r in live], axis=0))
         n = X.shape[0]
+        t_collect = time.monotonic()
+        walk_t0_ns = trace.now_ns() if trace.enabled() else 0
         self._inflight = (time.monotonic(), live)
         try:
             out = self._predict_with_retry(bp, X)
@@ -397,6 +422,26 @@ class Server:
             self._inflight = None
         self.metrics.on_batch(n, bp.bucket_for(n), backlog)
         done = time.monotonic()
+        walk_ms = (done - t_collect) * 1e3
+        if trace.enabled():
+            # one batch span + per-request queue/walk spans, every one
+            # carrying its propagated trace id — a p999 outlier in the
+            # export decomposes by grepping its X-Trace-Id
+            walk_dur_ns = trace.now_ns() - walk_t0_ns
+            trace.add_span("serve.batch", walk_t0_ns, walk_dur_ns,
+                           cat="serve",
+                           args={"rows": n, "version": mv.tag,
+                                 "degraded": degraded,
+                                 "requests": len(live)})
+            for req in live:
+                q_ns = int(max(t_collect - req.t_enq, 0.0) * 1e9)
+                trace.add_span("serve.queue", walk_t0_ns - q_ns, q_ns,
+                               cat="serve",
+                               args={"trace_id": req.trace_id})
+                trace.add_span("serve.walk", walk_t0_ns, walk_dur_ns,
+                               cat="serve",
+                               args={"trace_id": req.trace_id,
+                                     "batch_rows": n})
         lo = 0
         for req in live:
             vals = out[lo: lo + req.n]
@@ -406,9 +451,11 @@ class Server:
                 # batch): its client is gone — never double-complete
                 continue
             lat_ms = (done - req.t_enq) * 1e3
-            req.result = ServeResult(values=vals, version=mv.tag,
-                                     latency_ms=lat_ms, degraded=degraded,
-                                     batch_rows=n)
+            req.result = ServeResult(
+                values=vals, version=mv.tag, latency_ms=lat_ms,
+                degraded=degraded, batch_rows=n, trace_id=req.trace_id,
+                queue_ms=max((t_collect - req.t_enq) * 1e3, 0.0),
+                walk_ms=walk_ms)
             self.metrics.on_complete(lat_ms, degraded)
             req.event.set()
 

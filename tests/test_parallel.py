@@ -346,6 +346,11 @@ def test_int8sr_collective_moves_int32(monkeypatch):
     assert "i32" in dtypes, dtypes
 
 
+@pytest.mark.slow
+# slow-marked for the tier-1 wall budget (tools/tier1_budget.py, PR-6
+# discipline — the sibling int8sr_reduce_scatter_round was re-marked the
+# same way in PR 7): the full suite keeps it, and tools/dryrun_multichip
+# asserts voting int8sr tree parity on every driver capture.
 def test_int8sr_voting_selective_reduce_integer_domain(monkeypatch):
     """Satellite: the voting learner's selective reduce honors the int8sr
     integer domain.  Forcing the pool-free (no-subtraction) wave path

@@ -392,9 +392,15 @@ def test_http_endpoint_roundtrip(boosters):
         np.testing.assert_array_equal(
             np.asarray(out["values"])[:, 0], _host_raw(b1, X[:3]))
         health = json.loads(urllib.request.urlopen(u + "/healthz").read())
-        # liveness, not process-up (PR 6): registry + dispatcher state
-        assert health == {"ok": True, "version": "v1",
-                          "dispatcher_alive": True, "published": True}
+        # liveness, not process-up (PR 6): registry + dispatcher state;
+        # ISSUE 9 adds the build version + replica uptime
+        assert health["ok"] is True and health["version"] == "v1"
+        assert health["dispatcher_alive"] is True
+        assert health["published"] is True
+        from lightgbmv1_tpu import __version__
+
+        assert health["server_version"] == __version__
+        assert health["uptime_s"] >= 0
         m = json.loads(urllib.request.urlopen(u + "/metrics").read())
         assert m["completed"] >= 1 and m["version"] == "v1"
         with pytest.raises(urllib.error.HTTPError) as ei:

@@ -62,10 +62,17 @@ def train_text(params, X, y, Xv=None, yv=None, rounds=6):
     return bst.model_to_string(), evals, bst
 
 
+@pytest.mark.slow
 def test_stream_parity_binary_full_features():
     """Binary + bagging + feature_fraction + categorical + NaN + a valid
     set, streamed in ragged 96-row blocks: byte-identical model text AND
-    identical per-iteration valid metrics."""
+    identical per-iteration valid metrics.
+
+    slow-marked for the tier-1 wall budget (tools/tier1_budget.py, the
+    PR-6 discipline): the full suite and bench.py's measure_stream
+    (every capture) keep asserting byte parity; tier-1 retains the
+    mechanism pin (test_hist_accum_continues_resident_fold) and the
+    memory guard."""
     X, y = make_data(n=450)
     Xv, yv = make_data(n=150, seed=9)
     params = {**BASE, "objective": "binary", "bagging_fraction": 0.7,

@@ -1,0 +1,91 @@
+"""One CI gate: the bench regression sentinel + the tier-1 wall budget.
+
+Two guards existed as separate tools with separate exit codes
+(tools/bench_trend.py, tools/tier1_budget.py); driver/CI wiring wants
+ONE entry with ONE exit code, so a capture or a suite run is gated by a
+single command:
+
+    python tools/ci_gate.py [--records DIR] [--t1-log PATH]
+                            [--skip-trend] [--skip-t1]
+
+* **trend** — ``bench_trend.run()`` over the record directory: the
+  newest BENCH/MULTICHIP record must not regress a watched field >10%
+  vs the best prior capture nor read False on any ``*_ok`` guard.
+* **tier1** — ``tier1_budget`` over the per-test durations JSONL (or the
+  tee'd pytest log): the projected tier-1 wall must fit 95% of the
+  870 s driver budget.  A MISSING log fails the gate (a guard that
+  silently skips is not a guard) unless ``--skip-t1`` says the caller
+  genuinely has no suite run to judge (e.g. a records-only capture box).
+
+Exit code 0 only when every enabled guard passes; each guard's own
+report is printed so the failing one is obvious.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import bench_trend  # noqa: E402
+import tier1_budget  # noqa: E402
+
+
+def run_gate(records_dir: str, t1_log: str, skip_trend: bool = False,
+             skip_t1: bool = False, budget: float = None,
+             frac: float = None, out=print) -> dict:
+    """Run both guards; returns ``{"trend_ok", "t1_ok", "ok"}`` (skipped
+    guards report True and are marked in the dict)."""
+    results = {"trend_ok": True, "t1_ok": True,
+               "trend_skipped": bool(skip_trend),
+               "t1_skipped": bool(skip_t1)}
+    if not skip_trend:
+        trend = bench_trend.run(records_dir)
+        bench_trend.render_report(trend, out=out)
+        results["trend_ok"] = bool(trend["ok"])
+    else:
+        out("ci_gate: trend guard SKIPPED")
+    if not skip_t1:
+        if not os.path.exists(t1_log):
+            out(f"ci_gate: tier-1 log {t1_log!r} not found — the budget "
+                "guard cannot run, FAILING the gate (pass --skip-t1 for "
+                "a records-only check)")
+            results["t1_ok"] = False
+        else:
+            per_test, wall = tier1_budget.load(t1_log)
+            kw = {}
+            if budget is not None:
+                kw["budget"] = budget
+            if frac is not None:
+                kw["frac"] = frac
+            results["t1_ok"] = bool(
+                tier1_budget.report(per_test, wall, out=out, **kw))
+    else:
+        out("ci_gate: tier-1 budget guard SKIPPED")
+    results["ok"] = results["trend_ok"] and results["t1_ok"]
+    out(f"ci_gate: {'PASS' if results['ok'] else 'FAIL'} "
+        f"(trend_ok={results['trend_ok']}, t1_ok={results['t1_ok']})")
+    return results
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--records", default=bench_trend.ROOT,
+                    help="BENCH_r*/MULTICHIP_r* record directory")
+    ap.add_argument("--t1-log", default="/tmp/_t1.log",
+                    help="tier-1 durations JSONL or tee'd pytest log")
+    ap.add_argument("--skip-trend", action="store_true")
+    ap.add_argument("--skip-t1", action="store_true")
+    ap.add_argument("--budget", type=float, default=None)
+    ap.add_argument("--frac", type=float, default=None)
+    args = ap.parse_args(argv)
+    results = run_gate(args.records, args.t1_log,
+                       skip_trend=args.skip_trend, skip_t1=args.skip_t1,
+                       budget=args.budget, frac=args.frac)
+    return 0 if results["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

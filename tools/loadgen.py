@@ -39,13 +39,6 @@ import numpy as np
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
-def _quantile(sorted_vals: List[float], q: float) -> Optional[float]:
-    if not sorted_vals:
-        return None
-    i = min(int(q * len(sorted_vals)), len(sorted_vals) - 1)
-    return round(sorted_vals[i], 3)
-
-
 def run_loadgen(server, X: np.ndarray, *, rate_qps: float,
                 duration_s: float, rows_per_req: int = 1,
                 n_threads: int = 8, seed: int = 0,
@@ -64,7 +57,16 @@ def run_loadgen(server, X: np.ndarray, *, rate_qps: float,
     sequential requests once the swap has completed (deterministic
     post-swap coverage for the per-version parity check).
     ``check_fn(start, n_rows, result)`` may verify each response (parity
-    bookkeeping); check failures are counted, never raised mid-run."""
+    bookkeeping); check failures are counted, never raised mid-run.
+
+    Client-side telemetry lives in an obs registry (ISSUE 9): outcome
+    counts are ``loadgen_requests_total{outcome=...}`` counters, the
+    latency histogram is ``loadgen_latency_ms`` (exact quantiles over a
+    full-run sample window), per-version counts are
+    ``loadgen_version_total{version=...}`` — the returned dict is
+    computed FROM the registry, and the registry itself rides along
+    under the ``"registry"`` key for Prometheus exposition."""
+    from lightgbmv1_tpu.obs.metrics import Registry
     from lightgbmv1_tpu.serve.server import (RequestTimeout,
                                              ServerOverloaded)
 
@@ -75,13 +77,23 @@ def run_loadgen(server, X: np.ndarray, *, rate_qps: float,
     starts = rng.randint(0, max(X.shape[0] - rows_per_req, 1),
                          size=n_arrivals)
 
+    reg = Registry()
+    outcomes = reg.counter("loadgen_requests_total",
+                           "Client-side request outcomes",
+                           label_names=("outcome",))
+    for oc in ("ok", "shed", "timeout", "error", "check_failure",
+               "degraded"):
+        outcomes.labels(outcome=oc)   # pre-touch: zeros render in snapshots
+    lat_hist = reg.histogram(
+        "loadgen_latency_ms", "Client-measured request latency (ms)",
+        sample_window=n_arrivals + max(int(tail_requests_after_swap), 0)
+        + 16)
+    version_counts = reg.counter("loadgen_version_total",
+                                 "Responses per served model version",
+                                 label_names=("version",))
+
     next_idx = [0]
     idx_lock = threading.Lock()
-    out_lock = threading.Lock()
-    stats = {"ok": 0, "shed": 0, "timeout": 0, "error": 0,
-             "check_failures": 0, "degraded": 0}
-    latencies: List[float] = []
-    versions: Dict[str, int] = {}
     t0 = time.monotonic()
 
     def do_one(s: int):
@@ -90,16 +102,13 @@ def run_loadgen(server, X: np.ndarray, *, rate_qps: float,
         try:
             res = server.submit(rows)
         except ServerOverloaded:
-            with out_lock:
-                stats["shed"] += 1
+            outcomes.labels(outcome="shed").inc()
             return
         except RequestTimeout:
-            with out_lock:
-                stats["timeout"] += 1
+            outcomes.labels(outcome="timeout").inc()
             return
         except Exception:  # noqa: BLE001 — counted, run continues
-            with out_lock:
-                stats["error"] += 1
+            outcomes.labels(outcome="error").inc()
             return
         lat = (time.monotonic() - t_req) * 1e3
         ok = True
@@ -108,14 +117,13 @@ def run_loadgen(server, X: np.ndarray, *, rate_qps: float,
                 ok = bool(check_fn(s, rows_per_req, res))
             except Exception:  # noqa: BLE001
                 ok = False
-        with out_lock:
-            stats["ok"] += 1
-            if res.degraded:
-                stats["degraded"] += 1
-            if not ok:
-                stats["check_failures"] += 1
-            latencies.append(lat)
-            versions[res.version] = versions.get(res.version, 0) + 1
+        outcomes.labels(outcome="ok").inc()
+        if res.degraded:
+            outcomes.labels(outcome="degraded").inc()
+        if not ok:
+            outcomes.labels(outcome="check_failure").inc()
+        lat_hist.observe(lat)
+        version_counts.labels(version=res.version).inc()
 
     def client():
         while True:
@@ -155,9 +163,20 @@ def run_loadgen(server, X: np.ndarray, *, rate_qps: float,
             do_one(int(s))
     wall = time.monotonic() - t0
 
-    lat = sorted(latencies)
+    stats = {oc: int(outcomes.labels(outcome=oc).get())
+             for oc in ("ok", "shed", "timeout", "error")}
+    stats["check_failures"] = int(
+        outcomes.labels(outcome="check_failure").get())
+    stats["degraded"] = int(outcomes.labels(outcome="degraded").get())
+    versions = {key[0]: int(child.get())
+                for key, child in version_counts.children()}
     total = sum(stats[k] for k in ("ok", "shed", "timeout", "error"))
     snap = server.metrics_snapshot()
+
+    def q(p):
+        v = lat_hist.quantile(p)
+        return None if v is None else round(v, 3)
+
     return {
         "offered_qps": round(rate_qps, 1),
         "achieved_qps": round(stats["ok"] / wall, 1) if wall > 0 else None,
@@ -165,11 +184,14 @@ def run_loadgen(server, X: np.ndarray, *, rate_qps: float,
         "requests": total,
         **stats,
         "shed_frac": round(stats["shed"] / total, 4) if total else 0.0,
-        "client_p50_ms": _quantile(lat, 0.50),
-        "client_p99_ms": _quantile(lat, 0.99),
-        "client_p999_ms": _quantile(lat, 0.999),
+        "client_p50_ms": q(0.50),
+        "client_p99_ms": q(0.99),
+        "client_p999_ms": q(0.999),
         "versions_served": versions,
         "server_metrics": snap,
+        # the registry's own JSON view (labeled keys like
+        # loadgen_requests_total{outcome="ok"}) — same store, flat dump
+        "client_metrics": reg.snapshot(),
     }
 
 

@@ -322,6 +322,85 @@ def robustness_section(w, rec):
     w("")
 
 
+def observability_section(w, rec):
+    """Observability: the obs/ subsystem's own cost and validity record
+    (ISSUE 9 — bench.py measure_obs): armed-tracer overhead vs the
+    2% contract, off-path bit-parity, trace validity for the train and
+    serve paths, and Prometheus exposition health.  Placeholder until
+    the first capture that carries the fields."""
+    w("## Observability (span tracer + metrics registry, obs/)")
+    w("")
+    if rec.get("obs_ok") is None:
+        w("No obs fields in this record yet — the next driver capture "
+          "runs bench.py's measure_obs (A/B train with the span tracer "
+          "armed vs off, a traced serve loadgen window, and a Prometheus "
+          "exposition probe) and this section renders the overhead "
+          "fraction against the 2% contract and the `obs_ok` guard.")
+        w("")
+        return
+    w("| armed overhead frac | span cover of train wall | trace events | "
+      "off-path parity | prom exposition |")
+    w("|---|---|---|---|---|")
+    w(f"| {get(rec, 'obs_overhead_frac', 4)} | "
+      f"{get(rec, 'obs_span_cover_frac', 4)} | "
+      f"{get(rec, 'obs_trace_events', 0)} | "
+      f"{rec.get('obs_parity_ok')} | {rec.get('obs_prom_ok')} |")
+    w("")
+    w(f"Guard `obs_ok={rec.get('obs_ok')}`: armed tracing costs <= 2% of "
+      "train wall AND the disarmed run's model text is byte-identical "
+      f"(`obs_parity_ok={rec.get('obs_parity_ok')}`) AND both exported "
+      "Chrome traces are valid with train iteration spans covering the "
+      "measured wall within 10% "
+      f"(`obs_trace_ok={rec.get('obs_trace_ok')}`) and serve request "
+      "spans decomposing queue/walk "
+      f"(`obs_serve_trace_ok={rec.get('obs_serve_trace_ok')}`).  Knobs: "
+      "`obs_trace`, `trace_out`, `obs_ring_events` (BASELINE.md); "
+      "`GET /metrics` serves Prometheus text under content negotiation.")
+    w("")
+
+
+def trend_section(w, root=ROOT):
+    """Trend: the regression sentinel's view of the whole BENCH record
+    trajectory (tools/bench_trend.py — the same comparator that gates
+    captures renders this table, so PERF.md and the gate cannot
+    disagree)."""
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    try:
+        import bench_trend
+    except Exception as e:  # noqa: BLE001 — report generation must not die
+        w(f"(trend unavailable: {type(e).__name__})")
+        w("")
+        return
+    result = bench_trend.run(root)
+    w("## Trend (tools/bench_trend.py over every captured record)")
+    w("")
+    names = result["bench_records"]
+    w(f"{len(names)} BENCH records "
+      f"({names[0] if names else '—'} → {names[-1] if names else '—'}), "
+      f"{len(result['multichip_records'])} MULTICHIP PARITY records.  "
+      "Newest-record bars: watched fields within tolerance of the best "
+      "prior capture, every `*_ok` guard True — the same check "
+      "`tools/ci_gate.py` gates on.")
+    w("")
+    w("| field | newest | best prior | record | verdict |")
+    w("|---|---|---|---|---|")
+    for row in result["trend_rows"]:
+        verdict = "**REGRESSED**" if row["regressed"] else "ok"
+        prior = (f"{fmt(row['best_prior'], 4)} "
+                 f"({row['best_prior_record']})"
+                 if row["best_prior"] is not None else "first capture")
+        w(f"| {row['field']} | {fmt(row['current'], 4)} | {prior} | "
+          f"{row['record']} | {verdict} |")
+    for f in result["flags"]:
+        if f["kind"] != "regression":
+            w(f"| {f['field']} | False | — | {f['record']} | "
+              f"**{f['kind'].upper()}** |")
+    w("")
+    w(f"Sentinel verdict: {'OK' if result['ok'] else 'FLAGGED'} "
+      "(`python tools/bench_trend.py` exits non-zero on any flag).")
+    w("")
+
+
 def fmt(v, nd=2):
     if v is None:
         return "—"
@@ -553,8 +632,12 @@ def generate(rec, name, prev=None, prev_name=None):
 
     robustness_section(w, rec)
 
+    observability_section(w, rec)
+
     mc_name, mc = load_multichip()
     comm_section(w, mc_name, mc)
+
+    trend_section(w)
 
     w("## Provenance")
     w("")
