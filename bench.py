@@ -724,6 +724,9 @@ def measure_chaos():
         "chaos_n_scenarios": rec["n_scenarios"],
         "chaos_scenarios": {k: bool(v.get("ok"))
                             for k, v in rec["scenarios"].items()},
+        # flight-recorder contract (ISSUE 10): kill/wedge scenarios left
+        # exactly one validated bundle each, recovered faults left none
+        "chaos_forensics_ok": bool(rec.get("forensics_ok")),
         "chaos_seconds": round(sum(v.get("seconds", 0)
                                    for v in rec["scenarios"].values()), 1),
     }
@@ -825,12 +828,33 @@ def measure_obs(X, y, backend: str, phase_fields=None):
       span pairs carrying its trace id (``obs_serve_trace_ok``), and the
       server's ``prometheus_text()`` must parse with monotone histogram
       buckets (``obs_prom_ok``).
+    * **SLO burn-rate** (ISSUE 10) — the loadgen window's always-on
+      tracker must report a sane evaluation (SLIs in [0,1], finite burn
+      rates, worst-tail exemplar trace ids on the latency buckets) and
+      the multi-window alert logic must page on synthetic budget-burning
+      traffic and stay quiet on clean traffic (``slo_ok``).
+    * **forensics drill** (ISSUE 10) — an armed flight recorder must
+      write exactly ONE validated bundle per arming (``forensics_ok``);
+      the chaos suite separately asserts the real kill/wedge paths
+      (``chaos_forensics_ok``).
+    * **aggregation probe** (ISSUE 10) — the loadgen + server artifacts
+      of the window must merge into one Chrome trace with distinct pid
+      lanes and one additive metrics snapshot (``obs_agg_ok``).
 
     ``obs_ok`` = overhead <= 2% AND parity AND both traces valid AND the
-    exposition healthy."""
+    exposition healthy AND slo/forensics/aggregation green — the events
+    ring and SLO tracker are always-on, so their cost sits inside the
+    measured A/B walls."""
+    import shutil
+    import tempfile
+
     import lightgbmv1_tpu as lgb
+    from lightgbmv1_tpu.obs import agg as obs_agg
+    from lightgbmv1_tpu.obs import dump as obs_dump
+    from lightgbmv1_tpu.obs import events as obs_events
     from lightgbmv1_tpu.obs import trace
     from lightgbmv1_tpu.serve import ServeConfig, Server
+    from lightgbmv1_tpu.serve.slo import SLOConfig, SLOTracker
     from tools.loadgen import run_loadgen
 
     n = min(len(y), 20_000 if backend == "cpu" else 100_000)
@@ -912,12 +936,21 @@ def measure_obs(X, y, backend: str, phase_fields=None):
                       queue_depth_rows=2048, f64_scores=True,
                       predictor_kwargs={"bucket_min": 64})
     server = Server(bst, config=cfg)
+    art_dir = tempfile.mkdtemp(prefix="bench_obs_agg_")
     try:
         server.submit(pool[:32])            # warm the compiled path
         trace.arm(ring_events=1 << 15)
         lg = run_loadgen(server, pool, rate_qps=150.0, duration_s=1.5,
-                         rows_per_req=2, n_threads=4, seed=9)
+                         rows_per_req=2, n_threads=4, seed=9,
+                         export_artifacts_to=art_dir)
         serve_doc = trace.export_chrome()
+        # server-side artifact (same span ring + the replica registry)
+        # while the ring still holds the window — the aggregation probe
+        # below merges it with the loadgen's client artifact
+        ident = obs_events.identity()
+        obs_agg.export_process_artifacts(
+            art_dir, label=f"server-{ident['host']}-{ident['pid']}",
+            registry=server.metrics.registry)
         trace.reset()
         sev = serve_doc["traceEvents"]
         q_ids = {e["args"]["trace_id"] for e in sev
@@ -941,19 +974,89 @@ def measure_obs(X, y, backend: str, phase_fields=None):
                 last_name, last_v = name, v
             else:
                 last_name, last_v = None, -1
+        om_text = server.metrics.prometheus_text(exemplars=True)
         fields["obs_prom_ok"] = bool(
             "# TYPE serve_latency_ms histogram" in prom
-            and "serve_completed_total" in prom and mono_ok)
+            and "serve_completed_total" in prom and mono_ok
+            # exemplars render ONLY under OpenMetrics negotiation: the
+            # 0.0.4 exposition stays grammar-clean for classic scrapers
+            and " # {trace_id=" not in prom
+            and " # {trace_id=" in om_text)
+
+        # ---- SLO: live-window evaluation + deterministic alert probe --
+        slo = server.slo_snapshot()
+        fast_a = slo["availability"]["windows"]["fast"]
+        fast_l = slo["latency"]["windows"]["fast"]
+        exemplars = slo.get("exemplars", [])
+        fields["slo_availability"] = fast_a["sli"]
+        fields["slo_latency_sli"] = fast_l["sli"]
+        fields["slo_availability_burn"] = fast_a["burn_rate"]
+        fields["slo_exemplars"] = len(exemplars)
+        sane = (0.0 <= fast_a["sli"] <= 1.0
+                and 0.0 <= fast_l["sli"] <= 1.0
+                and fast_a["burn_rate"] >= 0.0
+                and slo["lifetime"]["total"] >= lg["ok"]
+                and exemplars
+                and all(len(str(e.get("trace_id", ""))) == 16
+                        for e in exemplars)
+                and json.dumps(slo))   # GET /slo payload serializes
+        # alert logic, replayed deterministically: 50% failures must
+        # page both windows; clean traffic must not
+        burn_cfg = SLOConfig(fast_window_s=60.0, slow_window_s=600.0)
+        hot, cold = SLOTracker(burn_cfg), SLOTracker(burn_cfg)
+        for i in range(400):
+            hot.record(i % 2 == 0, latency_ms=1.0, trace_id="x" * 16,
+                       now=1_000.0 + i * 0.1)
+            cold.record(True, latency_ms=1.0, trace_id="y" * 16,
+                        now=1_000.0 + i * 0.1)
+        alerts_ok = (
+            hot.evaluate(now=1_040.0)["alerts"]["availability_page"]
+            and not cold.evaluate(
+                now=1_040.0)["alerts"]["availability_page"])
+        fields["slo_ok"] = bool(sane and alerts_ok)
+
+        # ---- aggregation probe: loadgen + server -> one timeline ------
+        agg_summary = obs_agg.aggregate_dir(art_dir)
+        with open(agg_summary["merged_metrics"]) as fh:
+            merged = json.load(fh)["merged"]
+        fields["obs_agg_sources"] = len(agg_summary["sources"])
+        fields["obs_agg_ok"] = bool(
+            agg_summary["lanes"] >= 2
+            and merged.get('loadgen_requests_total{outcome="ok"}')
+            == lg["ok"]
+            and merged.get("serve_completed_total", 0) >= lg["ok"])
     finally:
         trace.reset()
         server.close()
+        shutil.rmtree(art_dir, ignore_errors=True)
+
+    # ---- forensics drill: one validated bundle per arming --------------
+    fdir = tempfile.mkdtemp(prefix="bench_forensics_")
+    try:
+        with obs_dump.armed_dir(fdir, config={"bench_drill": True}):
+            first = obs_dump.dump("bench_drill", error="forensics drill")
+            second = obs_dump.dump("bench_drill")   # latched: must no-op
+        bundles = obs_dump.list_bundles(fdir)
+        manifest = (obs_dump.validate_bundle(bundles[0])
+                    if len(bundles) == 1 else None)
+        fields["forensics_ok"] = bool(
+            first and second is None and len(bundles) == 1
+            and manifest and manifest["reason"] == "bench_drill"
+            and manifest["identity"]["pid"] == os.getpid())
+    except Exception:   # noqa: BLE001 — a broken recorder FAILS the guard
+        fields["forensics_ok"] = False
+    finally:
+        shutil.rmtree(fdir, ignore_errors=True)
 
     fields["obs_ok"] = bool(
         fields.get("obs_overhead_frac", 1.0) <= 0.02
         and fields.get("obs_parity_ok")
         and fields.get("obs_trace_ok")
         and fields.get("obs_serve_trace_ok")
-        and fields.get("obs_prom_ok"))
+        and fields.get("obs_prom_ok")
+        and fields.get("slo_ok")
+        and fields.get("forensics_ok")
+        and fields.get("obs_agg_ok"))
     return fields
 
 

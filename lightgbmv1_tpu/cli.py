@@ -284,7 +284,14 @@ def run_train(config: Config) -> Booster:
                 faults.fire("snapshot", site=str(total_i))
             if finished:
                 break
-    except BaseException:
+    except BaseException as e:
+        # dying run: the armed flight recorder writes its bundle HERE,
+        # while the trainer state that explains the death still exists
+        # (the injected-kill and fatal paths dump at their own seams;
+        # the once-per-arming latch keeps it to one bundle either way)
+        from .obs import dump as obs_dump
+
+        obs_dump.dump("train_crash", exc=e)
         _finish_trace()
         raise
     finally:
@@ -423,6 +430,16 @@ def run_serve(config: Config):
 
         http.shutdown()
         snap = server.metrics_snapshot()
+        obs_dir = config.obs_dir or os.environ.get("LGBMV1_OBS_DIR", "")
+        if obs_dir:
+            # per-process artifacts for tools/obs_aggregate.py — with
+            # THIS replica's registry, so the merged snapshot carries
+            # its serve counters next to the loadgen's client view
+            from .obs import agg as obs_agg
+
+            obs_agg.export_process_artifacts(
+                obs_dir, registry=server.metrics.registry)
+            log_info(f"serve: wrote obs artifacts to {obs_dir}")
         server.close()
         if tracing:
             from .obs import trace as obs_trace
@@ -480,6 +497,20 @@ def main(argv: Optional[List[str]] = None) -> int:
     # phase timing (reference: USE_TIMETAG global_timer, common.h:1054-1138;
     # scopes live in gbdt.py/cli.py; report printed at exit)
     global_timer.enabled = config.verbosity >= 1
+    # forensics & fleet identity (obs/): stamp who this process is,
+    # size the always-on event ring, and arm the crash-dump flight
+    # recorder when a crash dir is configured (knob or env — the env
+    # form reaches subprocess runs the chaos driver kills)
+    from .obs import events as obs_events
+
+    obs_events.set_identity(role=config.task)
+    if config.obs_event_ring != obs_events.DEFAULT_RING_EVENTS:
+        obs_events.configure(config.obs_event_ring)
+    crash_dir = config.crash_dir or os.environ.get("LGBMV1_CRASH_DIR", "")
+    if crash_dir:
+        from .obs import dump as obs_dump
+
+        obs_dump.arm(crash_dir, config=_config_to_params(config))
     if config.num_machines > 1 or config.machines:
         # reference: Application::InitTrain -> Network::Init
         # (application.cpp:167); here the cluster bring-up is jax.distributed
@@ -501,6 +532,15 @@ def main(argv: Optional[List[str]] = None) -> int:
         run_convert_model(config)
     else:
         log_fatal(f"Unknown task: {task}")
+    obs_dir = config.obs_dir or os.environ.get("LGBMV1_OBS_DIR", "")
+    if obs_dir and task != "serve":   # serve exports its own (with the
+        # replica's registry) inside run_serve's shutdown path
+        from .obs import agg as obs_agg
+
+        paths = obs_agg.export_process_artifacts(obs_dir)
+        log_info(f"Wrote obs artifacts to {obs_dir} "
+                 f"({', '.join(sorted(paths))}; merge with "
+                 "tools/obs_aggregate.py)")
     if global_timer.enabled and global_timer.totals:
         log_info(global_timer.report())
     return 0

@@ -505,6 +505,34 @@ class Config:
     # span ring capacity while armed; the OLDEST events are overwritten
     # under sustained load and the export reports the dropped count
     obs_ring_events: int = 65536
+    # -- forensics & fleet telemetry (ISSUE 10) ------------------------
+    # always-on structured event ring capacity (obs/events.py): the
+    # black-box tail every forensic bundle carries
+    obs_event_ring: int = 4096
+    # crash-dump flight recorder (obs/dump.py): arm it at this directory
+    # — the first crash-grade moment (unhandled exception, fatal,
+    # SIGTERM, watchdog stall, injected kill) atomically writes ONE
+    # forensic bundle there.  Empty = recorder disarmed (the
+    # LGBMV1_CRASH_DIR env var is the subprocess-spanning equivalent)
+    crash_dir: str = ""
+    # per-process telemetry artifact export (obs/agg.py): at the end of
+    # a task=train / task=serve run, write <role>-<host>-<pid>.trace.json
+    # / .metrics.json / .events.jsonl here for tools/obs_aggregate.py to
+    # merge into one Perfetto timeline.  Empty = no export
+    # (LGBMV1_OBS_DIR is the env equivalent)
+    obs_dir: str = ""
+    # -- serving SLOs (serve/slo.py; GET /slo) -------------------------
+    # availability: fraction of requests answered successfully (sheds,
+    # timeouts, batch errors and watchdog failures all spend budget)
+    serve_slo_availability_target: float = 0.999
+    # latency: fraction of SUCCESSFUL requests under the objective
+    serve_slo_latency_ms: float = 50.0
+    serve_slo_latency_target: float = 0.99
+    # multi-window burn-rate evaluation windows (page needs BOTH the
+    # fast and slow window over threshold — blips don't page, and pages
+    # clear when the fast window recovers)
+    serve_slo_fast_window_s: float = 60.0
+    serve_slo_slow_window_s: float = 600.0
 
     # -- IO -----------------------------------------------------------------
     max_bin: int = 255
@@ -665,6 +693,20 @@ class Config:
                              "snapshot needs an intact predecessor)")
         if self.obs_ring_events < 16:
             raise ValueError("obs_ring_events must be >= 16")
+        if self.obs_event_ring < 16:
+            raise ValueError("obs_event_ring must be >= 16")
+        for name in ("serve_slo_availability_target",
+                     "serve_slo_latency_target"):
+            v = getattr(self, name)
+            if not 0.0 < v < 1.0:
+                raise ValueError(f"{name} must be in (0, 1), got {v}")
+        if self.serve_slo_latency_ms <= 0:
+            raise ValueError("serve_slo_latency_ms must be > 0")
+        if not 0 < self.serve_slo_fast_window_s \
+                <= self.serve_slo_slow_window_s:
+            raise ValueError(
+                "serve_slo windows need 0 < fast_window_s <= "
+                "slow_window_s (the page rule evaluates both)")
         if self.trace_out:
             # the artifact path is the arming intent (documented knob
             # precedence: trace_out implies obs_trace)

@@ -41,7 +41,19 @@ META_NAME = "meta.npz"
 
 class BlockCacheError(RuntimeError):
     """Torn, corrupted, or incompatible block cache — raised at open/load
-    time so a damaged cache can never silently train garbage."""
+    time so a damaged cache can never silently train garbage.  Every
+    construction publishes a first-class structured event (the forensic
+    bundle of a run that died on a damaged cache names the damage)."""
+
+    def __init__(self, msg: str):
+        super().__init__(msg)
+        try:
+            from ..obs import events
+
+            events.publish("data.block_cache_error", str(msg),
+                           severity="error")
+        except Exception:   # noqa: BLE001 — the raise must proceed
+            pass
 
 
 def _sha256(data: bytes) -> str:

@@ -498,7 +498,16 @@ class GBDT:
                f"boundary (finite_guard={mode}): the last iteration's "
                "trees are suspect — roll back or resume from the "
                "previous checkpoint")
+        from ..obs import dump, events
+
+        events.publish("guard.finite_guard", msg,
+                       severity="error" if mode == "raise" else "warning",
+                       mode=mode, iteration=int(self.iter))
         if mode == "raise":
+            # a tripped finite guard is a crash-grade moment: the armed
+            # flight recorder dumps the state that explains WHICH
+            # iteration poisoned the scores before the raise unwinds it
+            dump.dump("finite_guard", error=msg)
             raise FiniteGuardError(msg)
         if not self._finite_warned:
             self._finite_warned = True

@@ -16,6 +16,23 @@ schema that crosses the train/serve boundary:
   ``BENCH_r*.json`` / ``MULTICHIP_r*.json`` trajectory (guard flips and
   >10% regressions exit non-zero so captures can be gated).
 
+The forensics-and-fleet half (ISSUE 10) builds on those:
+
+* :mod:`~lightgbmv1_tpu.obs.events` — an always-on bounded structured
+  wide-event log with process identity; every warning, fatal and guard
+  trip (finite guard, shed, watchdog, breaker, publish reject, block
+  cache, fault injection) is a first-class event.
+* :mod:`~lightgbmv1_tpu.obs.dump` — a crash-dump flight recorder: the
+  first crash-grade moment of an armed process atomically writes ONE
+  validated forensic bundle (event tail + trace + metrics + config +
+  versions) into a crash dir.
+* :mod:`~lightgbmv1_tpu.obs.agg` + ``tools/obs_aggregate.py`` — merge
+  per-process trace/metrics/event artifacts (and crash bundles) into
+  ONE Perfetto trace with pid lanes and one merged snapshot.
+* :mod:`~lightgbmv1_tpu.serve.slo` — availability/latency SLOs with
+  multi-window burn-rate evaluation and exemplar trace ids
+  (``GET /slo``).
+
 Contract: tracing is OFF by default and its off-path must cost nothing
 measurable (one module-level flag check, no allocation); armed tracing
 must stay within 2% of train wall (the BENCH ``obs_ok`` guard measures
@@ -23,8 +40,9 @@ both).  Metrics are always on — counter bumps are nanoseconds against
 millisecond iterations and requests.
 """
 
-from . import metrics, trace
+from . import agg, dump, events, metrics, trace
 from .metrics import Registry, default_registry
 from .trace import span
 
-__all__ = ["metrics", "trace", "Registry", "default_registry", "span"]
+__all__ = ["agg", "dump", "events", "metrics", "trace", "Registry",
+           "default_registry", "span"]

@@ -32,7 +32,9 @@ Design constraints:
 
 from __future__ import annotations
 
+import math
 import threading
+import time
 from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 
 # Default latency buckets (ms): roughly logarithmic from sub-ms to 10 s.
@@ -65,7 +67,7 @@ class _Child:
     """One labeled time series of a metric."""
 
     __slots__ = ("_metric", "_key", "value", "sum", "count", "buckets",
-                 "_window", "_wpos")
+                 "_window", "_wpos", "_exemplars")
 
     def __init__(self, metric: "_Metric", key: Tuple[str, ...]):
         self._metric = metric
@@ -77,6 +79,13 @@ class _Child:
                         if metric.kind == "histogram" else None)
         self._window: List[float] = []
         self._wpos = 0
+        # per-bucket worst-tail exemplar (one extra slot for +Inf):
+        # {"value", "ts", labels...} — the SLO layer attaches trace ids
+        # here so the slowest request in every latency bucket is
+        # greppable from the exposition and GET /slo
+        self._exemplars: List[Optional[dict]] = (
+            [None] * (len(metric.bucket_bounds) + 1)
+            if metric.kind == "histogram" else [])
 
     # -- counter / gauge -------------------------------------------------
     def inc(self, amount: float = 1.0) -> None:
@@ -104,20 +113,36 @@ class _Child:
             return self.value
 
     # -- histogram -------------------------------------------------------
-    def observe(self, value: float) -> None:
+    def observe(self, value: float,
+                exemplar: Optional[Dict[str, str]] = None) -> None:
+        """Record one observation.  NaN/±Inf are REJECTED (counted into
+        ``obs_bad_observations_total{metric=...}`` on the same registry
+        and published as a warning event): before this guard a single
+        ``observe(nan)`` landed silently in the +Inf bucket and poisoned
+        ``sum`` — and through it every mean — forever.  ``exemplar``
+        (e.g. ``{"trace_id": ...}``) is retained per bucket for the
+        WORST value seen there."""
         if self._metric.kind != "histogram":
             raise ValueError(f"observe() on a {self._metric.kind}")
         v = float(value)
         m = self._metric
+        if not math.isfinite(v):
+            m._on_bad_observation(v)
+            return
         with m.lock:
             self.sum += v
             self.count += 1
+            idx = len(m.bucket_bounds)        # +Inf slot
             for i, ub in enumerate(m.bucket_bounds):
                 if v <= ub:
                     self.buckets[i] += 1
+                    idx = i
                     break
-            else:
-                pass   # lands only in +Inf (the implicit final bucket)
+            if exemplar is not None:
+                cur = self._exemplars[idx]
+                if cur is None or v >= cur["value"]:
+                    self._exemplars[idx] = {
+                        "value": v, "ts": time.time(), **exemplar}
             w = m.sample_window
             if w:
                 if len(self._window) < w:
@@ -140,6 +165,16 @@ class _Child:
         with self._metric.lock:
             return len(self._window)
 
+    def exemplars(self) -> List[Tuple[str, dict]]:
+        """``[(le, exemplar_dict)]`` for buckets holding one (worst-tail
+        value + attached labels; ``le`` is the bucket bound or +Inf)."""
+        m = self._metric
+        with m.lock:
+            bounds = [_fmt_value(b) for b in m.bucket_bounds] + ["+Inf"]
+            return [(bounds[i], dict(ex))
+                    for i, ex in enumerate(self._exemplars)
+                    if ex is not None]
+
     def _reset(self) -> None:
         self.value = 0.0
         self.sum = 0.0
@@ -148,6 +183,7 @@ class _Child:
             self.buckets = [0] * len(self.buckets)
         self._window = []
         self._wpos = 0
+        self._exemplars = [None] * len(self._exemplars)
 
 
 class _Metric:
@@ -162,9 +198,30 @@ class _Metric:
         self.bucket_bounds = tuple(sorted(float(b) for b in buckets))
         self.sample_window = int(sample_window)
         self.lock = threading.Lock()
+        self._registry: Optional["Registry"] = None
         self._children: Dict[Tuple[str, ...], _Child] = {}
         if not self.label_names:
             self._children[()] = _Child(self, ())
+
+    def _on_bad_observation(self, v: float) -> None:
+        """A rejected NaN/±Inf observation: count it on the owning
+        registry (outside this metric's lock — the bad-observation
+        counter is its own metric) and publish a warning event."""
+        reg = self._registry
+        if reg is not None:
+            reg.counter(
+                "obs_bad_observations_total",
+                "Non-finite histogram observations rejected",
+                label_names=("metric",)).labels(metric=self.name).inc()
+        try:
+            from . import events
+
+            events.publish("metrics.bad_observation",
+                           f"{self.name}: non-finite observation {v!r} "
+                           "rejected", severity="warning",
+                           metric=self.name)
+        except Exception:   # noqa: BLE001 — metrics must never throw
+            pass
 
     def labels(self, **kv: str) -> _Child:
         if set(kv) != set(self.label_names):
@@ -197,14 +254,18 @@ class _Metric:
     def get(self) -> float:
         return self._solo().get()
 
-    def observe(self, value: float) -> None:
-        self._solo().observe(value)
+    def observe(self, value: float,
+                exemplar: Optional[Dict[str, str]] = None) -> None:
+        self._solo().observe(value, exemplar=exemplar)
 
     def quantile(self, q: float) -> Optional[float]:
         return self._solo().quantile(q)
 
     def window_len(self) -> int:
         return self._solo().window_len()
+
+    def exemplars(self) -> List[Tuple[str, dict]]:
+        return self._solo().exemplars()
 
     def children(self) -> List[Tuple[Tuple[str, ...], _Child]]:
         with self.lock:
@@ -236,6 +297,7 @@ class Registry:
                 return m
             m = _Metric(name, help_text, kind, label_names, buckets,
                         sample_window)
+            m._registry = self
             self._metrics[name] = m
             return m
 
@@ -293,9 +355,13 @@ class Registry:
                             int(v) if float(v) == int(v) else round(v, 6))
         return out
 
-    def prometheus_text(self) -> str:
+    def prometheus_text(self, exemplars: bool = False) -> str:
         """Prometheus text exposition (content type
-        ``text/plain; version=0.0.4``)."""
+        ``text/plain; version=0.0.4``).  ``exemplars=True`` appends
+        OpenMetrics-style exemplar suffixes to buckets that hold one —
+        only for consumers that negotiated OpenMetrics: the suffix is
+        NOT part of the 0.0.4 grammar and would break classic
+        scrapers."""
         lines: List[str] = []
         for m in self._sorted_metrics():
             if m.help:
@@ -306,15 +372,31 @@ class Registry:
             for key, child in m.children():
                 with m.lock:
                     if m.kind == "histogram":
+                        def _ex(i):
+                            ex = (child._exemplars[i] if exemplars
+                                  else None)
+                            if ex is None:
+                                return ""
+                            lbl = ",".join(
+                                f'{k}="{escape_label_value(v)}"'
+                                for k, v in ex.items()
+                                if k not in ("value", "ts"))
+                            return (f" # {{{lbl}}} "
+                                    f"{_fmt_value(ex['value'])} "
+                                    f"{ex['ts']:.3f}")
+
                         cum = 0
-                        for ub, c in zip(m.bucket_bounds, child.buckets):
+                        for i, (ub, c) in enumerate(
+                                zip(m.bucket_bounds, child.buckets)):
                             cum += c
                             ls = _label_str(m.label_names + ("le",),
                                             key + (_fmt_value(ub),))
-                            lines.append(f"{m.name}_bucket{ls} {cum}")
+                            lines.append(
+                                f"{m.name}_bucket{ls} {cum}{_ex(i)}")
                         ls = _label_str(m.label_names + ("le",),
                                         key + ("+Inf",))
-                        lines.append(f"{m.name}_bucket{ls} {child.count}")
+                        lines.append(f"{m.name}_bucket{ls} {child.count}"
+                                     f"{_ex(len(m.bucket_bounds))}")
                         base = _label_str(m.label_names, key)
                         lines.append(f"{m.name}_sum{base} "
                                      f"{_fmt_value(child.sum)}")

@@ -54,6 +54,9 @@ _ring_cap = DEFAULT_RING_EVENTS
 _ring_pos = 0                   # next slot when the ring has wrapped
 _dropped = 0
 _t_arm_ns = 0                   # export rebases timestamps to this
+_t_arm_unix_ns = 0              # wall-clock anchor of the SAME instant —
+                                # the cross-process alignment key agg.py
+                                # merges timelines on
 _phase_profile: Optional[Dict] = None
 
 _tls = threading.local()
@@ -66,13 +69,18 @@ def enabled() -> bool:
 
 def arm(ring_events: int = DEFAULT_RING_EVENTS) -> None:
     """Arm the tracer with a fresh ring of ``ring_events`` capacity."""
-    global _armed, _ring, _ring_cap, _ring_pos, _dropped, _t_arm_ns
+    global _armed, _ring, _ring_cap, _ring_pos, _dropped, _t_arm_ns, \
+        _t_arm_unix_ns
     with _lock:
         _ring = []
         _ring_cap = max(int(ring_events), 16)
         _ring_pos = 0
         _dropped = 0
+        # the two clocks are read back to back: the pair (monotonic,
+        # wall) anchors this process's relative timestamps onto the
+        # shared wall-clock axis for cross-process merging
         _t_arm_ns = time.perf_counter_ns()
+        _t_arm_unix_ns = time.time_ns()
         _armed = True
 
 
@@ -275,13 +283,15 @@ def iteration_span_end(t0_ns: int, iteration: int,
 
 def drain() -> Dict:
     """Snapshot the ring (oldest -> newest) without disturbing it:
-    ``{"events": [...], "dropped": n, "t0_ns": arm_instant}``."""
+    ``{"events": [...], "dropped": n, "t0_ns": arm_instant,
+    "t0_unix_ns": the same instant on the wall clock}``."""
     with _lock:
         if len(_ring) < _ring_cap or _ring_pos == 0:
             events = list(_ring)
         else:
             events = _ring[_ring_pos:] + _ring[:_ring_pos]
-        return {"events": events, "dropped": _dropped, "t0_ns": _t_arm_ns}
+        return {"events": events, "dropped": _dropped, "t0_ns": _t_arm_ns,
+                "t0_unix_ns": _t_arm_unix_ns}
 
 
 def export_chrome(path: Optional[str] = None) -> Dict:
@@ -295,7 +305,15 @@ def export_chrome(path: Optional[str] = None) -> Dict:
     t0 = snap["t0_ns"]
     events = []
     tids = {}
+    pre_arm = 0
     for name, cat, t_ns, dur_ns, tid, args in snap["events"]:
+        if t_ns < t0:
+            # a span ENTERED before the most recent arm() (or re-arm)
+            # carries a t0 from the previous epoch — exporting it would
+            # produce a negative ts Perfetto renders at minus-infinity.
+            # Drop it and report the count instead.
+            pre_arm += 1
+            continue
         tids.setdefault(tid, len(tids))
         ev = {
             "name": name,
@@ -312,11 +330,20 @@ def export_chrome(path: Optional[str] = None) -> Dict:
     for tid, i in tids.items():
         events.append({"name": "thread_name", "ph": "M", "pid": os.getpid(),
                        "tid": tid, "args": {"name": f"thread-{i}"}})
+    from . import events as obs_events
+
+    ident = obs_events.identity()
     doc = {
         "traceEvents": events,
         "displayTimeUnit": "ms",
         "otherData": {"dropped_events": snap["dropped"],
-                      "exporter": "lightgbmv1_tpu.obs.trace"},
+                      "pre_arm_dropped": pre_arm,
+                      "exporter": "lightgbmv1_tpu.obs.trace",
+                      # cross-process merge keys (obs/agg.py): the wall
+                      # instant ts=0 corresponds to, plus who we are
+                      "t0_unix_ns": snap["t0_unix_ns"],
+                      "host": ident["host"], "pid": ident["pid"],
+                      "role": ident["role"], "run_id": ident["run_id"]},
     }
     if path:
         from ..utils import fileio

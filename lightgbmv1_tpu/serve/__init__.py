@@ -12,7 +12,10 @@ service:
   publish and instant rollback (registry.py),
 * :class:`ServeMetrics` — QPS / latency quantiles / batch occupancy /
   queue + shed counters, one JSON snapshot (metrics.py),
-* :class:`ServeHTTP` — stdlib HTTP front-end (http.py).
+* :class:`ServeHTTP` — stdlib HTTP front-end (http.py),
+* :class:`SLOTracker` / :class:`SLOConfig` — availability + latency
+  SLOs with multi-window burn-rate evaluation and worst-tail exemplar
+  trace ids, surfaced at ``GET /slo`` (slo.py).
 
 Front doors: ``Server.submit()`` in-process, ``ServeHTTP`` over the
 wire, and CLI ``task=serve`` (cli.py).  ``tools/loadgen.py`` drives
@@ -25,10 +28,12 @@ from .server import (DispatcherDied, DispatcherStalled, RequestTimeout,
                      ServeConfig, ServeError, ServeResult, Server,
                      ServerClosed, ServerOverloaded, build_server)
 from .http import ServeHTTP
+from .slo import SLOConfig, SLOTracker
 
 __all__ = [
     "DispatcherDied", "DispatcherStalled", "ModelRegistry", "ModelVersion",
-    "PublishValidationError", "RequestTimeout", "ServeConfig",
-    "ServeError", "ServeHTTP", "ServeMetrics", "ServeResult", "Server",
-    "ServerClosed", "ServerOverloaded", "build_server",
+    "PublishValidationError", "RequestTimeout", "SLOConfig", "SLOTracker",
+    "ServeConfig", "ServeError", "ServeHTTP", "ServeMetrics",
+    "ServeResult", "Server", "ServerClosed", "ServerOverloaded",
+    "build_server",
 ]

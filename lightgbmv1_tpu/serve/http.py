@@ -83,10 +83,20 @@ def _make_handler(server: Server):
             route = self.path.split("?", 1)[0]
             if route == "/metrics":
                 if self._wants_prometheus():
-                    self._reply_text(200, server.metrics.prometheus_text(),
-                                     PROM_CONTENT_TYPE)
+                    # exemplar suffixes only for OpenMetrics consumers —
+                    # they are not part of the 0.0.4 text grammar
+                    om = "openmetrics" in self.headers.get("Accept", "")
+                    self._reply_text(
+                        200,
+                        server.metrics.prometheus_text(exemplars=om),
+                        PROM_CONTENT_TYPE)
                 else:
                     self._reply(200, server.metrics_snapshot())
+            elif route == "/slo":
+                # burn-rate evaluation + worst-tail exemplar trace ids
+                # (serve/slo.py) — the page/warn booleans an external
+                # alerter can poll without scraping histograms
+                self._reply(200, server.slo_snapshot())
             elif route == "/healthz":
                 health = server.health()
                 self._reply(200 if health["ok"] else 503, health)

@@ -137,17 +137,28 @@ class ServeMetrics:
         self._c["batch_capacity"].inc(max(bucket, 1))
         self._queue_depth.set(queue_depth)
 
-    def on_complete(self, latency_ms: float, degraded: bool = False) -> None:
+    def on_complete(self, latency_ms: float, degraded: bool = False,
+                    trace_id: str = "") -> None:
         with self._lock:
             self._t_last = time.monotonic()
         self._c["completed"].inc()
         if degraded:
             self._c["degraded"].inc()
-        self._latency.observe(latency_ms)
+        # the trace id rides as the bucket's worst-tail exemplar: the
+        # slowest request in every latency bucket stays greppable from
+        # the exposition and GET /slo
+        self._latency.observe(
+            latency_ms,
+            exemplar={"trace_id": trace_id} if trace_id else None)
+
+    def exemplars(self):
+        """``[(le, exemplar_dict)]`` of the latency histogram's
+        per-bucket worst-tail trace ids."""
+        return self._latency.exemplars()
 
     # -- read surface ----------------------------------------------------
-    def prometheus_text(self) -> str:
-        return self.registry.prometheus_text()
+    def prometheus_text(self, exemplars: bool = False) -> str:
+        return self.registry.prometheus_text(exemplars=exemplars)
 
     def snapshot(self) -> Dict[str, object]:
         """One JSON-able dict; the serve_* BENCH fields are computed from

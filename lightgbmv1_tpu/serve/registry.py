@@ -194,6 +194,12 @@ class ModelRegistry:
         except Exception as e:
             if self._metrics is not None:
                 self._metrics.on_publish_reject()
+            from ..obs import events as obs_events
+
+            obs_events.publish(
+                "serve.publish_reject",
+                f"{type(e).__name__}: {e}", severity="error",
+                n_trees=len(trees))
             log_warning(f"serve: publish rejected pre-swap "
                         f"({type(e).__name__}: {e}); active version "
                         "keeps serving")

@@ -35,11 +35,14 @@ from typing import Any, Dict, List, Optional
 
 import numpy as np
 
+from ..obs import dump as obs_dump
+from ..obs import events as obs_events
 from ..obs import trace
 from ..utils import faults
 from ..utils.log import log_info, log_warning
 from .metrics import ServeMetrics
 from .registry import ModelRegistry, ModelVersion
+from .slo import SLOConfig, SLOTracker
 
 
 class ServeError(RuntimeError):
@@ -92,6 +95,8 @@ class ServeConfig:
     watchdog_ms: float = 0.0            # stalled-batch deadline; 0 = off
     probe_rows: int = 64                # publish golden-probe batch size
                                         # (0 = structural checks only)
+    # -- SLOs (serve/slo.py): always-on burn-rate tracking ---------------
+    slo: Optional[SLOConfig] = None     # None = default SLOConfig()
     predictor_kwargs: Dict[str, Any] = field(default_factory=dict)
 
     def __post_init__(self):
@@ -108,6 +113,8 @@ class ServeConfig:
         self.breaker_failures = max(int(self.breaker_failures), 0)
         self.watchdog_ms = max(float(self.watchdog_ms), 0.0)
         self.probe_rows = max(int(self.probe_rows), 0)
+        if self.slo is None:
+            self.slo = SLOConfig()
 
 
 @dataclass
@@ -152,6 +159,10 @@ class Server:
         self.config = config or ServeConfig()
         self._t_start = time.monotonic()
         self.metrics = ServeMetrics(window=self.config.metrics_window)
+        # always-on SLO burn-rate tracking (serve/slo.py): every
+        # completed / shed / timed-out / failed request spends or
+        # preserves error budget; GET /slo reads the evaluation
+        self.slo = SLOTracker(self.config.slo)
         self.registry = registry or ModelRegistry(
             metrics=self.metrics,
             predictor_kwargs=self.config.predictor_kwargs)
@@ -166,6 +177,10 @@ class Server:
         self._consec_failures = 0
         self._dispatcher = threading.Thread(
             target=self._dispatch_loop, name="serve-dispatcher", daemon=True)
+        # a forensic bundle dumped while this replica lives should carry
+        # its per-replica metrics next to the process-wide registry
+        obs_dump.add_metrics_source(f"server-{id(self):x}",
+                                    self.metrics_snapshot)
         if model is not None:
             self.publish(model)
         self._dispatcher.start()
@@ -220,6 +235,11 @@ class Server:
                 raise ServerClosed("server is shut down")
             if self._queue_rows + req.n > self.config.queue_depth_rows:
                 self.metrics.on_shed()
+                self.slo.record(False, trace_id=req.trace_id)
+                obs_events.publish(
+                    "serve.shed", "admission queue full",
+                    severity="warning", rows=req.n,
+                    backlog=self._queue_rows, trace_id=req.trace_id)
                 raise ServerOverloaded(
                     f"queue full ({self._queue_rows} rows backlogged, "
                     f"depth {self.config.queue_depth_rows})")
@@ -238,6 +258,17 @@ class Server:
         snap["version"] = self.registry.current_tag()
         snap["versions"] = self.registry.versions()
         return snap
+
+    def slo_snapshot(self) -> Dict[str, Any]:
+        """The ``GET /slo`` payload: burn-rate evaluation + per-bucket
+        worst-tail exemplar trace ids from the latency histogram, so an
+        alerting burn rate hands the operator the request ids to grep
+        in an armed trace."""
+        out = self.slo.snapshot()
+        out["version"] = self.registry.current_tag()
+        out["exemplars"] = [
+            {"le": le, **ex} for le, ex in self.metrics.exemplars()]
+        return out
 
     def dispatcher_alive(self) -> bool:
         return self._dispatcher.is_alive() and not self._closed
@@ -329,20 +360,32 @@ class Server:
                             f"({e}); watchdog will restart")
                 return
             except BaseException as e:  # noqa: BLE001 — a poisoned batch
-                # must fail ITS requests, never kill the dispatcher
+                # must fail ITS requests, never kill the dispatcher.
+                # Breaker accounting runs BEFORE the requests are woken:
+                # a client that saw its submit fail must also see the
+                # breaker state that failure produced (the old order
+                # raced clients against the trip)
+                self._consec_failures += 1
+                self._maybe_trip_breaker()
                 self._fail_batch(batch, e)
                 log_warning(f"serve: batch failed after retries "
                             f"({type(e).__name__}: {e})")
-                self._consec_failures += 1
-                self._maybe_trip_breaker()
 
     def _fail_batch(self, batch: List[_Request], err: BaseException) -> None:
+        n_failed = 0
         for req in batch:
             if not req.event.is_set():
                 self.metrics.on_error()
+                self.slo.record(False, trace_id=req.trace_id)
                 req.error = (err if isinstance(err, Exception)
                              else ServeError(str(err)))
                 req.event.set()
+                n_failed += 1
+        if n_failed:
+            obs_events.publish(
+                "serve.batch_failed",
+                f"{type(err).__name__}: {err}", severity="error",
+                requests=n_failed)
 
     def _maybe_trip_breaker(self) -> None:
         """Circuit breaker: ``breaker_failures`` CONSECUTIVE failed
@@ -357,10 +400,16 @@ class Server:
         try:
             tag = self.registry.rollback()
         except Exception as e:  # noqa: BLE001 — nothing to roll back to
+            obs_events.publish(
+                "serve.breaker_trip", "no previous version to roll "
+                "back to", severity="error", failures=bf)
             log_warning(f"serve: circuit breaker tripped with no "
                         f"previous version to roll back to ({e})")
             return
         self.metrics.on_breaker()
+        obs_events.publish(
+            "serve.breaker_trip", f"auto-rolled back to {tag}",
+            severity="error", failures=bf, rolled_back_to=tag)
         log_warning(f"serve: circuit breaker tripped after {bf} "
                     f"consecutive batch failures — rolled back to {tag}")
 
@@ -395,6 +444,7 @@ class Server:
         for req in batch:
             if req.deadline is not None and now > req.deadline:
                 self.metrics.on_timeout()
+                self.slo.record(False, trace_id=req.trace_id)
                 req.error = RequestTimeout(
                     f"deadline expired after "
                     f"{(now - req.t_enq) * 1e3:.1f} ms in queue")
@@ -456,7 +506,10 @@ class Server:
                 degraded=degraded, batch_rows=n, trace_id=req.trace_id,
                 queue_ms=max((t_collect - req.t_enq) * 1e3, 0.0),
                 walk_ms=walk_ms)
-            self.metrics.on_complete(lat_ms, degraded)
+            self.metrics.on_complete(lat_ms, degraded,
+                                     trace_id=req.trace_id)
+            self.slo.record(True, latency_ms=lat_ms,
+                            trace_id=req.trace_id)
             req.event.set()
 
     # -- watchdog --------------------------------------------------------
@@ -483,13 +536,31 @@ class Server:
                                 f"{self.config.watchdog_ms:.0f} ms "
                                 "watchdog deadline")
                             req.event.set()
+                            self.slo.record(False, trace_id=req.trace_id)
                             n_failed += 1
                     if n_failed:
                         self.metrics.on_watchdog(n_failed)
+                        obs_events.publish(
+                            "serve.watchdog_stall",
+                            f"stalled batch failed {n_failed} "
+                            "request(s)", severity="error",
+                            requests=n_failed,
+                            watchdog_ms=self.config.watchdog_ms)
+                        # a wedged device batch is a crash-grade moment:
+                        # give the armed flight recorder its dump (the
+                        # process survives, the evidence must too)
+                        obs_dump.dump(
+                            "watchdog_stall",
+                            error=f"device batch exceeded "
+                                  f"{self.config.watchdog_ms:.0f} ms")
                         log_warning(
                             f"serve: watchdog failed {n_failed} "
                             "request(s) of a stalled batch")
             if not self._dispatcher.is_alive() and not self._closed:
+                obs_events.publish(
+                    "serve.dispatcher_restart",
+                    "dispatcher thread dead — restarting",
+                    severity="error")
                 log_warning("serve: dispatcher thread dead — restarting")
                 self.metrics.on_dispatcher_restart()
                 self._dispatcher = threading.Thread(
@@ -513,6 +584,13 @@ def build_server(booster, config) -> Server:
         breaker_failures=config.serve_breaker_failures,
         watchdog_ms=config.serve_watchdog_ms,
         probe_rows=config.serve_probe_rows,
+        slo=SLOConfig(
+            availability_target=config.serve_slo_availability_target,
+            latency_ms=config.serve_slo_latency_ms,
+            latency_target=config.serve_slo_latency_target,
+            fast_window_s=config.serve_slo_fast_window_s,
+            slow_window_s=config.serve_slo_slow_window_s,
+        ),
         predictor_kwargs={
             "bucket_min": config.predict_bucket_min,
             "cache_entries": config.predict_cache_entries,

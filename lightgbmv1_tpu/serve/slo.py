@@ -1,0 +1,226 @@
+"""Serving SLOs with multi-window burn-rate evaluation.
+
+A raw p999 gauge tells an operator a replica is slow *right now*; it
+cannot answer the question that actually pages someone: **are we
+spending our error budget fast enough to miss the SLO this period?**
+This module is the standard answer (the SRE-workbook multi-window
+multi-burn-rate rule) applied to the two objectives the serving path
+owns:
+
+* **availability** — fraction of admitted-or-shed requests answered
+  successfully (sheds, queue timeouts, batch errors and watchdog
+  failures all spend budget: a request the client had to retry is a
+  failure no matter which internal mechanism refused it);
+* **latency** — fraction of *successful* requests answered under the
+  objective threshold (failed requests are availability's problem;
+  counting them here would double-bill one incident against two
+  budgets).
+
+**Burn rate** is error-fraction divided by the budget fraction
+``(1 - target)``: burn 1.0 spends the budget exactly over the period,
+burn 14.4 exhausts a 30-day budget in ~2 days.  Evaluation runs over
+two windows — a slow window (the trend) and a fast window (the
+confirmation that the problem is *still* happening) — and an alert
+requires BOTH above threshold: the fast window alone pages on blips,
+the slow window alone keeps paging long after recovery.  ``page`` uses
+``fast_burn`` (default 14.4), ``warn`` uses ``slow_burn`` (default 6).
+
+**Exemplars**: every completed request's latency lands in the serving
+histogram with its trace id attached (obs/metrics.py per-bucket
+worst-tail exemplars), and the tracker keeps the global worst-K
+``(latency, trace_id)`` — so ``GET /slo`` hands the operator the exact
+request ids to grep in an armed trace, closing the loop from "budget is
+burning" to "this is the request that burned it".
+
+State is a time-bucketed ring (``bucket_s`` resolution, sized to the
+slow window): O(slow_window / bucket_s) memory, O(1) record, no
+per-request allocation beyond the worst-K list.  All entry points take
+an optional explicit ``now`` so tests replay traffic deterministically.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+import time
+from dataclasses import dataclass
+from typing import Dict, List, Optional
+
+
+@dataclass
+class SLOConfig:
+    """SLO policy knobs (mirrored by the ``serve_slo_*`` config names)."""
+
+    availability_target: float = 0.999   # fraction answered successfully
+    latency_ms: float = 50.0             # latency objective threshold
+    latency_target: float = 0.99         # fraction of good reqs under it
+    fast_window_s: float = 60.0          # short confirmation window
+    slow_window_s: float = 600.0         # long trend window
+    fast_burn: float = 14.4              # page threshold (both windows)
+    slow_burn: float = 6.0               # warn threshold (both windows)
+    bucket_s: float = 1.0                # ring resolution
+    worst_k: int = 8                     # exemplar trace ids retained
+
+    def __post_init__(self):
+        for name in ("availability_target", "latency_target"):
+            v = float(getattr(self, name))
+            if not 0.0 < v < 1.0:
+                raise ValueError(f"{name} must be in (0, 1), got {v}")
+            setattr(self, name, v)
+        self.latency_ms = max(float(self.latency_ms), 0.0)
+        self.bucket_s = max(float(self.bucket_s), 1e-3)
+        self.fast_window_s = max(float(self.fast_window_s), self.bucket_s)
+        self.slow_window_s = max(float(self.slow_window_s),
+                                 self.fast_window_s)
+        self.fast_burn = max(float(self.fast_burn), 0.0)
+        self.slow_burn = max(float(self.slow_burn), 0.0)
+        self.worst_k = max(int(self.worst_k), 0)
+
+
+class _Bucket:
+    __slots__ = ("idx", "total", "errors", "slow")
+
+    def __init__(self):
+        self.idx = -1
+        self.total = 0
+        self.errors = 0
+        self.slow = 0
+
+    def reset(self, idx: int) -> None:
+        self.idx = idx
+        self.total = 0
+        self.errors = 0
+        self.slow = 0
+
+
+class SLOTracker:
+    """Thread-safe request-outcome accumulator + burn-rate evaluator."""
+
+    def __init__(self, config: Optional[SLOConfig] = None):
+        self.config = config or SLOConfig()
+        n = int(math.ceil(self.config.slow_window_s
+                          / self.config.bucket_s)) + 1
+        self._buckets = [_Bucket() for _ in range(n)]
+        self._worst: List[Dict[str, object]] = []
+        self._lock = threading.Lock()
+        self._total = 0
+        self._errors = 0
+
+    # -- write path ------------------------------------------------------
+    def record(self, ok: bool, latency_ms: Optional[float] = None,
+               trace_id: str = "", now: Optional[float] = None) -> None:
+        """One finished request: ``ok=False`` for shed / timeout / batch
+        error / watchdog failure (availability budget), ``ok=True`` with
+        its latency for an answered one (latency budget)."""
+        cfg = self.config
+        t = time.monotonic() if now is None else float(now)
+        idx = int(t // cfg.bucket_s)
+        with self._lock:
+            b = self._buckets[idx % len(self._buckets)]
+            if b.idx != idx:
+                b.reset(idx)
+            b.total += 1
+            self._total += 1
+            if not ok:
+                b.errors += 1
+                self._errors += 1
+                return
+            if latency_ms is None:
+                return
+            lat = float(latency_ms)
+            if lat > cfg.latency_ms:
+                b.slow += 1
+            if cfg.worst_k and trace_id:
+                w = self._worst
+                if len(w) < cfg.worst_k or lat > w[-1]["latency_ms"]:
+                    w.append({"latency_ms": round(lat, 3),
+                              "trace_id": trace_id})
+                    w.sort(key=lambda e: -e["latency_ms"])
+                    del w[cfg.worst_k:]
+
+    # -- read path -------------------------------------------------------
+    def _window(self, window_s: float, now: float) -> Dict[str, int]:
+        cfg = self.config
+        lo = int((now - window_s) // cfg.bucket_s) + 1
+        hi = int(now // cfg.bucket_s)
+        total = errors = slow = 0
+        for b in self._buckets:
+            if lo <= b.idx <= hi:
+                total += b.total
+                errors += b.errors
+                slow += b.slow
+        return {"total": total, "errors": errors, "slow": slow}
+
+    @staticmethod
+    def _burn(frac: float, target: float) -> float:
+        budget = 1.0 - target
+        return frac / budget if budget > 0 else 0.0
+
+    def evaluate(self, now: Optional[float] = None) -> Dict[str, object]:
+        """Multi-window burn-rate evaluation; alert booleans require
+        BOTH windows over threshold (see module docstring)."""
+        cfg = self.config
+        t = time.monotonic() if now is None else float(now)
+        with self._lock:
+            wins = {"fast": {"window_s": cfg.fast_window_s,
+                             **self._window(cfg.fast_window_s, t)},
+                    "slow": {"window_s": cfg.slow_window_s,
+                             **self._window(cfg.slow_window_s, t)}}
+            worst = [dict(e) for e in self._worst]
+            lifetime = {"total": self._total, "errors": self._errors}
+        avail = {}
+        lat = {}
+        for name, w in wins.items():
+            total, errors, slow = w["total"], w["errors"], w["slow"]
+            err_frac = errors / total if total else 0.0
+            good = total - errors
+            slow_frac = slow / good if good else 0.0
+            avail[name] = {
+                "window_s": w["window_s"], "total": total,
+                "errors": errors, "sli": round(1.0 - err_frac, 6),
+                "burn_rate": round(
+                    self._burn(err_frac, cfg.availability_target), 4),
+            }
+            lat[name] = {
+                "window_s": w["window_s"], "good": good, "slow": slow,
+                "sli": round(1.0 - slow_frac, 6),
+                "burn_rate": round(
+                    self._burn(slow_frac, cfg.latency_target), 4),
+            }
+
+        def both_over(d, bar):
+            return bool(d["fast"]["burn_rate"] >= bar
+                        and d["slow"]["burn_rate"] >= bar)
+
+        return {
+            "availability": {"target": cfg.availability_target,
+                             "windows": avail},
+            "latency": {"target": cfg.latency_target,
+                        "objective_ms": cfg.latency_ms,
+                        "windows": lat},
+            "alerts": {
+                "availability_page": both_over(avail, cfg.fast_burn),
+                "availability_warn": both_over(avail, cfg.slow_burn),
+                "latency_page": both_over(lat, cfg.fast_burn),
+                "latency_warn": both_over(lat, cfg.slow_burn),
+            },
+            "worst": worst,
+            "lifetime": lifetime,
+        }
+
+    def snapshot(self, now: Optional[float] = None) -> Dict[str, object]:
+        """The ``GET /slo`` payload: the evaluation plus the config echo
+        (an operator reading the endpoint must not need the deploy repo
+        to know what the targets ARE)."""
+        out = self.evaluate(now=now)
+        cfg = self.config
+        out["config"] = {
+            "availability_target": cfg.availability_target,
+            "latency_ms": cfg.latency_ms,
+            "latency_target": cfg.latency_target,
+            "fast_window_s": cfg.fast_window_s,
+            "slow_window_s": cfg.slow_window_s,
+            "fast_burn": cfg.fast_burn,
+            "slow_burn": cfg.slow_burn,
+        }
+        return out

@@ -226,6 +226,17 @@ def fire(kind: str, site: str = "") -> Optional[FaultSpec]:
     sp = plan.on_event(kind, site)
     if sp is None:
         return None
+    # every firing injection is a first-class structured event — the
+    # forensic bundle of the crash it induces must name its own cause
+    try:
+        from ..obs import events
+
+        events.publish("fault.injected",
+                       f"{kind} fault ({sp.mode}) at {site or '<any>'}",
+                       severity="warning", fault_kind=kind, site=site,
+                       mode=sp.mode)
+    except Exception:   # noqa: BLE001 — injection must stay injection
+        pass
     if sp.mode == "raise":
         raise FaultInjected(f"injected {kind} fault at {site or '<any>'}")
     if sp.mode == "stall":
@@ -233,7 +244,15 @@ def fire(kind: str, site: str = "") -> Optional[FaultSpec]:
         time.sleep(sp.stall_s)
         return sp
     if sp.mode == "kill":
-        # the honest crash: no atexit, no finally blocks, no flush
+        # the honest crash: no atexit, no finally blocks, no flush —
+        # but a real panicking process gets its black box out first,
+        # so the armed flight recorder dumps before the lights go out
+        try:
+            from ..obs import dump
+
+            dump.dump("fault_kill", error=f"{kind} kill at {site}")
+        except Exception:   # noqa: BLE001
+            pass
         os._exit(137)
     if sp.mode == "exit_thread":
         raise ThreadKilled(f"injected {kind} thread death at {site}")

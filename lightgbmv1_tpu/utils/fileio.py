@@ -80,8 +80,18 @@ def atomic_write_bytes(path, data: bytes, site: str = "") -> None:
             fh.flush()
             os.fsync(fh.fileno())
         if sp is not None and sp.mode == "kill":
-            os._exit(137)   # crash between tmp write and rename: the old
-                            # file must survive intact
+            # crash between tmp write and rename: the old file must
+            # survive intact — and the armed flight recorder dumps
+            # first (guarded against recursing into THIS writer mid-kill)
+            if "forensics_bundle" not in (site or path):
+                try:
+                    from ..obs import dump
+
+                    dump.dump("fault_kill",
+                              error=f"file_write kill at {site or path}")
+                except Exception:   # noqa: BLE001
+                    pass
+            os._exit(137)
         os.replace(tmp, path)
         try:
             dfd = os.open(d, os.O_RDONLY)

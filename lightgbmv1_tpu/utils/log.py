@@ -2,16 +2,28 @@
 
 Fatal raises (the reference throws std::runtime_error); callbacks can be
 registered the way ``LGBM_RegisterLogCallback`` allows (c_api.h:54).
+
+Observability wiring (ISSUE 10): every emitted line counts into the
+default registry (``log_messages_total{level=...}``), warnings and
+fatals additionally publish first-class structured events
+(:mod:`~lightgbmv1_tpu.obs.events`) so the flight-recorder bundle
+carries the process's last words, and a fatal triggers the crash dump
+when the recorder is armed.  ``register_callback``/``_emit`` are
+thread-safe: serving threads log concurrently with a test (or an
+embedding application) swapping the callback or the verbosity.
 """
 
 from __future__ import annotations
 
 import sys
+import threading
 from typing import Callable, Optional
 
 _LEVELS = {"fatal": -1, "warning": 0, "info": 1, "debug": 2}
 _level = 1
 _callback: Optional[Callable[[str], None]] = None
+_lock = threading.Lock()      # guards _level/_callback swaps vs reads
+_counter = None               # lazily bound log_messages_total{level}
 
 
 class LightGBMError(RuntimeError):
@@ -21,35 +33,74 @@ class LightGBMError(RuntimeError):
 def set_verbosity(verbosity: int) -> None:
     """Map reference ``verbosity`` param: <0 fatal, 0 warning, 1 info, >1 debug."""
     global _level
-    _level = max(-1, min(2, verbosity))
+    with _lock:
+        _level = max(-1, min(2, verbosity))
 
 
 def register_callback(fn: Optional[Callable[[str], None]]) -> None:
     global _callback
-    _callback = fn
+    with _lock:
+        _callback = fn
 
 
-def _emit(msg: str) -> None:
-    if _callback is not None:
-        _callback(msg)
+def _count(level: str) -> None:
+    global _counter
+    try:
+        if _counter is None:
+            from ..obs.metrics import default_registry
+
+            _counter = default_registry().counter(
+                "log_messages_total", "Log lines emitted",
+                label_names=("level",))
+        _counter.labels(level=level).inc()
+    except Exception:   # noqa: BLE001 — logging must never throw
+        pass
+
+
+def _publish_event(severity: str, msg: str) -> None:
+    try:
+        from ..obs import events
+
+        events.publish(f"log.{severity}", msg, severity=severity)
+    except Exception:   # noqa: BLE001
+        pass
+
+
+def _emit(msg: str, level: str = "info") -> None:
+    _count(level)
+    with _lock:
+        cb = _callback
+    if cb is not None:
+        cb(msg)
     else:
         print(msg, file=sys.stderr, flush=True)
 
 
 def log_debug(msg: str) -> None:
     if _level >= 2:
-        _emit(f"[LightGBM-TPU] [Debug] {msg}")
+        _emit(f"[LightGBM-TPU] [Debug] {msg}", "debug")
 
 
 def log_info(msg: str) -> None:
     if _level >= 1:
-        _emit(f"[LightGBM-TPU] [Info] {msg}")
+        _emit(f"[LightGBM-TPU] [Info] {msg}", "info")
 
 
 def log_warning(msg: str) -> None:
     if _level >= 0:
-        _emit(f"[LightGBM-TPU] [Warning] {msg}")
+        _publish_event("warning", msg)
+        _emit(f"[LightGBM-TPU] [Warning] {msg}", "warning")
 
 
 def log_fatal(msg: str) -> None:
+    # the fatal path is unconditional: count, publish the event, give
+    # the armed flight recorder its dump moment, then raise
+    _count("fatal")
+    _publish_event("fatal", msg)
+    try:
+        from ..obs import dump
+
+        dump.dump("fatal", error=msg)
+    except Exception:   # noqa: BLE001 — dying loudly beats dying twice
+        pass
     raise LightGBMError(msg)

@@ -1,0 +1,246 @@
+"""Cross-process trace/metrics aggregation (obs/agg.py +
+tools/obs_aggregate.py).
+
+Contracts under test:
+
+* **trace merge** — per-process Chrome docs rebase onto the earliest
+  wall-clock anchor, each source gets a DISTINCT pid lane with a
+  ``process_name`` metadata record, anchored sources line up on one
+  time axis;
+* **metrics merge** — ``*_total``/``*_count``/``*_sum`` sum across
+  processes, ``*_max`` maxes, everything else stays per-process only;
+* **loadgen + server run** — the artifacts of a real traced serve
+  window (server export + loadgen client export) merge into one trace
+  with >= 2 lanes and one additive snapshot, and the obs_aggregate CLI
+  drives the same path end to end;
+* **multihost subprocess smoke** — REAL worker subprocesses (the
+  ``dist_data``/multihost spawn pattern) each export artifacts; the
+  merged trace carries one lane per OS pid;
+* **crash bundles as sources** — a dead process's forensic bundle
+  contributes its trace/metrics/events next to the clean exports.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from lightgbmv1_tpu.obs import agg, dump, events, trace
+from lightgbmv1_tpu.obs.metrics import Registry
+
+from conftest import make_binary_problem
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    trace.reset()
+    yield
+    trace.reset()
+
+
+def _doc(role, pid, t0_unix_ns, spans):
+    return {
+        "traceEvents": [
+            {"name": n, "cat": "t", "ph": "X", "ts": ts, "dur": dur,
+             "pid": pid, "tid": 1}
+            for n, ts, dur in spans],
+        "otherData": {"t0_unix_ns": t0_unix_ns, "host": "h", "pid": pid,
+                      "role": role, "run_id": "r", "dropped_events": 0},
+    }
+
+
+def test_merge_trace_docs_lanes_names_and_rebase():
+    base = 1_000_000_000_000_000_000
+    # worker B armed 2 ms after worker A: its spans shift +2000 µs
+    a = _doc("trainer", 100, base, [("a.work", 0.0, 50.0)])
+    b = _doc("server", 100, base + 2_000_000, [("b.work", 10.0, 5.0)])
+    merged = agg.merge_trace_docs([("A", a), ("B", b)])
+    evs = [e for e in merged["traceEvents"] if e.get("ph") == "X"]
+    names = {e["name"]: e for e in evs}
+    # distinct lanes even though both sources claim OS pid 100
+    assert names["a.work"]["pid"] != names["b.work"]["pid"]
+    assert names["a.work"]["ts"] == 0.0
+    assert names["b.work"]["ts"] == pytest.approx(2010.0)
+    procs = {e["pid"]: e["args"]["name"]
+             for e in merged["traceEvents"]
+             if e.get("name") == "process_name"}
+    assert set(procs.values()) == {"trainer h:100", "server h:100"}
+    assert merged["otherData"]["merged_from"] == 2
+    assert [s["label"] for s in merged["otherData"]["sources"]] \
+        == ["A", "B"]
+    json.dumps(merged)
+
+
+def test_merge_trace_doc_without_anchor_keeps_zero():
+    base = 1_000_000_000_000_000_000
+    a = _doc("w", 1, base, [("a", 0.0, 1.0)])
+    foreign = {"traceEvents": [{"name": "f", "ph": "X", "ts": 7.0,
+                                "dur": 1.0, "pid": 9, "tid": 0}]}
+    merged = agg.merge_trace_docs([("A", a), ("F", foreign)])
+    f = [e for e in merged["traceEvents"] if e.get("name") == "f"][0]
+    assert f["ts"] == 7.0          # no anchor: no rebase invented
+
+
+def test_merge_metrics_snapshot_rules():
+    out = agg.merge_metrics_snapshots({
+        "p1": {"req_total": 3, "lat_ms_sum": 10.0, "lat_ms_count": 4,
+               "queue_depth_max": 7, "queue_depth": 2, "frac": 0.5,
+               'byo_total{k="v"}': 2},
+        "p2": {"req_total": 5, "lat_ms_sum": 2.5, "lat_ms_count": 1,
+               "queue_depth_max": 3, 'byo_total{k="v"}': 1},
+    })
+    m = out["merged"]
+    assert m["req_total"] == 8
+    assert m["lat_ms_sum"] == 12.5 and m["lat_ms_count"] == 5
+    assert m["queue_depth_max"] == 7            # max, not sum
+    assert m['byo_total{k="v"}'] == 3           # labeled keys merge too
+    assert "queue_depth" not in m               # gauges stay per-process
+    assert "frac" not in m                      # ratios never sum
+    assert out["processes"]["p1"]["queue_depth"] == 2
+
+
+def test_merge_event_lists_orders_by_wall_clock():
+    l1 = [{"t_wall": 10.0, "seq": 1, "pid": 1, "kind": "a"},
+          {"t_wall": 30.0, "seq": 2, "pid": 1, "kind": "c"}]
+    l2 = [{"t_wall": 20.0, "seq": 1, "pid": 2, "kind": "b"}]
+    merged = agg.merge_event_lists([l1, l2])
+    assert [e["kind"] for e in merged] == ["a", "b", "c"]
+
+
+def test_aggregate_loadgen_server_run(tmp_path, booster=None):
+    """A real traced serve window: the server's artifact (span ring +
+    replica registry) and the loadgen's client artifact merge into one
+    trace with distinct lanes and ONE additive snapshot."""
+    import lightgbmv1_tpu as lgb
+    from lightgbmv1_tpu.serve import ServeConfig, Server
+    from tools.loadgen import run_loadgen
+
+    X, y = make_binary_problem(800, 5, seed=11)
+    bst = lgb.train({"objective": "binary", "num_leaves": 7,
+                     "min_data_in_leaf": 5, "verbosity": -1},
+                    lgb.Dataset(X, label=y), num_boost_round=2)
+    srv = Server(bst, config=ServeConfig(
+        max_batch_rows=64, max_batch_delay_ms=1.0, f64_scores=True,
+        predictor_kwargs={"bucket_min": 64}))
+    art = tmp_path / "arts"
+    try:
+        srv.submit(X[:4])
+        trace.arm(ring_events=4096)
+        lg = run_loadgen(srv, X, rate_qps=80.0, duration_s=0.4,
+                         rows_per_req=1, n_threads=3, seed=5,
+                         export_artifacts_to=str(art))
+        ident = events.identity()
+        agg.export_process_artifacts(
+            str(art), label=f"server-{ident['host']}-{ident['pid']}",
+            registry=srv.metrics.registry)
+    finally:
+        srv.close()
+    summary = agg.aggregate_dir(str(art))
+    assert len(summary["sources"]) == 2
+    assert summary["lanes"] >= 2
+    with open(summary["merged_trace"]) as fh:
+        doc = json.load(fh)
+    lane_names = [e["args"]["name"] for e in doc["traceEvents"]
+                  if e.get("name") == "process_name"]
+    assert len(lane_names) == 2
+    # serve spans landed in the merged timeline
+    assert any(e.get("name") == "serve.batch"
+               for e in doc["traceEvents"])
+    with open(summary["merged_metrics"]) as fh:
+        merged = json.load(fh)["merged"]
+    assert merged['loadgen_requests_total{outcome="ok"}'] == lg["ok"]
+    assert merged["serve_completed_total"] >= lg["ok"]
+    # CLI drives the same path (fresh outputs, exit 0)
+    import obs_aggregate
+
+    out2 = tmp_path / "cli.trace.json"
+    assert obs_aggregate.main([str(art), "--out", str(out2),
+                               "--json"]) == 0
+    assert json.load(open(out2))["otherData"]["merged_from"] == 2
+
+
+def test_aggregate_dir_includes_crash_bundles(tmp_path):
+    """A crashed process's forensic bundle is a first-class aggregation
+    source: its trace/metrics/events merge next to clean exports."""
+    trace.arm(ring_events=64)
+    with trace.span("doomed.work"):
+        pass
+    dump.arm(str(tmp_path))
+    try:
+        assert dump.dump("agg_test") is not None
+    finally:
+        dump.disarm()
+    trace.reset()
+    # plus one clean artifact from a "surviving" process
+    reg = Registry()
+    reg.counter("x_total").inc(2)
+    trace.arm(ring_events=64)
+    with trace.span("survivor.work"):
+        pass
+    agg.export_process_artifacts(str(tmp_path), label="survivor",
+                                 registry=reg)
+    summary = agg.aggregate_dir(str(tmp_path))
+    assert len(summary["sources"]) == 2
+    with open(summary["merged_trace"]) as fh:
+        names = {e.get("name") for e in json.load(fh)["traceEvents"]}
+    assert {"doomed.work", "survivor.work"} <= names
+
+
+def test_empty_dir_cli_exits_nonzero(tmp_path):
+    import obs_aggregate
+
+    assert obs_aggregate.main([str(tmp_path)]) == 1
+
+
+WORKER = """
+import os, sys, time
+sys.path.insert(0, {repo!r})
+from lightgbmv1_tpu.obs import agg, events, trace
+events.set_identity(role=sys.argv[1], run_id="smoke")
+trace.arm(ring_events=256)
+with trace.span(sys.argv[1] + ".step", cat="work"):
+    time.sleep(0.01)
+from lightgbmv1_tpu.obs.metrics import default_registry
+default_registry().counter("worker_steps_total").inc()
+agg.export_process_artifacts(sys.argv[2])
+print("DONE", os.getpid())
+"""
+
+
+def test_multihost_subprocess_smoke(tmp_path):
+    """The multihost pattern: N REAL worker processes export their own
+    artifacts; the merged trace carries one lane per OS pid and the
+    merged snapshot sums their counters."""
+    script = WORKER.format(repo=REPO)
+    procs = [subprocess.Popen(
+        [sys.executable, "-c", script, f"worker{i}", str(tmp_path)],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"))
+        for i in range(2)]
+    pids = []
+    for p in procs:
+        out, _ = p.communicate(timeout=120)
+        assert p.returncode == 0, out
+        pids.append(int(out.split()[-1]))
+    summary = agg.aggregate_dir(str(tmp_path))
+    assert len(summary["sources"]) == 2
+    assert summary["lanes"] == 2
+    with open(summary["merged_trace"]) as fh:
+        doc = json.load(fh)
+    lane_names = sorted(e["args"]["name"] for e in doc["traceEvents"]
+                        if e.get("name") == "process_name")
+    # one lane per REAL pid, named role host:pid
+    for name, pid in zip(lane_names, sorted(pids)):
+        assert str(pid) in name
+    spans = sorted(e["name"] for e in doc["traceEvents"]
+                   if e.get("ph") == "X")
+    assert spans == ["worker0.step", "worker1.step"]
+    with open(summary["merged_metrics"]) as fh:
+        merged = json.load(fh)["merged"]
+    assert merged["worker_steps_total"] == 2

@@ -60,12 +60,18 @@ def test_poisoned_gradients_detected_and_clamped():
     out = chaos.scenario_poisoned_gradients()
     assert out["detected_at_boundary"], out
     assert out["clamp_survived"], out
+    # forensics: the raise-mode trip left exactly ONE validated bundle
+    assert out["forensics_ok"] and out["bundles"] == 1, out
+    assert out["bundle_reason"] == "finite_guard", out
     assert out["ok"]
 
 
 def test_publish_of_garbage_never_serves():
     out = chaos.scenario_publish_of_garbage()
     assert out["garbage_rejected"] and out["active_served_exact"], out
+    # forensics: a recovered fault writes NO bundle, only reject events
+    assert out["forensics_ok"] and out["bundles"] == 0, out
+    assert out["reject_events"] >= 2, out
     assert out["ok"]
 
 
@@ -86,4 +92,6 @@ def test_overload_sheds_bounded():
 def test_h2d_transient_retried():
     out = chaos.scenario_h2d_transient()
     assert out["retries"] >= 1 and out["answer_exact"], out
+    assert out["forensics_ok"] and out["bundles"] == 0, out
+    assert out["fault_events"] >= 1, out
     assert out["ok"]

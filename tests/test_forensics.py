@@ -1,0 +1,359 @@
+"""Failure forensics (ISSUE 10): structured event log + flight recorder.
+
+Contracts under test:
+
+* **event log** (obs/events.py) — always-on bounded ring: publish /
+  tail / since-seq bookmarks / kind filters, process identity stamping,
+  oldest-overwrite with a drop count, severity counting into the
+  default registry, JSONL round-trip (incl. torn tail lines), and the
+  guard-trip publishers (log warnings, BlockCacheError, fault
+  injections).
+* **flight recorder** (obs/dump.py) — an armed process's first
+  crash-grade moment writes EXACTLY ONE forensic bundle, atomically;
+  ``validate_bundle`` enforces schema + member digests +
+  Perfetto-loadable trace and rejects tampered bundles; hooks cover
+  unhandled thread exceptions and SIGTERM (real signal, subprocess);
+  the CLI arms from the ``crash_dir`` knob and a dying ``task=train``
+  leaves one bundle naming the crash.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+import zipfile
+
+import pytest
+
+from lightgbmv1_tpu.obs import dump, events
+from lightgbmv1_tpu.obs import metrics as obs_metrics
+from lightgbmv1_tpu.utils import log
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    events.reset()
+    dump.disarm()
+    yield
+    events.reset()
+    dump.disarm()
+
+
+# ---------------------------------------------------------------------------
+# event log
+# ---------------------------------------------------------------------------
+
+
+def test_event_publish_identity_and_filters():
+    mark = events.seq()
+    ev = events.publish("test.alpha", "hello", severity="warning", n=3)
+    events.publish("test.beta", "other", severity="error")
+    events.publish("other.kind", "x")
+    assert ev["seq"] > mark and ev["severity"] == "warning"
+    assert ev["message"] == "hello" and ev["fields"] == {"n": 3}
+    # identity stamped on every event
+    ident = events.identity()
+    assert ev["host"] == ident["host"] and ev["pid"] == os.getpid()
+    assert ev["role"] and ev["run_id"]
+    # monotone clocks + wall time present
+    assert ev["t_mono_ns"] > 0 and ev["t_wall"] > 1e9
+    # bookmarks and kind filters
+    assert len(events.tail(since_seq=mark)) == 3
+    assert [e["kind"] for e in events.tail(since_seq=mark,
+                                           kind_prefix="test.")] \
+        == ["test.alpha", "test.beta"]
+    assert len(events.tail(n=1, since_seq=mark)) == 1
+
+
+def test_event_ring_bounded_oldest_overwritten():
+    events.configure(capacity=16)
+    try:
+        for i in range(40):
+            events.publish("ring.ev", str(i))
+        tail = events.tail(kind_prefix="ring.")
+        assert len(tail) == 16
+        assert [e["message"] for e in tail] == [str(i)
+                                                for i in range(24, 40)]
+        assert events.dropped() == 24
+    finally:
+        events.configure()   # restore default capacity
+
+
+def test_event_severity_counts_into_default_registry():
+    reg = obs_metrics.default_registry()
+    c = reg.counter("obs_events_total", label_names=("severity",))
+    before = c.labels(severity="error").get()
+    events.publish("sev.test", severity="error")
+    events.publish("sev.test", severity="bogus")   # coerced to info
+    assert c.labels(severity="error").get() == before + 1
+    assert events.tail(kind_prefix="sev.")[-1]["severity"] == "info"
+
+
+def test_event_jsonl_roundtrip_tolerates_torn_tail():
+    events.publish("jl.one", "a", k=1)
+    events.publish("jl.two", "b")
+    text = events.to_jsonl(events.tail(kind_prefix="jl."))
+    # a crashed writer leaves a torn final line: parsing must survive
+    back = events.from_jsonl(text + '{"seq": 99, "kind": "jl.torn"')
+    assert [e["kind"] for e in back] == ["jl.one", "jl.two"]
+    assert back[0]["fields"] == {"k": 1}
+
+
+def test_set_identity_changes_role_and_run_id():
+    old = events.identity()
+    try:
+        events.set_identity(role="worker3", run_id="r123")
+        ev = events.publish("id.test")
+        assert ev["role"] == "worker3" and ev["run_id"] == "r123"
+    finally:
+        events.set_identity(role=old["role"], run_id=old["run_id"])
+
+
+def test_log_warning_publishes_event_and_counts():
+    mark = events.seq()
+    reg = obs_metrics.default_registry()
+    c = reg.counter("log_messages_total", label_names=("level",))
+    before = c.labels(level="warning").get()
+    lines = []
+    prev_level = log._level   # earlier tests train with verbosity=-1,
+    log.set_verbosity(0)      # which silences warnings globally
+    log.register_callback(lines.append)
+    try:
+        log.log_warning("something leaned over")
+    finally:
+        log.register_callback(None)
+        log.set_verbosity(prev_level)
+    assert lines and "something leaned over" in lines[0]
+    assert c.labels(level="warning").get() == before + 1
+    evs = events.tail(since_seq=mark, kind_prefix="log.warning")
+    assert len(evs) == 1 and evs[0]["message"] == "something leaned over"
+
+
+def test_log_fatal_publishes_event_and_dumps_when_armed(tmp_path):
+    mark = events.seq()
+    dump.arm(str(tmp_path))
+    with pytest.raises(log.LightGBMError):
+        log.log_fatal("terminal condition")
+    evs = events.tail(since_seq=mark, kind_prefix="log.fatal")
+    assert len(evs) == 1
+    bundles = dump.list_bundles(str(tmp_path))
+    assert len(bundles) == 1
+    assert dump.validate_bundle(bundles[0])["reason"] == "fatal"
+
+
+def test_block_cache_error_publishes_event():
+    from lightgbmv1_tpu.data.block_cache import BlockCacheError
+
+    mark = events.seq()
+    with pytest.raises(BlockCacheError):
+        raise BlockCacheError("torn shard digest mismatch")
+    evs = events.tail(since_seq=mark,
+                      kind_prefix="data.block_cache_error")
+    assert len(evs) == 1 and "torn shard" in evs[0]["message"]
+
+
+def test_fault_injection_publishes_event():
+    from lightgbmv1_tpu.utils import faults
+    from lightgbmv1_tpu.utils.faults import FaultInjected, FaultSpec
+
+    mark = events.seq()
+    with faults.inject(FaultSpec("h2d", mode="raise", at=1)):
+        with pytest.raises(FaultInjected):
+            faults.fire("h2d", site="unit")
+    evs = events.tail(since_seq=mark, kind_prefix="fault.injected")
+    assert len(evs) == 1
+    assert evs[0]["fields"] == {"fault_kind": "h2d", "site": "unit",
+                                "mode": "raise"}
+
+
+# ---------------------------------------------------------------------------
+# flight recorder
+# ---------------------------------------------------------------------------
+
+
+def test_bundle_write_validate_roundtrip(tmp_path):
+    events.publish("pre.crash", "last words", severity="error")
+    dump.arm(str(tmp_path), config={"task": "train", "num_leaves": 31})
+    path = dump.dump("unit_test", error="boom")
+    assert path and os.path.exists(path)
+    manifest = dump.validate_bundle(path)
+    assert manifest["reason"] == "unit_test"
+    assert manifest["error"] == "boom"
+    for key in ("host", "pid", "role", "run_id"):
+        assert key in manifest["identity"]
+    bundle = dump.read_bundle(path)
+    assert bundle["config.json"]["num_leaves"] == 31
+    assert bundle["versions.json"]["python"]
+    assert any(e["kind"] == "pre.crash"
+               for e in bundle["events.jsonl"])
+    assert isinstance(bundle["trace.json"]["traceEvents"], list)
+    assert "default" in bundle["metrics.json"]
+    # no stray tmp file: the zip write was atomic
+    assert not [p for p in os.listdir(tmp_path) if ".tmp." in p]
+
+
+def test_bundle_once_per_arming_and_force(tmp_path):
+    dump.arm(str(tmp_path))
+    first = dump.dump("first")
+    assert first is not None
+    assert dump.dump("second") is None          # latched
+    assert dump.last_bundle() == first
+    forced = dump.dump("forced", force=True)    # explicit override
+    assert forced and forced != first
+    assert len(dump.list_bundles(str(tmp_path))) == 2
+    # re-arming resets the latch
+    dump.arm(str(tmp_path))
+    assert dump.dump("third") is not None
+    assert len(dump.list_bundles(str(tmp_path))) == 3
+
+
+def test_disarmed_dump_is_noop(tmp_path):
+    assert not dump.armed()
+    assert dump.dump("nope") is None
+    assert dump.list_bundles(str(tmp_path)) == []
+
+
+def test_validate_rejects_tampered_member(tmp_path):
+    dump.arm(str(tmp_path))
+    path = dump.dump("tamper_me")
+    dump.disarm()
+    with zipfile.ZipFile(path) as zf:
+        members = {n: zf.read(n) for n in zf.namelist()}
+    members["metrics.json"] = b'{"default": {"forged": 1}}'
+    with zipfile.ZipFile(path, "w") as zf:
+        for n, data in members.items():
+            zf.writestr(n, data)
+    with pytest.raises(dump.ForensicsError, match="digest mismatch"):
+        dump.validate_bundle(path)
+
+
+def test_validate_rejects_missing_member_and_garbage(tmp_path):
+    dump.arm(str(tmp_path))
+    path = dump.dump("strip_me")
+    dump.disarm()
+    with zipfile.ZipFile(path) as zf:
+        members = {n: zf.read(n) for n in zf.namelist()
+                   if n != "trace.json"}
+    with zipfile.ZipFile(path, "w") as zf:
+        for n, data in members.items():
+            zf.writestr(n, data)
+    with pytest.raises(dump.ForensicsError, match="missing"):
+        dump.validate_bundle(path)
+    junk = tmp_path / "crash-x.zip"
+    junk.write_bytes(b"not a zip at all")
+    with pytest.raises(dump.ForensicsError):
+        dump.validate_bundle(str(junk))
+
+
+def test_metrics_sources_ride_into_bundle(tmp_path):
+    dump.arm(str(tmp_path))
+    dump.add_metrics_source("replica", lambda: {"qps": 42})
+    dump.add_metrics_source("broken", lambda: 1 / 0)
+    path = dump.dump("with_sources")
+    bundle = dump.read_bundle(path)
+    assert bundle["metrics.json"]["replica"] == {"qps": 42}
+    # a dead source must not block the bundle that explains its death
+    assert "error" in bundle["metrics.json"]["broken"]
+
+
+@pytest.mark.filterwarnings(
+    "ignore::pytest.PytestUnhandledThreadExceptionWarning")
+def test_unhandled_thread_exception_dumps(tmp_path):
+    dump.arm(str(tmp_path))
+
+    def die():
+        raise RuntimeError("thread went sideways")
+
+    t = threading.Thread(target=die)
+    t.start()
+    t.join()
+    bundles = dump.list_bundles(str(tmp_path))
+    assert len(bundles) == 1
+    manifest = dump.validate_bundle(bundles[0])
+    assert manifest["reason"] == "unhandled_thread_exception"
+    assert manifest["exc_type"] == "RuntimeError"
+
+
+def test_sigterm_writes_bundle_subprocess(tmp_path):
+    """A REAL SIGTERM: the child arms the recorder, reports readiness,
+    receives the signal, dumps, and still dies with the canonical
+    SIGTERM status."""
+    script = (
+        "import sys, time\n"
+        f"sys.path.insert(0, {REPO!r})\n"
+        "from lightgbmv1_tpu.obs import dump\n"
+        f"dump.arm({str(tmp_path)!r})\n"
+        "print('ARMED', flush=True)\n"
+        "time.sleep(30)\n"
+    )
+    proc = subprocess.Popen([sys.executable, "-c", script],
+                            stdout=subprocess.PIPE, text=True)
+    try:
+        assert proc.stdout.readline().strip() == "ARMED"
+        proc.send_signal(signal.SIGTERM)
+        rc = proc.wait(timeout=20)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+    assert rc == -signal.SIGTERM
+    bundles = dump.list_bundles(str(tmp_path))
+    assert len(bundles) == 1
+    assert dump.validate_bundle(bundles[0])["reason"] == "sigterm"
+
+
+def test_cli_crash_dir_knob_leaves_one_bundle(tmp_path):
+    """task=train with crash_dir=<dir>: an injected mid-training raise
+    leaves exactly one validated bundle whose config member records the
+    run's knobs."""
+    import numpy as np
+
+    from lightgbmv1_tpu.cli import main as cli_main
+    from lightgbmv1_tpu.utils import faults
+    from lightgbmv1_tpu.utils.faults import FaultInjected, FaultSpec
+
+    rng = np.random.RandomState(0)
+    X = rng.randn(220, 4)
+    y = (X[:, 0] > 0).astype(float)
+    data = tmp_path / "train.tsv"
+    np.savetxt(data, np.column_stack([y, X]), fmt="%.6g", delimiter="\t")
+    crash = tmp_path / "crash"
+    args = [f"data={data}", "objective=binary", "num_trees=6",
+            "num_leaves=4", "min_data_in_leaf=10", "snapshot_freq=2",
+            f"output_model={tmp_path / 'm.txt'}", "verbosity=-1",
+            f"crash_dir={crash}"]
+    with faults.inject(FaultSpec("snapshot", mode="raise", at=1)):
+        with pytest.raises(FaultInjected):
+            cli_main(args)
+    bundles = dump.list_bundles(str(crash))
+    assert len(bundles) == 1
+    manifest = dump.validate_bundle(bundles[0])
+    assert manifest["reason"] == "train_crash"
+    assert manifest["identity"]["role"] == "train"
+    cfg = dump.read_bundle(bundles[0])["config.json"]
+    assert cfg["num_leaves"] == 4 and cfg["snapshot_freq"] == 2
+
+
+def test_bundle_trace_is_perfetto_loadable(tmp_path):
+    """The bundle's trace member carries the armed tracer's spans with
+    non-negative rebased timestamps (validate_bundle enforces it)."""
+    from lightgbmv1_tpu.obs import trace
+
+    trace.arm(ring_events=64)
+    try:
+        with trace.span("pre.crash.work"):
+            time.sleep(0.001)
+        dump.arm(str(tmp_path))
+        path = dump.dump("traced")
+        bundle = dump.read_bundle(path)
+        names = [e["name"] for e in bundle["trace.json"]["traceEvents"]
+                 if e.get("ph") == "X"]
+        assert "pre.crash.work" in names
+        dump.validate_bundle(path)
+        json.dumps(bundle["trace.json"])
+    finally:
+        trace.reset()

@@ -151,6 +151,42 @@ def test_disarm_mid_span_drops_cleanly():
     assert trace.drain()["events"] == []   # dropped, never crashed
 
 
+def test_rearm_mid_span_drops_pre_arm_events_at_export():
+    """A span ENTERED before the most recent arm() carries a t0 from the
+    previous epoch; exporting it would produce a negative ts.  The
+    export drops it and reports the count (ISSUE 10 satellite)."""
+    trace.arm(ring_events=64)
+    sp = trace.span("stale")
+    with sp:
+        time.sleep(0.002)
+        trace.arm(ring_events=64)          # re-arm MID-span
+        with trace.span("fresh"):
+            time.sleep(0.001)
+    # the stale span closed after the re-arm: it recorded into the new
+    # ring with a pre-arm t0
+    doc = trace.export_chrome()
+    names = [e["name"] for e in doc["traceEvents"] if e.get("ph") == "X"]
+    assert "fresh" in names and "stale" not in names
+    assert doc["otherData"]["pre_arm_dropped"] == 1
+    assert all(e["ts"] >= 0 for e in doc["traceEvents"]
+               if e.get("ph") == "X")
+    json.dumps(doc)
+
+
+def test_export_carries_wall_anchor_and_identity():
+    from lightgbmv1_tpu.obs import events as obs_events
+
+    trace.arm(ring_events=64)
+    with trace.span("x"):
+        pass
+    other = trace.export_chrome()["otherData"]
+    ident = obs_events.identity()
+    assert other["t0_unix_ns"] > 1e18          # a real wall instant (ns)
+    assert other["pid"] == os.getpid()
+    assert other["host"] == ident["host"]
+    assert other["role"] == ident["role"]
+
+
 def test_phase_profile_children_agree_with_attribution():
     """Installed phase profile (the phase_attrib breakdown) => iteration
     spans carry estimated wave-round/phase children whose durations
@@ -268,6 +304,168 @@ def test_registry_thread_safety():
     total = sum(child.get() for _, child in c.children())
     assert total == N * T                        # no lost increments
     assert h._solo().count == N * T
+
+
+def test_histogram_rejects_nonfinite_observations():
+    """observe(NaN/±Inf) is REJECTED and counted — before this guard a
+    single NaN landed silently in the +Inf bucket and poisoned `sum`
+    (and through it every mean) forever (ISSUE 10 satellite)."""
+    reg = obs_metrics.Registry()
+    h = reg.histogram("lat_ms", "", buckets=(1, 10), sample_window=16)
+    h.observe(2.0)
+    for bad in (float("nan"), float("inf"), float("-inf")):
+        h.observe(bad)
+    snap = reg.snapshot()
+    assert snap["lat_ms_count"] == 1            # only the finite one
+    assert snap["lat_ms_sum"] == 2.0            # sum not poisoned
+    assert snap['obs_bad_observations_total{metric="lat_ms"}'] == 3
+    assert h.quantile(1.0) == 2.0               # window clean too
+    # the +Inf bucket holds only real observations
+    text = reg.prometheus_text()
+    assert 'lat_ms_bucket{le="+Inf"} 1' in text
+    # and each rejection published a warning event
+    from lightgbmv1_tpu.obs import events
+
+    evs = events.tail(kind_prefix="metrics.bad_observation", n=3)
+    assert len(evs) == 3 and evs[-1]["fields"]["metric"] == "lat_ms"
+
+
+def test_registry_reset_races_concurrent_writers():
+    """reset() racing observe()/inc() from serving threads: no torn
+    buckets, no exceptions, and the post-race state is consistent
+    (bucket cumsum == count) (ISSUE 10 satellite)."""
+    reg = obs_metrics.Registry()
+    c = reg.counter("n_total", "")
+    h = reg.histogram("d_ms", "", buckets=(1, 5, 10), sample_window=32)
+    stop = threading.Event()
+    errors = []
+
+    def writer():
+        try:
+            while not stop.is_set():
+                c.inc()
+                h.observe(3.0)
+        except Exception as e:  # noqa: BLE001 — any raise fails the test
+            errors.append(e)
+
+    def resetter():
+        try:
+            for _ in range(200):
+                reg.reset()
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    threads = [threading.Thread(target=writer) for _ in range(4)] \
+        + [threading.Thread(target=resetter)]
+    for t in threads:
+        t.start()
+    time.sleep(0.15)
+    stop.set()
+    for t in threads:
+        t.join(timeout=10)
+    assert not errors
+    child = h._solo()
+    with h.lock:
+        assert sum(child.buckets) <= child.count   # never torn past count
+        assert len(child._window) <= 32
+    # a final reset + write round works normally
+    reg.reset()
+    h.observe(2.0)
+    assert h._solo().count == 1
+
+
+def test_registry_snapshot_under_labeled_child_churn():
+    """snapshot()/prometheus_text() while another thread creates new
+    labeled children: no RuntimeError from dict mutation, every
+    snapshot internally consistent (ISSUE 10 satellite)."""
+    reg = obs_metrics.Registry()
+    c = reg.counter("churn_total", "", label_names=("who",))
+    stop = threading.Event()
+    errors = []
+
+    def churner():
+        # cycle over a bounded label set: the race under test is
+        # child-creation vs snapshot iteration, not unbounded growth
+        # (100k children would make each snapshot O(n^2) and blow the
+        # tier-1 wall for no extra coverage)
+        i = 0
+        try:
+            while not stop.is_set():
+                c.labels(who=f"w{i % 64}").inc()
+                i += 1
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    def reader():
+        try:
+            for _ in range(300):
+                snap = reg.snapshot()
+                assert all(v >= 0 for v in snap.values())
+                reg.prometheus_text()
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    threads = [threading.Thread(target=churner),
+               threading.Thread(target=reader)]
+    for t in threads:
+        t.start()
+    time.sleep(0.15)
+    stop.set()
+    for t in threads:
+        t.join(timeout=10)
+    assert not errors
+    assert 0 < len(c.children()) <= 64
+    total = sum(child.get() for _, child in c.children())
+    assert total >= len(c.children())   # every surviving child was inc'd
+
+
+def test_log_callback_races_set_verbosity():
+    """register_callback()/_emit() are thread-safe: serving threads log
+    while another thread swaps the callback and the verbosity — no
+    exceptions, no line delivered to a half-installed callback
+    (ISSUE 10 satellite)."""
+    from lightgbmv1_tpu.utils import log
+
+    lines = []
+    lock = threading.Lock()
+    errors = []
+    stop = threading.Event()
+
+    def cb(msg):
+        with lock:
+            lines.append(msg)
+
+    def logger():
+        try:
+            while not stop.is_set():
+                log.log_warning("race line")
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    def flipper():
+        try:
+            for i in range(300):
+                log.register_callback(cb if i % 2 == 0 else None)
+                log.set_verbosity(-1 if i % 3 == 0 else 1)
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    prev_level = log._level
+    try:
+        log.set_verbosity(-1)   # keep stderr quiet for the None phases
+        threads = [threading.Thread(target=logger) for _ in range(3)] \
+            + [threading.Thread(target=flipper)]
+        for t in threads:
+            t.start()
+        time.sleep(0.15)
+        stop.set()
+        for t in threads:
+            t.join(timeout=10)
+    finally:
+        log.register_callback(None)
+        log.set_verbosity(prev_level)
+    assert not errors
+    assert all("race line" in ln for ln in lines)
 
 
 def test_registry_get_or_create_and_conflicts():
@@ -557,6 +755,35 @@ def test_bench_trend_reads_multichip_parity_tail(tmp_path):
     result = bench_trend.run(str(tmp_path))
     assert result["multichip_records"] == ["MULTICHIP_r01.json"]
     assert [f["field"] for f in result["flags"]] == ["comm_ok"]
+
+
+def test_ci_gate_required_guards(tmp_path, capsys):
+    """--require-guards (ISSUE 10): the newest record must CARRY each
+    named guard as True — a capture that silently dropped the field
+    fails, not just one that flipped it to False."""
+    import ci_gate
+
+    t1 = tmp_path / "durations.jsonl"
+    with open(t1, "w") as fh:
+        fh.write(json.dumps({"nodeid": "tests/test_a.py::t",
+                             "when": "call", "duration": 1.0}) + "\n")
+    _write_rec(tmp_path, "BENCH_r01.json",
+               {"value": 5.0, "slo_ok": True, "forensics_ok": True})
+    base = ["--records", str(tmp_path), "--t1-log", str(t1)]
+    assert ci_gate.main(base + ["--require-guards",
+                                "slo_ok,forensics_ok"]) == 0
+    # missing guard field -> FAIL (trend alone would pass this record)
+    assert ci_gate.main(base + ["--require-guards",
+                                "slo_ok,forensics_ok,obs_ok"]) == 1
+    # present-but-False -> FAIL (and the trend guard sweep flags it too)
+    _write_rec(tmp_path, "BENCH_r02.json",
+               {"value": 5.0, "slo_ok": False, "forensics_ok": True})
+    assert ci_gate.main(base + ["--require-guards", "slo_ok"]) == 1
+    # no --require-guards: old behavior intact apart from the flip flag
+    _write_rec(tmp_path, "BENCH_r02.json",
+               {"value": 5.0, "slo_ok": True, "forensics_ok": True})
+    assert ci_gate.main(base) == 0
+    capsys.readouterr()
 
 
 def test_ci_gate_combines_trend_and_tier1(tmp_path, capsys):

@@ -37,7 +37,14 @@ def test_eight_devices_present():
     assert len(jax.devices()) == 8
 
 
-@pytest.mark.parametrize("learner", ["data", "feature"])
+# tier-1 budget (ISSUE 10 re-marking, the PR-6/7 discipline): the
+# [data] variants are the suite's two heaviest tests (~39 s combined on
+# the 1-core box) and their serial-parity contract is additionally
+# hard-asserted by dryrun_multichip on EVERY driver capture (all
+# learners, both collective modes); the full suite still runs them.
+@pytest.mark.parametrize(
+    "learner",
+    [pytest.param("data", marks=pytest.mark.slow), "feature"])
 def test_parallel_matches_serial_binary(learner):
     X, y = make_binary_problem(1000, f=7)
     serial = _train({"objective": "binary"}, X, y)
@@ -53,7 +60,9 @@ def test_parallel_matches_serial_binary(learner):
     )
 
 
-@pytest.mark.parametrize("learner", ["data", "feature"])
+@pytest.mark.parametrize(
+    "learner",
+    [pytest.param("data", marks=pytest.mark.slow), "feature"])
 def test_parallel_matches_serial_regression(learner):
     X, y = make_regression_problem(900, f=5)
     serial = _train({"objective": "regression"}, X, y)
@@ -73,6 +82,9 @@ def test_data_parallel_row_count_not_divisible():
     )
 
 
+@pytest.mark.slow   # ISSUE 10 re-marking: ~19 s; the F % D padding
+# contract stays in tier-1 via test_reduce_scatter_feature_count_
+# not_divisible and per-capture via the dryrun feature learner
 def test_feature_parallel_feature_count_not_divisible():
     """Feature padding must not change results when F % ndev != 0."""
     X, y = make_binary_problem(800, f=11)   # 11 % 8 != 0

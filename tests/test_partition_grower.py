@@ -22,11 +22,17 @@ def make_problem(n=4000, seed=0):
     return X, y
 
 
+# tier-1 budget (ISSUE 10 re-marking, the PR-6/7 discipline): the
+# bagging and L1-regression variants (~22 s combined) ride the same
+# partition-vs-masked parity mechanism params0 keeps in tier-1; the
+# full suite still runs every variant.
 @pytest.mark.parametrize("params", [
     {"objective": "binary", "num_leaves": 31},
-    {"objective": "binary", "num_leaves": 31,
-     "bagging_fraction": 0.7, "bagging_freq": 1},
-    {"objective": "regression", "num_leaves": 15, "lambda_l1": 0.5},
+    pytest.param({"objective": "binary", "num_leaves": 31,
+                  "bagging_fraction": 0.7, "bagging_freq": 1},
+                 marks=pytest.mark.slow),
+    pytest.param({"objective": "regression", "num_leaves": 15,
+                  "lambda_l1": 0.5}, marks=pytest.mark.slow),
     {"objective": "binary", "num_leaves": 15, "monotone_constraints":
      [1, 0, 0, 0, 0, 0, 0, 0]},
 ])

@@ -293,6 +293,35 @@ def test_observability_section_renders_obs_fields():
     assert "No obs fields" in "\n".join(lines)
 
 
+def test_forensics_slo_section_renders_fields():
+    """The Forensics & SLO section (ISSUE 10) is generated from the
+    BENCH slo_*/forensics/agg fields (bench.py measure_obs +
+    measure_chaos): SLIs, burn rate, exemplar count and all four guards
+    grep to record fields."""
+    import perf_report
+
+    rec = {
+        "slo_ok": True, "slo_availability": 0.9987,
+        "slo_latency_sli": 0.9912, "slo_availability_burn": 1.3,
+        "slo_exemplars": 5, "forensics_ok": True, "obs_agg_ok": True,
+        "obs_agg_sources": 2, "chaos_forensics_ok": True,
+    }
+    lines = []
+    perf_report.forensics_slo_section(lines.append, rec)
+    txt = "\n".join(lines)
+    assert "## Forensics & SLO" in txt
+    for needle in ("0.9987", "0.9912", "1.3", "5", "slo_ok=True",
+                   "forensics_ok=True", "obs_agg_ok=True",
+                   "chaos_forensics_ok=True", "`crash_dir`",
+                   "`obs_dir`", "`serve_slo_*`", "burn-rate",
+                   "Perfetto-loadable"):
+        assert needle in txt, needle
+    # a record with no forensics/SLO capture renders the placeholder
+    lines = []
+    perf_report.forensics_slo_section(lines.append, {})
+    assert "No forensics/SLO fields" in "\n".join(lines)
+
+
 def test_trend_section_renders_sentinel_rows(tmp_path):
     """The Trend section is rendered BY the sentinel (bench_trend.run),
     so PERF.md's table and the gate's verdict cannot disagree."""

@@ -1,0 +1,288 @@
+"""Serving SLO burn-rate evaluation (serve/slo.py + GET /slo).
+
+Contracts under test:
+
+* **burn-rate math** — burn = error-fraction / (1 - target); SLIs in
+  [0, 1]; failed requests spend the availability budget, slow SUCCESSES
+  spend the latency budget (failures never double-bill both);
+* **multi-window alerting** — a page needs BOTH the fast and slow
+  window over threshold: a short blip inside a long-clean window never
+  pages, a recovered incident un-pages as soon as the fast window
+  clears, a sustained burn pages;
+* **windowing** — the time-bucketed ring expires outcomes older than
+  the window; everything is replayable with explicit ``now``;
+* **exemplars** — the tracker keeps the worst-K (latency, trace id)
+  pairs; the serve path attaches trace ids to latency-histogram
+  buckets; ``GET /slo`` surfaces both, and OpenMetrics negotiation
+  renders the bucket exemplars while the 0.0.4 exposition stays clean;
+* **server wiring** — completions, sheds and timeouts all reach the
+  tracker with their trace ids.
+"""
+
+import json
+import urllib.request
+
+import pytest
+
+import lightgbmv1_tpu as lgb
+from lightgbmv1_tpu.serve import ServeConfig, ServeHTTP, Server
+from lightgbmv1_tpu.serve.slo import SLOConfig, SLOTracker
+
+from conftest import make_binary_problem
+
+
+def _cfg(**over):
+    kw = dict(availability_target=0.999, latency_ms=50.0,
+              latency_target=0.99, fast_window_s=60.0,
+              slow_window_s=600.0, bucket_s=1.0)
+    kw.update(over)
+    return SLOConfig(**kw)
+
+
+# ---------------------------------------------------------------------------
+# tracker math
+# ---------------------------------------------------------------------------
+
+
+def test_burn_rate_math_availability():
+    t = SLOTracker(_cfg())
+    # 1000 requests, 10 failures -> error frac 1% against a 0.1% budget
+    # = burn 10 in both windows
+    for i in range(1000):
+        t.record(i % 100 != 0, latency_ms=1.0, now=1000.0 + i * 0.01)
+    ev = t.evaluate(now=1010.0)
+    for w in ("fast", "slow"):
+        win = ev["availability"]["windows"][w]
+        assert win["total"] == 1000 and win["errors"] == 10
+        assert win["sli"] == pytest.approx(0.99)
+        assert win["burn_rate"] == pytest.approx(10.0, rel=1e-3)
+
+
+def test_latency_budget_excludes_failures():
+    t = SLOTracker(_cfg(latency_ms=10.0, latency_target=0.9))
+    for i in range(80):
+        t.record(True, latency_ms=1.0, now=1000.0)
+    for i in range(20):
+        t.record(True, latency_ms=100.0, now=1000.0)   # slow successes
+    for i in range(100):
+        t.record(False, now=1000.0)                    # failures
+    ev = t.evaluate(now=1001.0)
+    lat = ev["latency"]["windows"]["fast"]
+    # latency SLI over the 100 GOOD requests only: 20% slow vs 10% budget
+    assert lat["good"] == 100 and lat["slow"] == 20
+    assert lat["sli"] == pytest.approx(0.8)
+    assert lat["burn_rate"] == pytest.approx(2.0)
+    assert ev["availability"]["windows"]["fast"]["sli"] \
+        == pytest.approx(0.5)
+
+
+def test_multiwindow_page_requires_both_windows():
+    # a 30 s blip of 100% failures inside an otherwise clean 600 s
+    # window: the fast window screams, the slow window absorbs it
+    t = SLOTracker(_cfg())
+    for i in range(570):
+        t.record(True, latency_ms=1.0, now=1000.0 + i)
+    for i in range(30):
+        t.record(False, now=1570.0 + i)
+    ev = t.evaluate(now=1600.0)
+    assert ev["availability"]["windows"]["fast"]["burn_rate"] >= 14.4
+    assert ev["availability"]["windows"]["slow"]["burn_rate"] < 14.4 * 4
+    # slow-window burn: 30/600 = 5% errors / 0.1% budget = 50 -> pages.
+    # rebalance so the blip's error fraction crosses the bar in the
+    # fast window but dilutes below it over the slow window:
+    t2 = SLOTracker(_cfg())
+    for i in range(5950):                               # 10 qps baseline
+        t2.record(True, latency_ms=1.0, now=2000.0 + i * 0.1)
+    for i in range(100):                                # 5 s burst of
+        t2.record(False, now=2595.0 + i * 0.05)         # failures
+    for i in range(5000):                               # amid a traffic
+        t2.record(True, latency_ms=1.0, now=2595.0 + i * 0.001)  # spike
+    ev2 = t2.evaluate(now=2601.0)
+    a2 = ev2["availability"]["windows"]
+    assert a2["fast"]["burn_rate"] >= 14.4        # blip fills fast window
+    assert a2["slow"]["burn_rate"] < 14.4         # diluted in slow window
+    assert not ev2["alerts"]["availability_page"]  # one window isn't enough
+
+
+def test_sustained_burn_pages_and_recovery_unpages():
+    t = SLOTracker(_cfg())
+    # sustained 50% failures across the whole slow window
+    for i in range(600):
+        t.record(i % 2 == 0, latency_ms=1.0, now=1000.0 + i)
+    ev = t.evaluate(now=1600.0)
+    assert ev["alerts"]["availability_page"]
+    assert ev["alerts"]["availability_warn"]
+    # 120 s of clean traffic: the fast window clears -> the page clears
+    # (the slow window still shows the damage as a warn-level burn)
+    for i in range(120):
+        t.record(True, latency_ms=1.0, now=1600.0 + i)
+    ev2 = t.evaluate(now=1720.0)
+    assert ev2["availability"]["windows"]["fast"]["burn_rate"] == 0.0
+    assert not ev2["alerts"]["availability_page"]
+
+
+def test_window_expiry():
+    t = SLOTracker(_cfg(fast_window_s=10.0, slow_window_s=60.0))
+    for i in range(20):
+        t.record(False, now=1000.0 + i * 0.1)
+    # 100 s later the failures aged out of BOTH windows
+    ev = t.evaluate(now=1100.0)
+    for w in ("fast", "slow"):
+        assert ev["availability"]["windows"][w]["total"] == 0
+        assert ev["availability"]["windows"][w]["sli"] == 1.0
+    assert ev["lifetime"]["total"] == 20   # lifetime keeps the history
+
+
+def test_worst_k_exemplars_sorted_and_bounded():
+    t = SLOTracker(_cfg(worst_k=3))
+    for i, lat in enumerate([5.0, 90.0, 15.0, 70.0, 40.0, 80.0]):
+        t.record(True, latency_ms=lat, trace_id=f"req{i:012d}",
+                 now=1000.0)
+    worst = t.evaluate(now=1001.0)["worst"]
+    assert [w["latency_ms"] for w in worst] == [90.0, 80.0, 70.0]
+    assert worst[0]["trace_id"] == "req000000000001"
+
+
+def test_config_validation():
+    with pytest.raises(ValueError):
+        SLOConfig(availability_target=1.5)
+    with pytest.raises(ValueError):
+        SLOConfig(latency_target=0.0)
+    cfg = SLOConfig(fast_window_s=100.0, slow_window_s=10.0)
+    assert cfg.slow_window_s >= cfg.fast_window_s   # coerced, not broken
+    from lightgbmv1_tpu.config import Config
+
+    with pytest.raises(ValueError):
+        Config.from_dict({"serve_slo_availability_target": 2.0})
+    with pytest.raises(ValueError):
+        Config.from_dict({"serve_slo_fast_window_s": 600.0,
+                          "serve_slo_slow_window_s": 60.0})
+
+
+def test_snapshot_serializes_and_echoes_config():
+    t = SLOTracker(_cfg())
+    t.record(True, latency_ms=3.0, trace_id="a" * 16, now=1000.0)
+    snap = t.snapshot(now=1001.0)
+    assert snap["config"]["availability_target"] == 0.999
+    assert snap["config"]["fast_window_s"] == 60.0
+    json.dumps(snap)
+
+
+# ---------------------------------------------------------------------------
+# server wiring + GET /slo
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def booster():
+    X, y = make_binary_problem(1000, 6, seed=3)
+    b = lgb.train({"objective": "binary", "num_leaves": 15,
+                   "min_data_in_leaf": 5, "verbosity": -1},
+                  lgb.Dataset(X, label=y), num_boost_round=3)
+    return b, X
+
+
+def _serve_cfg(**over):
+    kw = dict(max_batch_rows=64, max_batch_delay_ms=1.0,
+              queue_depth_rows=1024, f64_scores=True,
+              predictor_kwargs={"bucket_min": 64})
+    kw.update(over)
+    return ServeConfig(**kw)
+
+
+def test_server_completions_feed_slo_and_exemplars(booster):
+    b, X = booster
+    srv = Server(b, config=_serve_cfg())
+    try:
+        for n in (1, 4, 2):
+            srv.submit(X[:n])
+        snap = srv.slo_snapshot()
+        fast = snap["availability"]["windows"]["fast"]
+        assert fast["total"] == 3 and fast["errors"] == 0
+        assert fast["sli"] == 1.0
+        assert snap["lifetime"] == {"total": 3, "errors": 0}
+        # per-bucket worst-tail exemplars carry 16-hex trace ids
+        assert snap["exemplars"]
+        for ex in snap["exemplars"]:
+            assert len(ex["trace_id"]) == 16 and ex["value"] > 0
+        assert snap["worst"] and len(snap["worst"][0]["trace_id"]) == 16
+        # exemplars render ONLY under the OpenMetrics flag
+        assert " # {trace_id=" in srv.metrics.prometheus_text(
+            exemplars=True)
+        assert " # {trace_id=" not in srv.metrics.prometheus_text()
+    finally:
+        srv.close()
+
+
+def test_shed_spends_availability_budget(booster):
+    from lightgbmv1_tpu.serve import ServerOverloaded
+
+    b, X = booster
+    srv = Server(b, config=_serve_cfg(max_batch_rows=8,
+                                      queue_depth_rows=8))
+    try:
+        srv.submit(X[:4])
+        with pytest.raises(ServerOverloaded):
+            srv.submit(X[:16])            # > queue depth: shed NOW
+        snap = srv.slo_snapshot()
+        fast = snap["availability"]["windows"]["fast"]
+        assert fast["errors"] == 1 and fast["total"] == 2
+        assert fast["burn_rate"] > 0
+    finally:
+        srv.close()
+
+
+def test_http_slo_endpoint(booster):
+    b, X = booster
+    srv = Server(b, config=_serve_cfg())
+    http = ServeHTTP(srv, port=0).start()
+    try:
+        srv.submit(X[:4])
+        u = f"http://127.0.0.1:{http.port}/slo"
+        with urllib.request.urlopen(u) as resp:
+            assert resp.headers["Content-Type"] == "application/json"
+            slo = json.loads(resp.read())
+        assert slo["availability"]["target"] == 0.999
+        assert slo["alerts"].keys() >= {"availability_page",
+                                        "latency_page"}
+        assert slo["version"] == "v1"
+        assert slo["exemplars"]
+        # OpenMetrics negotiation renders bucket exemplars; plain
+        # text/plain stays 0.0.4-clean
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{http.port}/metrics",
+            headers={"Accept": "application/openmetrics-text"})
+        with urllib.request.urlopen(req) as resp:
+            om = resp.read().decode()
+        assert " # {trace_id=" in om
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{http.port}/metrics",
+            headers={"Accept": "text/plain"})
+        with urllib.request.urlopen(req) as resp:
+            assert " # {trace_id=" not in resp.read().decode()
+    finally:
+        http.shutdown()
+        srv.close()
+
+
+def test_build_server_wires_slo_knobs(booster):
+    from lightgbmv1_tpu.config import Config
+    from lightgbmv1_tpu.serve.server import build_server
+
+    b, _ = booster
+    cfg = Config.from_dict({
+        "serve_slo_availability_target": 0.99,
+        "serve_slo_latency_ms": 25.0,
+        "serve_slo_fast_window_s": 30.0,
+        "serve_slo_slow_window_s": 300.0,
+        "verbosity": -1,
+    })
+    srv = build_server(b, cfg)
+    try:
+        sc = srv.slo.config
+        assert sc.availability_target == 0.99
+        assert sc.latency_ms == 25.0
+        assert sc.fast_window_s == 30.0 and sc.slow_window_s == 300.0
+        assert srv.slo_snapshot()["config"]["latency_ms"] == 25.0
+    finally:
+        srv.close()

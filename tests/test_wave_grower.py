@@ -23,11 +23,16 @@ def make_problem(n=4000, seed=0):
     return X, y
 
 
+# tier-1 budget (ISSUE 10 re-marking, the PR-6/7 discipline): the L1
+# regression variant (~13 s) rides the same wave1==sequential schedule
+# property the other three variants keep in tier-1; the full suite
+# still runs it.
 @pytest.mark.parametrize("params", [
     {"objective": "binary", "num_leaves": 31},
     {"objective": "binary", "num_leaves": 31,
      "bagging_fraction": 0.7, "bagging_freq": 1},
-    {"objective": "regression", "num_leaves": 15, "lambda_l1": 0.5},
+    pytest.param({"objective": "regression", "num_leaves": 15,
+                  "lambda_l1": 0.5}, marks=pytest.mark.slow),
     {"objective": "binary", "num_leaves": 15, "max_depth": 4},
 ])
 def test_wave1_matches_sequential(params):

@@ -73,6 +73,11 @@ WATCHED: Tuple[Tuple[str, str, float], ...] = (
     ("stream_ms_per_iter", "down", 0.10),
     ("pipeline_ms_per_iter", "down", 0.10),
     ("obs_overhead_frac", "down", 0.10),
+    # forensics & SLO (ISSUE 10): the availability SLI is a quality
+    # field (tight bar); slo_ok / forensics_ok / obs_agg_ok /
+    # chaos_forensics_ok are booleans — the guard sweep below flags any
+    # False automatically
+    ("slo_availability", "up", 0.005),
 )
 
 _PARITY_RE = re.compile(r"dryrun_multichip PARITY (\{.*\})")

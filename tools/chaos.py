@@ -37,6 +37,19 @@ scenario                    contract proven
                             failures
 ==========================  ===============================================
 
+Forensics contract (ISSUE 10, obs/dump.py): every scenario also asserts
+the flight recorder's behavior for its induced failure.  Scenarios that
+KILL or WEDGE a process (kill-resume, torn-snapshot, poisoned-raise,
+dispatcher stall) must leave EXACTLY ONE validated forensic bundle
+(schema-checked, digest-intact, Perfetto-loadable trace) in the armed
+crash dir; scenarios whose fault is absorbed by a recovery path
+(publish-of-garbage, overload, transient H2D) must leave ZERO bundles —
+a recorder that dumps on survivable faults buries the real crashes —
+while still publishing the structured events that name the fault
+(``serve.publish_reject``, ``serve.shed``, ``fault.injected``).  The
+per-scenario ``forensics_ok`` rolls into ``chaos_ok`` and the CHAOS
+record's ``forensics_ok`` field.
+
 Usage::
 
     python tools/chaos.py          # full suite (includes subprocess kill)
@@ -126,6 +139,36 @@ def _host_raw(booster, X):
                                       predict_method="host"), np.float64)
 
 
+def _check_bundles(crash_dir: str, expect: int,
+                   reasons: tuple = ()) -> dict:
+    """Forensics assertion: exactly ``expect`` bundles in ``crash_dir``,
+    each fully validated (schema + digests + Perfetto-loadable trace),
+    the first one's reason in ``reasons`` when given."""
+    from lightgbmv1_tpu.obs import dump
+
+    bundles = dump.list_bundles(crash_dir) if crash_dir else []
+    out = {"bundles": len(bundles), "expect": expect}
+    if len(bundles) != expect:
+        out["forensics_ok"] = False
+        return out
+    try:
+        for b in bundles:
+            manifest = dump.validate_bundle(b)
+            out["bundle_reason"] = manifest["reason"]
+        ok = (not reasons or out.get("bundle_reason") in reasons)
+    except Exception as e:  # noqa: BLE001 — an invalid bundle FAILS
+        out["bundle_error"] = f"{type(e).__name__}: {e}"[:200]
+        ok = False
+    out["forensics_ok"] = bool(ok)
+    return out
+
+
+def _count_events(since_seq: int, kind: str) -> int:
+    from lightgbmv1_tpu.obs import events
+
+    return len(events.tail(since_seq=since_seq, kind_prefix=kind))
+
+
 # ---------------------------------------------------------------------------
 # scenarios — each returns a dict with at least {"ok": bool}
 # ---------------------------------------------------------------------------
@@ -136,13 +179,18 @@ def scenario_train_kill_resume(tmp: str, subprocess_kill: bool) -> dict:
     must auto-resume from the checkpoint bundle and produce model text
     BYTE-IDENTICAL to a run that never died.  ``subprocess_kill=True``
     uses a real child process and ``os._exit(137)`` (no cleanup, no
-    flush); the fast variant crashes in-process via an injected raise."""
+    flush); the fast variant crashes in-process via an injected raise.
+    Either way the dying run must leave exactly one validated forensic
+    bundle (the injected kill dumps at the faults seam; the in-process
+    raise dumps on run_train's way out)."""
     from lightgbmv1_tpu.cli import main as cli_main
+    from lightgbmv1_tpu.obs import dump
     from lightgbmv1_tpu.utils import faults
     from lightgbmv1_tpu.utils.faults import FaultInjected, FaultSpec
 
     data = _write_data(os.path.join(tmp, "train.tsv"))
     model = os.path.join(tmp, "m.txt")
+    crash_dir = os.path.join(tmp, "crash")
     args = _cli_args(data, model)
 
     cli_main(args)                       # straight run
@@ -152,6 +200,7 @@ def scenario_train_kill_resume(tmp: str, subprocess_kill: bool) -> dict:
         if p.startswith("m.txt"):
             os.remove(os.path.join(tmp, p))
 
+    crash_args = args + [f"crash_dir={crash_dir}"]
     plan = [{"kind": "snapshot", "mode": "kill", "at": 2}]
     if subprocess_kill:
         env = dict(os.environ, LGBMV1_FAULTS=json.dumps(plan),
@@ -159,25 +208,30 @@ def scenario_train_kill_resume(tmp: str, subprocess_kill: bool) -> dict:
                    PYTHONPATH=REPO + os.pathsep
                    + os.environ.get("PYTHONPATH", ""))
         proc = subprocess.run(
-            [sys.executable, "-m", "lightgbmv1_tpu"] + args,
+            [sys.executable, "-m", "lightgbmv1_tpu"] + crash_args,
             env=env, cwd=tmp, capture_output=True, text=True)
         crashed = proc.returncode == 137
     else:
         with faults.inject(FaultSpec("snapshot", mode="raise", at=2)):
             try:
-                cli_main(args)
+                cli_main(crash_args)
                 crashed = False
             except FaultInjected:
                 crashed = True
+        dump.disarm()                    # the CLI armed it; scope it here
     model_absent = not os.path.exists(model)
+    forensics = _check_bundles(crash_dir, expect=1,
+                               reasons=("fault_kill", "train_crash"))
 
     cli_main(args)                       # auto-resume
     with open(model) as fh:
         resumed = fh.read()
-    ok = crashed and model_absent and resumed == straight
+    ok = (crashed and model_absent and resumed == straight
+          and forensics["forensics_ok"])
     return {"ok": ok, "crashed": crashed, "model_absent": model_absent,
             "bit_identical": resumed == straight,
-            "kill": "subprocess" if subprocess_kill else "in-process"}
+            "kill": "subprocess" if subprocess_kill else "in-process",
+            **forensics}
 
 
 def scenario_torn_snapshot(tmp: str) -> dict:
@@ -202,14 +256,20 @@ def scenario_torn_snapshot(tmp: str) -> dict:
             os.remove(os.path.join(tmp, p))
 
     # tear the 2nd checkpoint write (iteration 4), then crash right after
+    from lightgbmv1_tpu.obs import dump
+
+    crash_dir = os.path.join(tmp, "crash")
     with faults.inject(
             FaultSpec("file_write", mode="truncate", match=".ckpt_iter_4"),
             FaultSpec("snapshot", mode="raise", at=2)):
         try:
-            cli_main(args)
+            cli_main(args + [f"crash_dir={crash_dir}"])
             crashed = False
         except FaultInjected:
             crashed = True
+    dump.disarm()
+    forensics = _check_bundles(crash_dir, expect=1,
+                               reasons=("train_crash",))
     torn = os.path.join(tmp, "m.txt.ckpt_iter_4")
     from lightgbmv1_tpu.io.checkpoint import (CheckpointError,
                                               validate_checkpoint)
@@ -223,17 +283,21 @@ def scenario_torn_snapshot(tmp: str) -> dict:
     cli_main(args)                       # resume: must fall back to iter 2
     with open(model) as fh:
         resumed = fh.read()
-    ok = crashed and torn_rejected and resumed == straight
+    ok = (crashed and torn_rejected and resumed == straight
+          and forensics["forensics_ok"])
     return {"ok": ok, "crashed": crashed, "torn_rejected": torn_rejected,
-            "bit_identical": resumed == straight}
+            "bit_identical": resumed == straight, **forensics}
 
 
 def scenario_poisoned_gradients() -> dict:
     """NaN-poisoned gradient pass: ``finite_guard=raise`` detects it at
-    the iteration boundary; ``finite_guard=clamp`` survives it with a
-    finite model; guard off documents the silent-absorption baseline."""
+    the iteration boundary (and the armed flight recorder dumps exactly
+    one bundle naming the poisoned iteration); ``finite_guard=clamp``
+    survives it with a finite model; guard off documents the
+    silent-absorption baseline."""
     import lightgbmv1_tpu as lgb
     from lightgbmv1_tpu.models.gbdt import FiniteGuardError
+    from lightgbmv1_tpu.obs import dump, events
     from lightgbmv1_tpu.utils import faults
     from lightgbmv1_tpu.utils.faults import FaultSpec
 
@@ -242,13 +306,25 @@ def scenario_poisoned_gradients() -> dict:
          "verbosity": -1}
 
     detected = False
-    with faults.inject(FaultSpec("grad_poison", payload=2)):
-        try:
-            lgb.train({**P, "finite_guard": "raise"},
-                      lgb.Dataset(X, label=y), num_boost_round=6,
-                      verbose_eval=False)
-        except FiniteGuardError:
-            detected = True
+    mark = events.seq()
+    crash_dir = tempfile.mkdtemp(prefix="lgbm_chaos_fg_")
+    try:
+        with dump.armed_dir(crash_dir):
+            with faults.inject(FaultSpec("grad_poison", payload=2)):
+                try:
+                    lgb.train({**P, "finite_guard": "raise"},
+                              lgb.Dataset(X, label=y), num_boost_round=6,
+                              verbose_eval=False)
+                except FiniteGuardError:
+                    detected = True
+        forensics = _check_bundles(crash_dir, expect=1,
+                                   reasons=("finite_guard",))
+        forensics["guard_events"] = _count_events(mark,
+                                                  "guard.finite_guard")
+        forensics["forensics_ok"] = bool(
+            forensics["forensics_ok"] and forensics["guard_events"] >= 1)
+    finally:
+        shutil.rmtree(crash_dir, ignore_errors=True)
 
     with faults.inject(FaultSpec("grad_poison", payload=2)):
         b = lgb.train({**P, "finite_guard": "clamp"},
@@ -261,21 +337,29 @@ def scenario_poisoned_gradients() -> dict:
 
     reloaded = lgb2.Booster(model_str=b.model_to_string())
     reload_ok = reloaded.num_trees() == 6
-    ok = detected and clamped_finite and reload_ok
+    ok = (detected and clamped_finite and reload_ok
+          and forensics["forensics_ok"])
     return {"ok": ok, "detected_at_boundary": detected,
-            "clamp_survived": clamped_finite, "reload_ok": reload_ok}
+            "clamp_survived": clamped_finite, "reload_ok": reload_ok,
+            **forensics}
 
 
 def scenario_publish_of_garbage() -> dict:
     """A corrupt model (NaN leaves) and a publish dying mid-warm: the
     active version must keep serving bit-exact answers throughout — the
-    corrupt candidate never serves a single response."""
+    corrupt candidate never serves a single response.  Forensics: both
+    rejections are first-class ``serve.publish_reject`` events and the
+    recovered fault writes NO crash bundle."""
     import lightgbmv1_tpu as lgb
+    from lightgbmv1_tpu.obs import dump, events
     from lightgbmv1_tpu.serve import PublishValidationError, Server
     from lightgbmv1_tpu.utils import faults
     from lightgbmv1_tpu.utils.faults import FaultInjected, FaultSpec
 
     b1, b2, X = _tiny_boosters()
+    mark = events.seq()
+    crash_dir = tempfile.mkdtemp(prefix="lgbm_chaos_pub_")
+    dump.arm(crash_dir)
     srv = Server(b1, config=_serve_cfg())
     try:
         want = _host_raw(b1, X[:16])
@@ -301,27 +385,42 @@ def scenario_publish_of_garbage() -> dict:
         recovered = (r2.version == clean_tag and np.array_equal(
             r2.values[:, 0], _host_raw(b2, X[:16])))
         rejects = srv.metrics_snapshot()["publish_rejects"]
+        forensics = _check_bundles(crash_dir, expect=0)
+        forensics["reject_events"] = _count_events(
+            mark, "serve.publish_reject")
+        forensics["forensics_ok"] = bool(
+            forensics["forensics_ok"] and forensics["reject_events"] >= 2)
         ok = (rejected and midwarm_failed and still_v1 and served_exact
-              and recovered and rejects == 2)
+              and recovered and rejects == 2
+              and forensics["forensics_ok"])
         return {"ok": ok, "garbage_rejected": rejected,
                 "midwarm_failed": midwarm_failed,
                 "active_served_exact": served_exact,
                 "clean_publish_recovered": recovered,
-                "publish_rejects": rejects}
+                "publish_rejects": rejects, **forensics}
     finally:
         srv.close()
+        dump.disarm()
+        shutil.rmtree(crash_dir, ignore_errors=True)
 
 
 def scenario_dispatcher_stall() -> dict:
     """A wedged device batch: the watchdog fails its requests fast (the
     503 path) instead of hanging the queue, and a DEAD dispatcher thread
-    is restarted — traffic resumes on the same version both times."""
+    is restarted — traffic resumes on the same version both times.
+    Forensics: the wedge is a crash-grade moment — EXACTLY ONE validated
+    bundle (reason watchdog_stall; the later dispatcher death hits the
+    once-per-arming latch, it must not shred the stall evidence)."""
+    from lightgbmv1_tpu.obs import dump, events
     from lightgbmv1_tpu.serve import DispatcherDied, DispatcherStalled, \
         Server
     from lightgbmv1_tpu.utils import faults
     from lightgbmv1_tpu.utils.faults import FaultSpec
 
     b1, _, X = _tiny_boosters()
+    mark = events.seq()
+    crash_dir = tempfile.mkdtemp(prefix="lgbm_chaos_wd_")
+    dump.arm(crash_dir)
     srv = Server(b1, config=_serve_cfg(watchdog_ms=200.0))
     try:
         srv.submit(X[:4])                 # warm
@@ -351,24 +450,42 @@ def scenario_dispatcher_stall() -> dict:
         snap = srv.metrics_snapshot()
         restarted = snap["dispatcher_restarts"] >= 1 and r2.version == "v1"
         healthy = srv.health()["ok"]
-        ok = stalled_fast and post_stall and died and restarted and healthy
+        forensics = _check_bundles(crash_dir, expect=1,
+                                   reasons=("watchdog_stall",))
+        forensics["stall_events"] = _count_events(
+            mark, "serve.watchdog_stall")
+        forensics["restart_events"] = _count_events(
+            mark, "serve.dispatcher_restart")
+        forensics["forensics_ok"] = bool(
+            forensics["forensics_ok"] and forensics["stall_events"] >= 1
+            and forensics["restart_events"] >= 1)
+        ok = (stalled_fast and post_stall and died and restarted
+              and healthy and forensics["forensics_ok"])
         return {"ok": ok, "stalled_failed_fast": stalled_fast,
                 "post_stall_recovered": post_stall,
                 "dispatcher_died": died,
                 "watchdog_restarted": restarted, "healthy_after": healthy,
-                "watchdog_failures": snap["watchdog_failures"]}
+                "watchdog_failures": snap["watchdog_failures"],
+                **forensics}
     finally:
         srv.close()
+        dump.disarm()
+        shutil.rmtree(crash_dir, ignore_errors=True)
 
 
 def scenario_overload() -> dict:
     """A burst far above capacity into a small admission queue: explicit
     sheds, backlog bounded at the configured depth, zero hangs, and
-    post-burst requests succeed."""
+    post-burst requests succeed.  Forensics: sheds are recoverable —
+    ``serve.shed`` events, NO crash bundle."""
+    from lightgbmv1_tpu.obs import dump, events
     from lightgbmv1_tpu.serve import Server, ServerOverloaded
 
     b1, _, X = _tiny_boosters()
     depth = 64
+    mark = events.seq()
+    crash_dir = tempfile.mkdtemp(prefix="lgbm_chaos_ov_")
+    dump.arm(crash_dir)
     srv = Server(b1, config=_serve_cfg(
         max_batch_rows=32, queue_depth_rows=depth,
         max_batch_delay_ms=20.0, watchdog_ms=0.0))
@@ -398,26 +515,39 @@ def scenario_overload() -> dict:
         snap = srv.metrics_snapshot()
         bounded = snap["queue_depth_max"] <= depth
         r = srv.submit(X[:4])             # post-burst service
+        forensics = _check_bundles(crash_dir, expect=0)
+        forensics["shed_events"] = _count_events(mark, "serve.shed")
+        forensics["forensics_ok"] = bool(
+            forensics["forensics_ok"]
+            and forensics["shed_events"] == results["shed"])
         ok = (not hung and results["shed"] > 0 and results["other"] == 0
               and bounded and r.version == "v1"
-              and results["ok"] + results["shed"] == 32)
+              and results["ok"] + results["shed"] == 32
+              and forensics["forensics_ok"])
         return {"ok": ok, "served": results["ok"], "shed": results["shed"],
                 "failed": results["other"], "hung": hung,
                 "queue_depth_max": snap["queue_depth_max"],
-                "queue_bounded": bounded}
+                "queue_bounded": bounded, **forensics}
     finally:
         srv.close()
+        dump.disarm()
+        shutil.rmtree(crash_dir, ignore_errors=True)
 
 
 def scenario_h2d_transient() -> dict:
     """A transient host->device transfer failure inside the device batch
     is retried with backoff: the client sees a normal answer, never an
-    error."""
+    error.  Forensics: the injection is a ``fault.injected`` event and
+    the retried-and-recovered fault writes NO crash bundle."""
+    from lightgbmv1_tpu.obs import dump, events
     from lightgbmv1_tpu.serve import Server
     from lightgbmv1_tpu.utils import faults
     from lightgbmv1_tpu.utils.faults import FaultSpec
 
     b1, _, X = _tiny_boosters()
+    mark = events.seq()
+    crash_dir = tempfile.mkdtemp(prefix="lgbm_chaos_h2d_")
+    dump.arm(crash_dir)
     srv = Server(b1, config=_serve_cfg())
     try:
         srv.submit(X[:4])
@@ -426,11 +556,19 @@ def scenario_h2d_transient() -> dict:
             r = srv.submit(X[:8])
         snap = srv.metrics_snapshot()
         exact = np.array_equal(r.values[:, 0], want)
-        ok = exact and snap["retries"] >= 1 and snap["errors"] == 0
+        forensics = _check_bundles(crash_dir, expect=0)
+        forensics["fault_events"] = _count_events(mark, "fault.injected")
+        forensics["forensics_ok"] = bool(
+            forensics["forensics_ok"] and forensics["fault_events"] >= 1)
+        ok = (exact and snap["retries"] >= 1 and snap["errors"] == 0
+              and forensics["forensics_ok"])
         return {"ok": ok, "answer_exact": exact,
-                "retries": snap["retries"], "errors": snap["errors"]}
+                "retries": snap["retries"], "errors": snap["errors"],
+                **forensics}
     finally:
         srv.close()
+        dump.disarm()
+        shutil.rmtree(crash_dir, ignore_errors=True)
 
 
 # ---------------------------------------------------------------------------
@@ -475,6 +613,10 @@ def run_suite(fast: bool = False) -> dict:
         "n_scenarios": len(scenarios),
         "scenarios": scenarios,
         "chaos_ok": all(s.get("ok") for s in scenarios.values()),
+        # the flight-recorder contract across ALL scenarios: bundles for
+        # kills/wedges, none for recovered faults, every bundle valid
+        "forensics_ok": all(s.get("forensics_ok", False)
+                            for s in scenarios.values()),
         "fast": bool(fast),
     }
     return record

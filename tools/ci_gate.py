@@ -16,6 +16,14 @@ single command:
   870 s driver budget.  A MISSING log fails the gate (a guard that
   silently skips is not a guard) unless ``--skip-t1`` says the caller
   genuinely has no suite run to judge (e.g. a records-only capture box).
+* **required guards** — ``--require-guards obs_ok,slo_ok,forensics_ok``
+  (ISSUE 10): the NEWEST BENCH record must CONTAIN each named guard and
+  hold it True.  The trend sentinel only flags a guard that is present
+  and False; this check additionally fails a capture that silently
+  dropped the field (a guard that vanishes is a guard that failed).
+  Off by default so records predating a guard still gate cleanly;
+  driver captures after ISSUE 10 pass
+  ``--require-guards obs_ok,slo_ok,forensics_ok,chaos_ok``.
 
 Exit code 0 only when every enabled guard passes; each guard's own
 report is printed so the failing one is obvious.
@@ -33,12 +41,34 @@ import bench_trend  # noqa: E402
 import tier1_budget  # noqa: E402
 
 
+def check_required_guards(records_dir: str, guards, out=print) -> bool:
+    """The newest BENCH record must carry every named guard as True —
+    present-and-True, not merely not-False (a capture that dropped the
+    field fails)."""
+    records = bench_trend.load_bench_records(records_dir)
+    if not records:
+        out("ci_gate: --require-guards with NO bench records — FAIL")
+        return False
+    name, newest = records[-1]
+    ok = True
+    for g in guards:
+        v = newest.get(g)
+        if v is True:
+            out(f"ci_gate: required guard {g} = True ({name})")
+        else:
+            out(f"ci_gate: required guard {g} "
+                f"{'MISSING from' if g not in newest else f'= {v} in'} "
+                f"{name} — FAIL")
+            ok = False
+    return ok
+
+
 def run_gate(records_dir: str, t1_log: str, skip_trend: bool = False,
              skip_t1: bool = False, budget: float = None,
-             frac: float = None, out=print) -> dict:
-    """Run both guards; returns ``{"trend_ok", "t1_ok", "ok"}`` (skipped
-    guards report True and are marked in the dict)."""
-    results = {"trend_ok": True, "t1_ok": True,
+             frac: float = None, require_guards=(), out=print) -> dict:
+    """Run the guards; returns ``{"trend_ok", "t1_ok", "guards_ok",
+    "ok"}`` (skipped guards report True and are marked in the dict)."""
+    results = {"trend_ok": True, "t1_ok": True, "guards_ok": True,
                "trend_skipped": bool(skip_trend),
                "t1_skipped": bool(skip_t1)}
     if not skip_trend:
@@ -47,6 +77,9 @@ def run_gate(records_dir: str, t1_log: str, skip_trend: bool = False,
         results["trend_ok"] = bool(trend["ok"])
     else:
         out("ci_gate: trend guard SKIPPED")
+    if require_guards:
+        results["guards_ok"] = check_required_guards(
+            records_dir, require_guards, out=out)
     if not skip_t1:
         if not os.path.exists(t1_log):
             out(f"ci_gate: tier-1 log {t1_log!r} not found — the budget "
@@ -64,9 +97,11 @@ def run_gate(records_dir: str, t1_log: str, skip_trend: bool = False,
                 tier1_budget.report(per_test, wall, out=out, **kw))
     else:
         out("ci_gate: tier-1 budget guard SKIPPED")
-    results["ok"] = results["trend_ok"] and results["t1_ok"]
+    results["ok"] = (results["trend_ok"] and results["t1_ok"]
+                     and results["guards_ok"])
     out(f"ci_gate: {'PASS' if results['ok'] else 'FAIL'} "
-        f"(trend_ok={results['trend_ok']}, t1_ok={results['t1_ok']})")
+        f"(trend_ok={results['trend_ok']}, t1_ok={results['t1_ok']}, "
+        f"guards_ok={results['guards_ok']})")
     return results
 
 
@@ -80,10 +115,16 @@ def main(argv=None) -> int:
     ap.add_argument("--skip-t1", action="store_true")
     ap.add_argument("--budget", type=float, default=None)
     ap.add_argument("--frac", type=float, default=None)
+    ap.add_argument("--require-guards", default="",
+                    help="comma-separated guard fields the NEWEST bench "
+                         "record must carry as True (e.g. "
+                         "obs_ok,slo_ok,forensics_ok,chaos_ok)")
     args = ap.parse_args(argv)
+    guards = tuple(g for g in args.require_guards.split(",") if g)
     results = run_gate(args.records, args.t1_log,
                        skip_trend=args.skip_trend, skip_t1=args.skip_t1,
-                       budget=args.budget, frac=args.frac)
+                       budget=args.budget, frac=args.frac,
+                       require_guards=guards)
     return 0 if results["ok"] else 1
 
 

@@ -45,7 +45,8 @@ def run_loadgen(server, X: np.ndarray, *, rate_qps: float,
                 swap_at_frac: Optional[float] = None,
                 swap_fn: Optional[Callable[[], None]] = None,
                 tail_requests_after_swap: int = 0,
-                check_fn: Optional[Callable] = None) -> Dict[str, object]:
+                check_fn: Optional[Callable] = None,
+                export_artifacts_to: str = "") -> Dict[str, object]:
     """Drive ``server.submit`` with open-loop Poisson arrivals.
 
     ``X`` is the row pool (requests sample ``rows_per_req`` consecutive
@@ -64,8 +65,11 @@ def run_loadgen(server, X: np.ndarray, *, rate_qps: float,
     latency histogram is ``loadgen_latency_ms`` (exact quantiles over a
     full-run sample window), per-version counts are
     ``loadgen_version_total{version=...}`` — the returned dict is
-    computed FROM the registry, and the registry itself rides along
-    under the ``"registry"`` key for Prometheus exposition."""
+    computed FROM the registry and carries its flat JSON dump under
+    ``"client_metrics"``.  ``export_artifacts_to`` (or the
+    ``LGBMV1_OBS_DIR`` env var) additionally writes the registry as a
+    loadgen-role per-process artifact for ``tools/obs_aggregate.py`` to
+    merge next to the server's (ISSUE 10)."""
     from lightgbmv1_tpu.obs.metrics import Registry
     from lightgbmv1_tpu.serve.server import (RequestTimeout,
                                              ServerOverloaded)
@@ -162,6 +166,22 @@ def run_loadgen(server, X: np.ndarray, *, rate_qps: float,
         for s in tail_starts:
             do_one(int(s))
     wall = time.monotonic() - t0
+
+    export_dir = export_artifacts_to or os.environ.get("LGBMV1_OBS_DIR",
+                                                       "")
+    if export_dir:
+        # the loadgen's own per-process artifact (obs/agg.py): its
+        # client registry under a loadgen-role label, so
+        # tools/obs_aggregate.py merges the client view next to the
+        # server's in one snapshot / one Perfetto timeline
+        from lightgbmv1_tpu.obs import agg as obs_agg
+        from lightgbmv1_tpu.obs import events as obs_events
+
+        ident = obs_events.identity()
+        obs_agg.export_process_artifacts(
+            export_dir,
+            label=f"loadgen-{ident['host']}-{ident['pid']}",
+            registry=reg)
 
     stats = {oc: int(outcomes.labels(outcome=oc).get())
              for oc in ("ok", "shed", "timeout", "error")}

@@ -1,0 +1,78 @@
+"""Merge per-process obs artifacts into ONE Perfetto trace + snapshot.
+
+A fleet run leaves one artifact set per process — ``<label>.trace.json``
+/ ``<label>.metrics.json`` / ``<label>.events.jsonl`` written by
+``obs.agg.export_process_artifacts`` (the CLI writes them when
+``obs_dir=<dir>`` or ``LGBMV1_OBS_DIR`` is set), plus ``crash-*.zip``
+forensic bundles from any process that died (obs/dump.py).  This tool
+merges everything in a directory into:
+
+* ``merged.trace.json`` — one Chrome trace: each process is a distinct
+  pid lane named ``role host:pid``, rebased onto a shared wall-clock
+  axis (open at https://ui.perfetto.dev);
+* ``merged.metrics.json`` — per-process snapshots verbatim plus an
+  additive ``merged`` view (``*_total``/``*_count``/``*_sum`` summed,
+  ``*_max`` maxed) and the interleaved cross-process event log.
+
+Usage::
+
+    python tools/obs_aggregate.py <artifact_dir>
+        [--out merged.trace.json] [--metrics-out merged.metrics.json]
+        [--json]
+
+Exit 0 with a one-line summary (or the full JSON summary under
+``--json``); exit 1 when the directory holds no artifacts at all.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from lightgbmv1_tpu.obs import agg  # noqa: E402
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("artifact_dir",
+                    help="directory of per-process obs artifacts "
+                         "(and/or crash-*.zip forensic bundles)")
+    ap.add_argument("--out", default=None,
+                    help="merged Chrome trace path "
+                         "(default <dir>/merged.trace.json)")
+    ap.add_argument("--metrics-out", default=None,
+                    help="merged metrics path "
+                         "(default <dir>/merged.metrics.json)")
+    ap.add_argument("--json", action="store_true",
+                    help="print the machine-readable summary")
+    args = ap.parse_args(argv)
+    if not os.path.isdir(args.artifact_dir):
+        print(f"obs_aggregate: {args.artifact_dir!r} is not a directory")
+        return 1
+    summary = agg.aggregate_dir(args.artifact_dir, out_trace=args.out,
+                                out_metrics=args.metrics_out)
+    if not summary["sources"]:
+        print(f"obs_aggregate: no artifacts in {args.artifact_dir!r} "
+              "(expected *.trace.json / *.metrics.json / *.events.jsonl "
+              "or crash-*.zip)")
+        return 1
+    if args.json:
+        print(json.dumps(summary, indent=1))
+    else:
+        print(f"obs_aggregate: merged {len(summary['sources'])} "
+              f"process(es) {summary['sources']} -> "
+              f"{summary['lanes']} lane(s), "
+              f"{summary['trace_events']} spans, "
+              f"{summary['merged_events']} events; wrote "
+              f"{summary['merged_trace']} and "
+              f"{summary['merged_metrics']} (open the trace at "
+              "https://ui.perfetto.dev)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -359,6 +359,53 @@ def observability_section(w, rec):
     w("")
 
 
+def forensics_slo_section(w, rec):
+    """Forensics & SLO (ISSUE 10 — bench.py measure_obs + measure_chaos):
+    the serving SLO burn-rate block (availability / latency SLIs,
+    exemplar trace ids), the flight-recorder drill, the loadgen+server
+    aggregation probe, and the chaos suite's bundle contract.
+    Placeholder until the first capture that carries the fields."""
+    w("## Forensics & SLO (flight recorder + burn-rate, obs/dump.py + "
+      "serve/slo.py)")
+    w("")
+    if rec.get("slo_ok") is None and rec.get("forensics_ok") is None:
+        w("No forensics/SLO fields in this record yet — the next driver "
+          "capture runs the extended measure_obs (SLO burn-rate "
+          "evaluation over the loadgen window with exemplar trace ids, "
+          "a flight-recorder drill writing one validated bundle, and "
+          "the loadgen+server artifact aggregation probe) plus "
+          "measure_chaos's per-scenario bundle contract, and this "
+          "section renders the `slo_ok` / `forensics_ok` / "
+          "`obs_agg_ok` / `chaos_forensics_ok` guards.")
+        w("")
+        return
+    w("| availability SLI (fast) | latency SLI (fast) | avail burn | "
+      "exemplars | agg sources |")
+    w("|---|---|---|---|---|")
+    w(f"| {get(rec, 'slo_availability', 4)} | "
+      f"{get(rec, 'slo_latency_sli', 4)} | "
+      f"{get(rec, 'slo_availability_burn', 4)} | "
+      f"{get(rec, 'slo_exemplars', 0)} | "
+      f"{get(rec, 'obs_agg_sources', 0)} |")
+    w("")
+    w(f"Guards: `slo_ok={rec.get('slo_ok')}` (sane multi-window "
+      "burn-rate evaluation, page-on-burning/quiet-on-clean alert "
+      "logic, 16-hex exemplar trace ids on the latency buckets, "
+      "`GET /slo` payload serializes); "
+      f"`forensics_ok={rec.get('forensics_ok')}` (an armed flight "
+      "recorder writes exactly ONE schema-valid, digest-intact, "
+      "Perfetto-loadable bundle per arming); "
+      f"`obs_agg_ok={rec.get('obs_agg_ok')}` (tools/obs_aggregate.py "
+      "merges the loadgen + server artifacts into one trace with "
+      "distinct pid lanes and one additive snapshot); "
+      f"`chaos_forensics_ok={rec.get('chaos_forensics_ok')}` (every "
+      "chaos kill/wedge left exactly one validated bundle, every "
+      "recovered fault left none).  Knobs: `crash_dir` / "
+      "`LGBMV1_CRASH_DIR`, `obs_dir` / `LGBMV1_OBS_DIR`, "
+      "`serve_slo_*` (BASELINE.md).")
+    w("")
+
+
 def trend_section(w, root=ROOT):
     """Trend: the regression sentinel's view of the whole BENCH record
     trajectory (tools/bench_trend.py — the same comparator that gates
@@ -633,6 +680,8 @@ def generate(rec, name, prev=None, prev_name=None):
     robustness_section(w, rec)
 
     observability_section(w, rec)
+
+    forensics_slo_section(w, rec)
 
     mc_name, mc = load_multichip()
     comm_section(w, mc_name, mc)
