@@ -708,6 +708,160 @@ def measure_serve(gb_lw, X):
     return fields
 
 
+def measure_fleet(gb_lw, X):
+    """Fault-tolerant fleet block (ISSUE 11) — on EVERY backend:
+
+    * **replica-kill under load** — a 3-replica fleet behind the
+      self-healing router takes open-loop loadgen traffic while one
+      replica is killed mid-run: ``fleet_zero_error_ok`` demands ZERO
+      client-visible failures (router retry/hedging absorbs the kill;
+      every answer stays bit-exact to the host oracle),
+      ``router_hedge_frac`` records hedge launches per completed
+      request, and the dead replica must be health-check ejected.
+    * **two-phase fleet publish** — a coordinated publish onto the
+      degraded fleet must land one aligned version tag everywhere
+      (``fleet_publish_ok``).
+    * **elastic kill-resume** — an ElasticCoordinator training run
+      (2-process jax.distributed where the backend supports cross-
+      process CPU collectives, 1-process otherwise — recorded in
+      ``fleet_elastic_world``) is killed at iteration 3 via the
+      ``peer_dead`` seam and re-bootstrapped from the newest checkpoint
+      bundle: ``fleet_kill_resume_ok`` pins the recovered model text
+      BYTE-IDENTICAL to the uninterrupted run and ``fleet_recovery_s``
+      records detection -> re-bootstrapped-and-beating wall time.
+
+    ``fleet_ok`` = zero-error-under-kill AND ejection observed AND
+    aligned publish AND byte-identical elastic resume."""
+    import shutil
+    import tempfile
+
+    from lightgbmv1_tpu.basic import Booster, _objective_string
+    from lightgbmv1_tpu.io.model_text import model_to_string
+    from lightgbmv1_tpu.serve import Fleet, Router, RouterConfig, \
+        ServeConfig
+    from lightgbmv1_tpu.serve.router import hedge_frac
+    from tools.loadgen import run_loadgen
+
+    trees = gb_lw.materialize_host_trees()
+    ds = gb_lw.train_set
+    model_str = model_to_string(
+        trees, objective_string=_objective_string(gb_lw.config),
+        num_class=1, num_tree_per_iteration=1,
+        feature_names=list(ds.feature_names),
+        feature_infos=ds.feature_infos())
+    full = Booster(model_str=model_str)
+    n_half = max(len(trees) // 2, 1)
+    half = Booster(model_str=full.model_to_string(num_iteration=n_half))
+
+    pool = np.asarray(X[:4096], np.float64)
+    want = np.asarray(half.predict(pool, raw_score=True,
+                                   predict_method="host"), np.float64)
+
+    def check(start, n, res):
+        return np.array_equal(res.values[:, 0], want[start:start + n])
+
+    fields = {}
+    cfg = ServeConfig(max_batch_rows=128, max_batch_delay_ms=1.0,
+                      queue_depth_rows=4096, f64_scores=True,
+                      watchdog_ms=250.0,
+                      predictor_kwargs={"bucket_min": 64})
+    fleet = Fleet(half, n_replicas=3, config=cfg)
+    router = Router(fleet, RouterConfig(health_period_ms=15.0,
+                                        retry_max=2, hedge_ms=50.0))
+    try:
+        router.submit(pool[:64])
+        lg = run_loadgen(
+            router, pool, rate_qps=float(os.environ.get(
+                "FLEET_RATE_QPS", 250)), duration_s=2.5, rows_per_req=2,
+            n_threads=6, seed=7, swap_at_frac=0.4,
+            swap_fn=lambda: fleet.replica("r1").close(),
+            check_fn=check)
+        deadline = time.time() + 3.0
+        while time.time() < deadline and \
+                "r1" not in router.health()["ejected_replicas"]:
+            time.sleep(0.05)
+        snap = router.metrics_snapshot()
+        fields["fleet_requests"] = lg["requests"]
+        fields["fleet_qps"] = lg["achieved_qps"]
+        fields["fleet_p99_ms"] = lg["client_p99_ms"]
+        fields["router_hedge_frac"] = hedge_frac(snap)
+        fields["fleet_router_retries"] = snap["retries"]
+        fields["fleet_zero_error_ok"] = bool(
+            lg["error"] == 0 and lg["timeout"] == 0 and lg["shed"] == 0
+            and lg["check_failures"] == 0 and lg["ok"] > 0)
+        fields["fleet_replica_ejected_ok"] = bool(
+            "r1" in router.health()["ejected_replicas"])
+        try:
+            tag = fleet.publish(full)
+            fields["fleet_publish_ok"] = bool(fleet.version() == tag)
+        except Exception as e:  # noqa: BLE001
+            fields["fleet_publish_error"] = \
+                f"{type(e).__name__}: {e}"[:200]
+            fields["fleet_publish_ok"] = False
+    finally:
+        router.close()
+        fleet.close()
+
+    # ---- elastic kill-resume (parallel/elastic.py) ---------------------
+    from lightgbmv1_tpu.parallel.cluster import cpu_multiprocess_supported
+    from lightgbmv1_tpu.parallel.elastic import (ElasticConfig,
+                                                 ElasticCoordinator)
+
+    world = 2 if cpu_multiprocess_supported() else 1
+    fields["fleet_elastic_world"] = world
+    tmp = tempfile.mkdtemp(prefix="lgbm_bench_fleet_")
+    try:
+        rng = np.random.RandomState(0)
+        Xe = rng.randn(1600, 5)
+        ye = (Xe[:, 0] - Xe[:, 1] > 0).astype(float)
+        data = os.path.join(tmp, "train.tsv")
+        np.savetxt(data, np.column_stack([ye, Xe]), fmt="%.7g",
+                   delimiter="\t")
+        from lightgbmv1_tpu.config import Config as _Cfg
+
+        ecfg = ElasticConfig.from_config(
+            _Cfg.from_dict({"elastic_lease_timeout_s": 2.0,
+                            "elastic_max_restarts": 1}),
+            world=world, devices_per_proc=2)
+        env = {k: v for k, v in os.environ.items()
+               if k not in ("LGBMV1_FAULTS",)}
+
+        def run_one(name, fault_env=None):
+            wd = os.path.join(tmp, name)
+            coord = ElasticCoordinator(
+                wd, worker_args={
+                    "data": data,
+                    "model_out": os.path.join(wd, "model.txt"),
+                    "iterations": 6, "snapshot_freq": 2},
+                config=ecfg, fault_env=fault_env, env=env)
+            res = coord.run()
+            p = os.path.join(wd, "model.txt")
+            return res, (open(p).read() if os.path.exists(p) else None)
+
+        res_a, straight = run_one("straight")
+        plan = [{"kind": "peer_dead", "mode": "kill",
+                 "match": f"rank{world - 1}:iter3"}]
+        res_b, resumed = run_one(
+            "killed", fault_env={"LGBMV1_FAULTS": json.dumps(plan)})
+        fields["fleet_recovery_s"] = res_b.recovery_s
+        fields["fleet_restarts"] = res_b.restarts
+        fields["fleet_kill_resume_ok"] = bool(
+            res_a.ok and res_b.ok and straight is not None
+            and straight == resumed)
+    except Exception as e:  # noqa: BLE001 — partial records beat none
+        fields["fleet_elastic_error"] = f"{type(e).__name__}: {e}"[:200]
+        fields["fleet_kill_resume_ok"] = False
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+    fields["fleet_ok"] = bool(
+        fields.get("fleet_zero_error_ok")
+        and fields.get("fleet_replica_ejected_ok")
+        and fields.get("fleet_publish_ok")
+        and fields.get("fleet_kill_resume_ok"))
+    return fields
+
+
 def measure_chaos():
     """Robustness block (PR 6): the scripted fault suite (tools/chaos.py)
     runs its fast deterministic subset on EVERY backend — kill-and-resume
@@ -727,6 +881,8 @@ def measure_chaos():
         # flight-recorder contract (ISSUE 10): kill/wedge scenarios left
         # exactly one validated bundle each, recovered faults left none
         "chaos_forensics_ok": bool(rec.get("forensics_ok")),
+        # the fault-tolerant-fleet scenario subset (ISSUE 11)
+        "chaos_fleet_ok": bool(rec.get("chaos_fleet_ok")),
         "chaos_seconds": round(sum(v.get("seconds", 0)
                                    for v in rec["scenarios"].values()), 1),
     }
@@ -1530,6 +1686,15 @@ def main():
         extra["serve_error"] = f"{type(e).__name__}: {e}"[:200]
         extra["serve_ok"] = False
 
+    # Fault-tolerant fleet block (ISSUE 11): replica-kill under loadgen
+    # with zero client-visible errors, coordinated two-phase publish,
+    # and the elastic kill-resume byte-parity drill — on every backend.
+    try:
+        extra.update(measure_fleet(gb_lw, X))
+    except Exception as e:  # noqa: BLE001
+        extra["fleet_error"] = f"{type(e).__name__}: {e}"[:200]
+        extra["fleet_ok"] = False
+
     # Robustness block (PR 6): the scripted chaos suite on every backend
     # — every injected fault (kill/torn-file/NaN/stall/garbage-publish/
     # overload/transient-H2D) must be recovered or the record flags it.
@@ -1538,6 +1703,7 @@ def main():
     except Exception as e:  # noqa: BLE001
         extra["chaos_error"] = f"{type(e).__name__}: {e}"[:200]
         extra["chaos_ok"] = False
+        extra["chaos_fleet_ok"] = False
 
     # Out-of-core streaming block (PR 8, data/ subsystem): block cache +
     # row-block trainer vs the resident trainer — byte parity AND the

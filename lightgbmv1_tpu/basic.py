@@ -236,6 +236,17 @@ class Dataset:
                 self.set_init_score(self.init_score)
 
     # ------------------------------------------------------------------
+    @classmethod
+    def from_binned(cls, binned: "BinnedDataset",
+                    params: Optional[Dict[str, Any]] = None) -> "Dataset":
+        """Wrap an ALREADY-binned :class:`BinnedDataset` (e.g. the
+        distributed loader's process shard, ``parallel/dist_data.py``)
+        in the Dataset surface the Booster consumes — no re-parse, no
+        re-bin; ``construct()`` is a no-op."""
+        ds = cls(None, params=params)
+        ds._binned = binned
+        return ds
+
     def construct(self) -> "Dataset":
         if self._binned is not None:
             return self
@@ -977,24 +988,30 @@ class Booster:
         return self
 
     # ------------------------------------------------------------------
-    def save_checkpoint(self, path) -> "Booster":
+    def save_checkpoint(self, path, write_file: bool = True) -> "Booster":
         """Write a crash-consistent full-trainer-state bundle
         (io/checkpoint.py): model text + score caches + RNG/bagging/DART
         state + iteration counter, atomically.  A training run resumed
         from this bundle (:meth:`resume_from_checkpoint`) continues
         BIT-EXACTLY — the final model text matches the uninterrupted
-        run's byte for byte (tests/test_checkpoint.py)."""
+        run's byte for byte (tests/test_checkpoint.py).
+
+        Under multi-process training the state capture is a COLLECTIVE
+        (cross-process score caches are gathered): every rank must call
+        this in lockstep, with ``write_file=False`` on the non-writing
+        ranks (parallel/elastic_worker.py — one bundle, rank 0's)."""
         if self._gbdt is None:
             log_fatal("save_checkpoint() requires a training Booster")
         from .io.checkpoint import write_checkpoint
 
         manifest, arrays = self._gbdt.capture_state()
         manifest["num_trees_total"] = self.num_trees()
-        write_checkpoint(str(path), manifest, arrays,
-                         model_text=self.model_to_string(),
-                         base_model_text=(self._loaded_str
-                                          if self._loaded is not None
-                                          else "") or "")
+        if write_file:
+            write_checkpoint(str(path), manifest, arrays,
+                             model_text=self.model_to_string(),
+                             base_model_text=(self._loaded_str
+                                              if self._loaded is not None
+                                              else "") or "")
         return self
 
     def resume_from_checkpoint(self, path_or_bundle) -> "Booster":

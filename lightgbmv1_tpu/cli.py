@@ -395,7 +395,14 @@ def run_serve(config: Config):
     into a warm :class:`~lightgbmv1_tpu.serve.Server`, and listen on the
     stdlib HTTP front-end.  ``serve_duration_s>0`` bounds the run (CI /
     driver smoke); 0 serves until interrupted.  Returns the
-    ``(server, http)`` pair so tests can drive it in-process."""
+    ``(server, http)`` pair so tests can drive it in-process.
+
+    ``serve_replicas > 1`` stands up the fault-tolerant fleet instead:
+    N replica Servers (serve/fleet.py, coordinated two-phase publish)
+    behind the self-healing router (serve/router.py — health-check
+    ejection, retry-onto-another-replica, optional hedging), served
+    through the SAME HTTP front-end; the returned "server" is the
+    Router."""
     import time as _time
 
     from .serve import ServeHTTP
@@ -413,7 +420,31 @@ def run_serve(config: Config):
         tracing = True
     booster = Booster(params=_config_to_params(config),
                       model_file=config.input_model)
-    server = build_server(booster, config)
+    fleet = None
+    if config.serve_replicas > 1:
+        from .serve import (Fleet, Router, RouterConfig, SLOConfig,
+                            serve_config_from)
+
+        fleet = Fleet(booster, n_replicas=config.serve_replicas,
+                      config=serve_config_from(config))
+        server = Router(fleet, RouterConfig(
+            health_period_ms=config.router_health_period_ms,
+            eject_after=config.router_eject_after,
+            readmit_after=config.router_readmit_after,
+            retry_max=config.router_retry_max,
+            hedge_ms=config.router_hedge_ms,
+            deadline_ms=config.router_deadline_ms,
+            slo=SLOConfig(
+                availability_target=config.serve_slo_availability_target,
+                latency_ms=config.serve_slo_latency_ms,
+                latency_target=config.serve_slo_latency_target,
+                fast_window_s=config.serve_slo_fast_window_s,
+                slow_window_s=config.serve_slo_slow_window_s,
+            )))
+        log_info(f"serve: fleet of {config.serve_replicas} replicas "
+                 f"({fleet.version()}) behind the router")
+    else:
+        server = build_server(booster, config)
     http = ServeHTTP(server, port=config.serve_http_port).start()
     log_info(f"serve: HTTP listening on 127.0.0.1:{http.port} "
              "(POST /predict, GET /metrics, GET /healthz)")
@@ -441,6 +472,8 @@ def run_serve(config: Config):
                 obs_dir, registry=server.metrics.registry)
             log_info(f"serve: wrote obs artifacts to {obs_dir}")
         server.close()
+        if fleet is not None:
+            fleet.close()
         if tracing:
             from .obs import trace as obs_trace
 

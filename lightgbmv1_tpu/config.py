@@ -533,6 +533,37 @@ class Config:
     # clear when the fast window recovers)
     serve_slo_fast_window_s: float = 60.0
     serve_slo_slow_window_s: float = 600.0
+    # -- fault-tolerant fleet (ISSUE 11) -------------------------------
+    # task=serve with serve_replicas > 1 stands up a replicated fleet
+    # (serve/fleet.py: N replica Servers, two-phase coordinated publish)
+    # behind the self-healing router (serve/router.py); 1 = single
+    # Server, the pre-fleet behavior
+    serve_replicas: int = 1
+    # router health poller: a replica failing router_eject_after
+    # consecutive health checks (dead/wedged dispatcher, nothing
+    # published) is ejected from the candidate set; readmitted after
+    # router_readmit_after consecutive healthy checks
+    router_health_period_ms: float = 25.0
+    router_eject_after: int = 2
+    router_readmit_after: int = 2
+    # per-request self-healing: retryable replica failures are retried
+    # on a DIFFERENT replica up to router_retry_max extra attempts;
+    # router_hedge_ms > 0 launches a hedge attempt on another replica
+    # when the primary hasn't answered within that delay (first answer
+    # wins, the loser is discarded without double-counting)
+    router_retry_max: int = 2
+    router_hedge_ms: float = 0.0
+    # whole-request deadline across retries/hedges; exhaustion returns
+    # 504 (RequestTimeout), never 500; 0 = no deadline
+    router_deadline_ms: float = 0.0
+    # -- elastic training recovery (parallel/elastic.py) ---------------
+    # worker lease staleness bound: a peer whose lease file goes stale
+    # past this is declared dead and survivors abort for re-bootstrap
+    # (the bounded detection window)
+    elastic_lease_timeout_s: float = 3.0
+    # re-bootstraps the elastic coordinator attempts before giving up;
+    # each resumes bit-exactly from the newest intact checkpoint bundle
+    elastic_max_restarts: int = 2
 
     # -- IO -----------------------------------------------------------------
     max_bin: int = 255
@@ -707,6 +738,22 @@ class Config:
             raise ValueError(
                 "serve_slo windows need 0 < fast_window_s <= "
                 "slow_window_s (the page rule evaluates both)")
+        if self.serve_replicas < 1:
+            raise ValueError("serve_replicas must be >= 1")
+        if self.router_health_period_ms <= 0:
+            raise ValueError("router_health_period_ms must be > 0")
+        if self.router_eject_after < 1 or self.router_readmit_after < 1:
+            raise ValueError("router_eject_after / router_readmit_after "
+                             "must be >= 1")
+        if self.router_retry_max < 0 or self.router_hedge_ms < 0 \
+                or self.router_deadline_ms < 0:
+            raise ValueError("router_retry_max / router_hedge_ms / "
+                             "router_deadline_ms must be >= 0")
+        if self.elastic_lease_timeout_s <= 0:
+            raise ValueError("elastic_lease_timeout_s must be > 0 "
+                             "(the peer-loss detection window)")
+        if self.elastic_max_restarts < 0:
+            raise ValueError("elastic_max_restarts must be >= 0")
         if self.trace_out:
             # the artifact path is the arming intent (documented knob
             # precedence: trace_out implies obs_trace)

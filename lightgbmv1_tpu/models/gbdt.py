@@ -971,26 +971,44 @@ class GBDT:
     # and therefore stateless — only the sequentially-consumed
     # RandomStates (feature sampling, DART drops) need saving.
     # ------------------------------------------------------------------
+    @staticmethod
+    def _host_fetch(arr) -> np.ndarray:
+        """Dtype-preserving host fetch of a possibly cross-process
+        array (checkpoint capture under multi-process training): an
+        addressable or fully-replicated array reads directly; a
+        process-spanning sharded one is gathered through a jitted
+        identity with replicated out-sharding.  NOTE the gather is a
+        COLLECTIVE — under ``jax.process_count() > 1`` every process
+        must call ``capture_state`` in lockstep (the elastic worker
+        captures on all ranks and writes on rank 0)."""
+        if getattr(arr, "is_fully_addressable", True) or \
+                getattr(arr, "is_fully_replicated", False):
+            return np.asarray(jax.device_get(arr))
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        rep = jax.jit(
+            lambda a: a,
+            out_shardings=NamedSharding(arr.sharding.mesh, P()))(arr)
+        return np.asarray(jax.device_get(rep))
+
     def capture_state(self):
         """-> (manifest dict, arrays dict) for io.checkpoint.write."""
         from ..io.checkpoint import encode_rng_state
         from .tree import TreeArrays
 
-        trees = jax.device_get(self._device_trees)
         arrays: Dict[str, np.ndarray] = {}
         for f in TreeArrays._fields:
             arrays[f"tree_{f}"] = np.stack(
-                [np.asarray(getattr(t, f)) for t in trees])
-        arrays["train_score"] = np.asarray(
-            jax.device_get(self._train_scores.score))
+                [self._host_fetch(getattr(t, f))
+                 for t in self._device_trees])
+        arrays["train_score"] = self._host_fetch(self._train_scores.score)
         for i, vs in enumerate(self._valid_scores):
-            arrays[f"valid_score_{i}"] = np.asarray(jax.device_get(vs.score))
-        cegb = jax.device_get(self._cegb_used)
-        if isinstance(cegb, tuple):
-            arrays["cegb_used"] = np.asarray(cegb[0])
-            arrays["cegb_marks"] = np.asarray(cegb[1])
+            arrays[f"valid_score_{i}"] = self._host_fetch(vs.score)
+        if isinstance(self._cegb_used, tuple):
+            arrays["cegb_used"] = self._host_fetch(self._cegb_used[0])
+            arrays["cegb_marks"] = self._host_fetch(self._cegb_used[1])
         else:
-            arrays["cegb_used"] = np.asarray(cegb)
+            arrays["cegb_used"] = self._host_fetch(self._cegb_used)
         manifest = {
             "iteration": int(self.iter),
             "num_trees": len(self.models),

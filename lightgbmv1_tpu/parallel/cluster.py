@@ -24,13 +24,66 @@ Standard cluster launchers (SLURM, Cloud TPU pods) are auto-detected by
 
 from __future__ import annotations
 
+import random
 import socket
+import time
 from typing import List, Optional
 
 from ..config import Config
 from ..utils.log import log_fatal, log_info, log_warning
 
 _initialized = False
+
+
+def find_free_port(host: str = "127.0.0.1") -> int:
+    """Ask the kernel for a currently-free TCP port.  The port is
+    released before returning, so callers that hand it to a coordinator
+    must be prepared for the (rare) collision where another process
+    grabs it first — pair with :func:`init_cluster`'s bootstrap retry
+    or re-allocate on failure (tests/test_multihost.py does both)."""
+    with socket.socket() as s:
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        s.bind((host, 0))
+        return int(s.getsockname()[1])
+
+
+def enable_cpu_collectives() -> bool:
+    """Turn on cross-process collectives for the CPU backend (gloo).
+
+    jax's CPU backend ships with collectives DISABLED: a 2-process
+    ``jax.distributed`` run bootstraps fine and then every multiprocess
+    computation dies with "Multiprocess computations aren't implemented
+    on the CPU backend".  The gloo implementation (when this jaxlib
+    carries it) makes the 2-process CPU harness — the multihost tests,
+    the elastic-recovery chaos scenario — actually run the collectives
+    instead of hanging or failing.  Returns True when the option was
+    available (already-gloo counts); False on jax builds without it.
+    No-op for TPU/GPU backends (the flag only affects CPU clients)."""
+    import jax
+
+    flag = "jax_cpu_collectives_implementation"
+    values = getattr(jax.config, "values", {})
+    if flag not in values:
+        return False
+    try:
+        if values.get(flag) in (None, "", "none"):
+            jax.config.update(flag, "gloo")
+        return True
+    except Exception as e:  # noqa: BLE001 — backend already initialized
+        log_warning(f"cluster: could not enable CPU collectives ({e}); "
+                    "multiprocess CPU computations may fail")
+        return False
+
+
+def cpu_multiprocess_supported() -> bool:
+    """Cheap capability probe: does this jax build carry a CPU
+    cross-process collectives implementation at all?  (Bootstrap
+    succeeding proves only the gRPC coordination service; the first
+    psum needs gloo.)"""
+    import jax
+
+    return "jax_cpu_collectives_implementation" in getattr(
+        jax.config, "values", {})
 
 
 def _local_addresses() -> List[str]:
@@ -83,12 +136,22 @@ def init_cluster(
     coordinator_address: Optional[str] = None,
     num_processes: Optional[int] = None,
     process_id: Optional[int] = None,
+    bootstrap_retries: int = 3,
+    bootstrap_backoff_s: float = 0.5,
 ) -> None:
     """Initialize jax.distributed so a process-spanning Mesh is available.
 
     Call once per process before building any trainer.  With a ``Config``
     carrying ``machines``/``num_machines`` the reference CLI semantics
     apply; with no arguments, jax's cluster auto-detection is used.
+
+    The coordinator bootstrap is retried ``bootstrap_retries`` times with
+    deterministic jittered exponential backoff (seeded per (rank,
+    attempt)): a coordinator that is a beat late to bind, or an
+    ephemeral-port collision on a busy CI host, costs a retry instead of
+    the whole run — the reference's socket linker spins the same way
+    inside its ``time_out`` window (linkers_socket.cpp TryBind/Connect
+    loops).
     """
     global _initialized
     import jax
@@ -114,12 +177,30 @@ def init_cluster(
         # reference: network time_out is in MINUTES (config.h:692); it bounds
         # the socket-linker connect phase, here the coordinator barrier
         kw["initialization_timeout"] = config.time_out * 60
-    jax.distributed.initialize(
-        coordinator_address=coordinator_address,
-        num_processes=num_processes,
-        process_id=process_id,
-        **kw,
-    )
+    # the CPU backend needs gloo for any cross-process computation; set
+    # it BEFORE the first backend touch (no-op on TPU/GPU)
+    enable_cpu_collectives()
+    attempts = max(int(bootstrap_retries), 1)
+    for attempt in range(attempts):
+        try:
+            jax.distributed.initialize(
+                coordinator_address=coordinator_address,
+                num_processes=num_processes,
+                process_id=process_id,
+                **kw,
+            )
+            break
+        except Exception as e:  # noqa: BLE001 — barrier timeout / bind race
+            if attempt + 1 >= attempts:
+                raise
+            jitter = random.Random(
+                (process_id or 0) * 1_000_003 + attempt).random()
+            delay = bootstrap_backoff_s * (2 ** attempt) * (1.0 + jitter)
+            log_warning(
+                f"cluster: bootstrap attempt {attempt + 1}/{attempts} "
+                f"failed ({type(e).__name__}: {e}); retrying in "
+                f"{delay:.2f}s")
+            time.sleep(delay)
     _initialized = True
     log_info(
         f"Cluster initialized: process {jax.process_index()} of "

@@ -26,14 +26,19 @@ from .metrics import ServeMetrics
 from .registry import ModelRegistry, ModelVersion, PublishValidationError
 from .server import (DispatcherDied, DispatcherStalled, RequestTimeout,
                      ServeConfig, ServeError, ServeResult, Server,
-                     ServerClosed, ServerOverloaded, build_server)
+                     ServerClosed, ServerOverloaded, build_server,
+                     serve_config_from)
 from .http import ServeHTTP
 from .slo import SLOConfig, SLOTracker
+from .fleet import Fleet, FleetPublishError
+from .router import Router, RouterConfig
 
 __all__ = [
-    "DispatcherDied", "DispatcherStalled", "ModelRegistry", "ModelVersion",
-    "PublishValidationError", "RequestTimeout", "SLOConfig", "SLOTracker",
+    "DispatcherDied", "DispatcherStalled", "Fleet", "FleetPublishError",
+    "ModelRegistry", "ModelVersion",
+    "PublishValidationError", "RequestTimeout", "Router", "RouterConfig",
+    "SLOConfig", "SLOTracker",
     "ServeConfig", "ServeError", "ServeHTTP", "ServeMetrics",
     "ServeResult", "Server", "ServerClosed", "ServerOverloaded",
-    "build_server",
+    "build_server", "serve_config_from",
 ]

@@ -156,6 +156,12 @@ class ServeMetrics:
         per-bucket worst-tail trace ids."""
         return self._latency.exemplars()
 
+    def value(self, attr: str) -> int:
+        """Point read of one counter (e.g. ``dispatcher_restarts`` for
+        the /healthz observability fields) without building the full
+        snapshot."""
+        return int(self._c[attr].get())
+
     # -- read surface ----------------------------------------------------
     def prometheus_text(self, exemplars: bool = False) -> str:
         return self.registry.prometheus_text(exemplars=exemplars)

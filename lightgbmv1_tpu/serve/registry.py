@@ -67,7 +67,8 @@ class ModelRegistry:
 
     def __init__(self, *, warm_buckets: Optional[List[int]] = None,
                  history: int = 4, metrics=None,
-                 predictor_kwargs: Optional[Dict[str, Any]] = None):
+                 predictor_kwargs: Optional[Dict[str, Any]] = None,
+                 name: str = ""):
         self._lock = threading.Lock()
         self._active: Optional[ModelVersion] = None
         self._history: List[ModelVersion] = []
@@ -76,6 +77,9 @@ class ModelRegistry:
         self._keep = max(int(history), 1)
         self._metrics = metrics
         self._predictor_kwargs = dict(predictor_kwargs or {})
+        # replica identity (fleet.py): prefixes the publish_warm fault
+        # site so a chaos plan can fail ONE replica's warm phase
+        self.name = str(name)
 
     # -- build + warm (off the serving path) -----------------------------
     def _build(self, trees, K, F, degrade_trees: int) -> ModelVersion:
@@ -109,8 +113,12 @@ class ModelRegistry:
                     b *= 2
             for bucket in buckets:
                 # chaos seam: a publish() that dies mid-warm must leave
-                # the active version serving (utils/faults.py)
-                faults.fire("publish_warm", site=mv.tag)
+                # the active version serving (utils/faults.py); the
+                # replica name prefixes the site so a fleet chaos plan
+                # can target one replica's warm phase
+                faults.fire("publish_warm",
+                            site=(f"{self.name}:{mv.tag}" if self.name
+                                  else mv.tag))
                 x = np.zeros((min(bucket, max_batch_rows), mv.num_features),
                              np.float64)
                 out = np.asarray(bp.predict_raw(x))
@@ -162,6 +170,60 @@ class ModelRegistry:
                 f"{int(probe_rows)} probe rows")
 
     # -- public API ------------------------------------------------------
+    def prepare(self, model, *, degrade_trees: int = 0,
+                max_batch_rows: int = 1024,
+                meta: Optional[Dict[str, Any]] = None,
+                probe_rows: int = 64) -> ModelVersion:
+        """Phase 1 of a publish: build, warm and VALIDATE a candidate
+        version WITHOUT making it visible — the expensive, failable
+        half.  Returns the warmed :class:`ModelVersion` for
+        :meth:`commit`; raises (``PublishValidationError`` or the warm
+        failure) with the active version untouched.  ``fleet.py`` runs
+        this on EVERY replica before any replica swaps (two-phase
+        publish): a single replica's validation failure aborts the
+        whole fleet's publish with zero replicas moved."""
+        trees, K, F = _booster_parts(model)
+        if not trees:
+            raise ValueError("publish() needs a trained model "
+                             "(zero trees)")
+        try:
+            self._validate_trees(trees)
+            mv = self._build(trees, K, F, degrade_trees)
+            if meta:
+                mv.meta.update(meta)
+            mv.meta["n_warm"] = self._warm(mv, max_batch_rows)
+            if probe_rows > 0:
+                self._probe_check(mv, trees, K, F, probe_rows)
+        except Exception as e:
+            if self._metrics is not None:
+                self._metrics.on_publish_reject()
+            from ..obs import events as obs_events
+
+            obs_events.publish(
+                "serve.publish_reject",
+                f"{type(e).__name__}: {e}", severity="error",
+                n_trees=len(trees), replica=self.name)
+            log_warning(f"serve: publish rejected pre-swap "
+                        f"({type(e).__name__}: {e}); active version "
+                        "keeps serving")
+            raise
+        return mv
+
+    def commit(self, mv: ModelVersion) -> str:
+        """Phase 2: atomically make a prepared version current (one
+        reference swap under the lock — in-flight batches finish on the
+        version they started with)."""
+        with self._lock:
+            if self._active is not None:
+                self._history.append(self._active)
+                del self._history[:-self._keep]
+            self._active = mv
+        if self._metrics is not None:
+            self._metrics.on_swap()
+        log_info(f"serve: published {mv.tag} ({mv.n_trees} trees, "
+                 f"{mv.meta.get('n_warm', 0)} warmed executables)")
+        return mv.tag
+
     def publish(self, model, *, degrade_trees: int = 0,
                 max_batch_rows: int = 1024,
                 meta: Optional[Dict[str, Any]] = None,
@@ -178,42 +240,12 @@ class ModelRegistry:
         golden probe batch — all BEFORE the swap, so a corrupt model can
         never serve a single answer.  Failure raises
         :class:`PublishValidationError` and the active version keeps
-        serving untouched."""
-        trees, K, F = _booster_parts(model)
-        if not trees:
-            raise ValueError("publish() needs a trained model "
-                             "(zero trees)")
-        try:
-            self._validate_trees(trees)
-            mv = self._build(trees, K, F, degrade_trees)
-            if meta:
-                mv.meta.update(meta)
-            n_warm = self._warm(mv, max_batch_rows)
-            if probe_rows > 0:
-                self._probe_check(mv, trees, K, F, probe_rows)
-        except Exception as e:
-            if self._metrics is not None:
-                self._metrics.on_publish_reject()
-            from ..obs import events as obs_events
-
-            obs_events.publish(
-                "serve.publish_reject",
-                f"{type(e).__name__}: {e}", severity="error",
-                n_trees=len(trees))
-            log_warning(f"serve: publish rejected pre-swap "
-                        f"({type(e).__name__}: {e}); active version "
-                        "keeps serving")
-            raise
-        with self._lock:
-            if self._active is not None:
-                self._history.append(self._active)
-                del self._history[:-self._keep]
-            self._active = mv
-        if self._metrics is not None:
-            self._metrics.on_swap()
-        log_info(f"serve: published {mv.tag} ({mv.n_trees} trees, "
-                 f"{n_warm} warmed executables)")
-        return mv.tag
+        serving untouched.  (Equivalent to :meth:`prepare` +
+        :meth:`commit`.)"""
+        return self.commit(self.prepare(
+            model, degrade_trees=degrade_trees,
+            max_batch_rows=max_batch_rows, meta=meta,
+            probe_rows=probe_rows))
 
     def rollback(self) -> str:
         """Swap back to the previous version (instant: its compiled cache

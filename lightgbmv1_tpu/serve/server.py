@@ -155,9 +155,12 @@ class Server:
     ``publish()``/``rollback()``, bounded queue, one dispatcher thread."""
 
     def __init__(self, model=None, config: Optional[ServeConfig] = None,
-                 registry: Optional[ModelRegistry] = None):
+                 registry: Optional[ModelRegistry] = None,
+                 name: str = ""):
         self.config = config or ServeConfig()
+        self.name = str(name)       # replica identity in a fleet ("" solo)
         self._t_start = time.monotonic()
+        self._last_wedge_unix: Optional[float] = None
         self.metrics = ServeMetrics(window=self.config.metrics_window)
         # always-on SLO burn-rate tracking (serve/slo.py): every
         # completed / shed / timed-out / failed request spends or
@@ -165,7 +168,8 @@ class Server:
         self.slo = SLOTracker(self.config.slo)
         self.registry = registry or ModelRegistry(
             metrics=self.metrics,
-            predictor_kwargs=self.config.predictor_kwargs)
+            predictor_kwargs=self.config.predictor_kwargs,
+            name=self.name)
         self._cond = threading.Condition()
         self._queue: deque = deque()
         self._queue_rows = 0
@@ -276,18 +280,45 @@ class Server:
     def uptime_s(self) -> float:
         return time.monotonic() - self._t_start
 
+    def wedged(self) -> bool:
+        """True while an in-flight device batch has exceeded the
+        watchdog deadline — the dispatcher thread is alive but stuck,
+        the state a router must eject on even though the process
+        answers health checks."""
+        if self.config.watchdog_ms <= 0:
+            return False
+        infl = self._inflight
+        return (infl is not None
+                and (time.monotonic() - infl[0])
+                > self.config.watchdog_ms / 1e3)
+
     def health(self) -> Dict[str, Any]:
         """Liveness the /healthz endpoint reports: a wedged or dead
         dispatcher and an empty registry are NOT healthy, even though
         the process is up.  ``version`` stays the ACTIVE MODEL tag (the
         pre-obs contract every client reads); ``server_version`` is the
-        package build and ``uptime_s`` the replica age."""
+        package build and ``uptime_s`` the replica age.
+
+        The router's ejection decision is observable here (ISSUE 11):
+        ``dispatcher_restarts`` counts watchdog-revived dispatcher
+        threads, ``last_wedge_unix`` stamps the most recent
+        watchdog-declared stall, and ``wedged`` flags a CURRENTLY-stuck
+        in-flight batch — ``ok`` is False while wedged, so a stuck
+        replica falls out of its load balancer before its queue
+        backs up."""
         from .. import __version__
 
         alive = self.dispatcher_alive()
+        wedged = self.wedged()
         tag = self.registry.current_tag()
-        return {"ok": bool(alive and tag is not None), "version": tag,
+        return {"ok": bool(alive and tag is not None and not wedged),
+                "version": tag,
                 "dispatcher_alive": alive, "published": tag is not None,
+                "wedged": wedged,
+                "dispatcher_restarts": self.metrics.value(
+                    "dispatcher_restarts"),
+                "last_wedge_unix": self._last_wedge_unix,
+                "name": self.name,
                 "server_version": __version__,
                 "uptime_s": round(self.uptime_s(), 3)}
 
@@ -467,6 +498,10 @@ class Server:
         walk_t0_ns = trace.now_ns() if trace.enabled() else 0
         self._inflight = (time.monotonic(), live)
         try:
+            # chaos seam: replica_wedge stalls THIS replica's dispatcher
+            # with the batch in flight — the watchdog (and the router's
+            # health checks) see exactly what a stuck device produces
+            faults.fire("replica_wedge", site=self.name or "server")
             out = self._predict_with_retry(bp, X)
         finally:
             self._inflight = None
@@ -539,6 +574,7 @@ class Server:
                             self.slo.record(False, trace_id=req.trace_id)
                             n_failed += 1
                     if n_failed:
+                        self._last_wedge_unix = time.time()
                         self.metrics.on_watchdog(n_failed)
                         obs_events.publish(
                             "serve.watchdog_stall",
@@ -569,10 +605,11 @@ class Server:
                 self._dispatcher.start()
 
 
-def build_server(booster, config) -> Server:
-    """CLI glue: a :class:`Server` from a Booster + the global Config's
-    ``serve_*`` knobs (cli.py task=serve)."""
-    sc = ServeConfig(
+def serve_config_from(config) -> ServeConfig:
+    """Map the global Config's ``serve_*`` knobs onto a
+    :class:`ServeConfig` (shared by the single-server and fleet CLI
+    paths)."""
+    return ServeConfig(
         max_batch_rows=config.serve_max_batch_rows,
         max_batch_delay_ms=config.serve_max_batch_delay_ms,
         queue_depth_rows=config.serve_queue_depth,
@@ -596,6 +633,12 @@ def build_server(booster, config) -> Server:
             "cache_entries": config.predict_cache_entries,
         },
     )
+
+
+def build_server(booster, config) -> Server:
+    """CLI glue: a :class:`Server` from a Booster + the global Config's
+    ``serve_*`` knobs (cli.py task=serve)."""
+    sc = serve_config_from(config)
     server = Server(booster, config=sc)
     log_info(f"serve: model {server.version()} online "
              f"({booster.num_trees()} trees, "

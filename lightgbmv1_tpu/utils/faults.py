@@ -43,6 +43,20 @@ kind                      site / effect
                           before the atomic swap
 ``snapshot``              cli.py — fires after the Nth snapshot/checkpoint
                           write (``kill`` = crash the training process there)
+``peer_dead``             parallel/elastic_worker.py — fires at each iteration
+                          boundary with site ``rank<r>:iter<i>``; ``kill`` is
+                          THE deterministic kill-at-k of an elastic training
+                          worker (survivors detect via lease staleness)
+``rpc_drop``              serve/router.py — per routed attempt, site = replica
+                          name; ``raise`` models the connection to that
+                          replica dropping before dispatch (router retries
+                          elsewhere)
+``rpc_delay``             serve/router.py — same site; ``stall`` models a slow
+                          link (drives hedging deterministically)
+``replica_wedge``         serve/server.py — fires inside the dispatcher with
+                          the batch in flight, site = replica name; ``stall``
+                          wedges ONE replica's device batch (watchdog +
+                          router ejection under test)
 ========================  =====================================================
 """
 

@@ -1,0 +1,157 @@
+"""Shared 2-process jax.distributed spawn harness for the multihost
+tests (test_multihost.py, test_dist_data.py, test_elastic.py).
+
+Three deflake mechanisms live here instead of being copy-pasted:
+
+* **deterministic free-port allocation with collision retry** — the
+  coordinator port comes from ``cluster.find_free_port`` per attempt,
+  and a worker set that dies with a bind/address-in-use signature is
+  respawned on a FRESH port (up to ``attempts`` times) instead of
+  failing the test on a port race;
+* **capability probe** that distinguishes the three environment
+  outcomes: ``"ok"`` (2-process bootstrap AND a real cross-process
+  allgather both work — a later test failure is a REGRESSION),
+  ``"timeout"`` (the sandbox blocks the gRPC coordination service —
+  skip), ``"no-collectives"`` (bootstrap works but this jax build has
+  no CPU cross-process collective implementation — skip, naming the
+  real reason instead of a generic timeout);
+* one spawn/communicate/collect loop with hard timeouts, so a hung
+  worker can never hang the suite.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+from typing import List, Optional, Tuple
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# stderr signatures of a coordinator-port race (another process grabbed
+# the port between find_free_port() and the coordinator's bind) — these
+# respawn on a fresh port instead of failing the test
+_BIND_RACE = ("address already in use", "failed to bind", "errno 98",
+              "bind address")
+
+_PROBE = r"""
+import os, sys
+rank = int(sys.argv[1]); port = sys.argv[2]
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+jax.config.update("jax_platforms", "cpu")
+from lightgbmv1_tpu.parallel.cluster import init_cluster
+init_cluster(coordinator_address=f"127.0.0.1:{port}", num_processes=2,
+             process_id=rank)
+import numpy as np
+from jax.experimental import multihost_utils
+try:
+    out = multihost_utils.process_allgather(np.asarray([rank + 1.0]))
+    assert float(out.sum()) == 3.0, out
+    print("PROBE COLLECTIVES OK")
+except Exception as e:  # noqa: BLE001 — classified by the parent
+    print("PROBE NO COLLECTIVES:", type(e).__name__, str(e)[:300])
+"""
+
+_probe_cache = {}
+
+
+def worker_env() -> dict:
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env.pop("XLA_FLAGS", None)
+    env.pop("JAX_PLATFORMS", None)
+    return env
+
+
+def spawn_workers(script: str, args_per_rank, *, n: int = 2,
+                  timeout: float = 240.0, attempts: int = 3,
+                  env: Optional[dict] = None,
+                  ) -> Tuple[bool, bool, List[str], List[int]]:
+    """Run ``script`` (a file path) once per rank with argv
+    ``[rank, port, *args_per_rank(rank)]``; returns
+    ``(ok, timed_out, outputs, returncodes)``.
+
+    Each attempt allocates a fresh coordinator port; an attempt whose
+    failure output carries a bind-race signature is retried on a new
+    port (the collision-retry contract).  A timeout kills every worker
+    of the attempt and is returned as ``timed_out`` — the caller's
+    probe decides skip vs fail."""
+    from lightgbmv1_tpu.parallel.cluster import find_free_port
+
+    env = env or worker_env()
+    outs: List[str] = []
+    rcs: List[int] = []
+    for attempt in range(max(int(attempts), 1)):
+        port = find_free_port()
+        procs = [subprocess.Popen(
+            [sys.executable, script, str(r), str(port)]
+            + [str(a) for a in args_per_rank(r)],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True) for r in range(n)]
+        outs, rcs, timed_out = [], [], False
+        for p in procs:
+            try:
+                out, _ = p.communicate(timeout=timeout)
+            except subprocess.TimeoutExpired:
+                for q in procs:
+                    q.kill()
+                for q in procs:
+                    q.wait()
+                timed_out = True
+                out = ""
+            outs.append(out)
+            rcs.append(p.returncode if p.returncode is not None else -9)
+        if timed_out:
+            return False, True, outs, rcs
+        if all(rc == 0 for rc in rcs):
+            return True, False, outs, rcs
+        blob = "\n".join(outs).lower()
+        if not any(sig in blob for sig in _BIND_RACE):
+            return False, False, outs, rcs      # a real failure, not a race
+    return False, False, outs, rcs
+
+
+def probe_multihost(tmp_path) -> str:
+    """``"ok"`` | ``"timeout"`` | ``"no-collectives"`` — cached for the
+    session.  ``"ok"`` means a later multihost test failure must FAIL
+    (regression), the other two are environment skips (VERDICT r3
+    item 8, now split by cause)."""
+    if "status" in _probe_cache:
+        return _probe_cache["status"]
+    probe = os.path.join(str(tmp_path), "probe_mh.py")
+    with open(probe, "w") as fh:
+        fh.write(_PROBE)
+    ok, timed_out, outs, _ = spawn_workers(
+        probe, lambda r: [], timeout=90.0)
+    blob = "\n".join(outs)
+    if timed_out:
+        status = "timeout"
+    elif ok and blob.count("PROBE COLLECTIVES OK") == 2:
+        status = "ok"
+    else:
+        status = "no-collectives"
+    _probe_cache["status"] = status
+    return status
+
+
+def skip_or_fail(tmp_path, what: str = "multihost run",
+                 detail: str = "") -> None:
+    """Called when a real multihost test failed/timed out: fail when the
+    probe says the environment supports it, skip (naming the cause)
+    otherwise."""
+    import pytest
+
+    status = probe_multihost(tmp_path)
+    if status == "ok":
+        pytest.fail(
+            f"2-process jax.distributed works in this sandbox (probe "
+            f"bootstrap + allgather succeeded) but the {what} failed — "
+            "a real multihost regression, not an environment skip"
+            + (f"\n--- worker output ---\n{detail}" if detail else ""))
+    if status == "timeout":
+        pytest.skip("jax.distributed coordination blocked in this "
+                    "sandbox (probe also timed out)")
+    pytest.skip("this jax build has no CPU cross-process collectives "
+                "(probe bootstrap OK, allgather unimplemented)")

@@ -95,3 +95,46 @@ def test_h2d_transient_retried():
     assert out["forensics_ok"] and out["bundles"] == 0, out
     assert out["fault_events"] >= 1, out
     assert out["ok"]
+
+
+# ---------------------------------------------------------------------------
+# fleet scenarios (ISSUE 11)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_trainer_worker_kill_two_process(tmp_path):
+    """Elastic training recovery on a REAL 2-process jax.distributed
+    run (degrades to world=1 where the jax build lacks CPU cross-
+    process collectives): kill-at-k, lease detection, re-bootstrap,
+    BYTE-IDENTICAL final model, exactly one forensic bundle, merged
+    trace."""
+    out = chaos.scenario_trainer_worker_kill(str(tmp_path),
+                                             two_process=True)
+    assert out["bit_identical"], out
+    assert out["bundles"] == 1, out
+    assert out["ok"], out
+
+
+@pytest.mark.slow
+def test_replica_kill_zero_client_errors():
+    out = chaos.scenario_replica_kill()
+    assert out["errors"] == 0 and out["timeouts"] == 0, out
+    assert out["ejected"] and out["bundles"] == 0, out
+    assert out["ok"], out
+
+
+@pytest.mark.slow
+def test_wedged_replica_ejected_and_readmitted():
+    out = chaos.scenario_wedged_replica()
+    assert out["errors"] == 0 and out["ejected_during_wedge"], out
+    assert out["bundles"] == 1, out
+    assert out["ok"], out
+
+
+def test_partial_publish_rolls_whole_fleet_back():
+    out = chaos.scenario_partial_publish_rollback()
+    assert out["aborted"] and out["still_v1"], out
+    assert out["per_replica_exact"] and out["tags_aligned"], out
+    assert out["forensics_ok"] and out["bundles"] == 0, out
+    assert out["ok"], out

@@ -3,15 +3,14 @@
 reference: DatasetLoader::LoadFromFile(fname, rank, num_machines)
 (dataset_loader.cpp:167) and the distributed bin-mapper construction with
 mapper Allgather (dataset_loader.cpp:913-996).
+
+Spawn/retry/probe mechanics come from tests/mh_harness.py (free-port
+collision retry + the ok/timeout/no-collectives capability probe).
 """
 
-import os
-import socket
-import subprocess
-import sys
-
 import numpy as np
-import pytest
+
+from mh_harness import skip_or_fail, spawn_workers
 
 from lightgbmv1_tpu.parallel.dist_data import shard_rows
 
@@ -61,32 +60,13 @@ def test_distributed_bins_agree_across_processes(tmp_path):
     data = tmp_path / "train.tsv"
     np.savetxt(data, np.column_stack([y, X]), fmt="%.7g", delimiter="\t")
 
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        port = s.getsockname()[1]
     worker = tmp_path / "worker.py"
     worker.write_text(_WORKER)
-    env = dict(os.environ)
-    env["PYTHONPATH"] = os.path.dirname(os.path.dirname(
-        os.path.abspath(__file__))) + os.pathsep + env.get("PYTHONPATH", "")
-    env.pop("XLA_FLAGS", None)
-    env.pop("JAX_PLATFORMS", None)
-    procs = [subprocess.Popen(
-        [sys.executable, str(worker), str(r), str(port), str(tmp_path),
-         str(data)],
-        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
-        for r in range(2)]
-    outs = []
-    for p in procs:
-        try:
-            out, _ = p.communicate(timeout=240)
-        except subprocess.TimeoutExpired:
-            for q in procs:
-                q.kill()
-            pytest.skip("jax.distributed coordination timed out")
-        outs.append(out)
-    for r, (p, out) in enumerate(zip(procs, outs)):
-        assert p.returncode == 0, f"rank {r} failed:\n{out[-3000:]}"
+    ok, _, outs, _ = spawn_workers(
+        str(worker), lambda r: [str(tmp_path), str(data)])
+    if not ok:
+        skip_or_fail(tmp_path, "distributed bin-finding run",
+                     detail="\n".join(o[-3000:] for o in outs))
 
     a = np.load(tmp_path / "rank0.npz")
     b = np.load(tmp_path / "rank1.npz")

@@ -1,0 +1,374 @@
+"""Fault-tolerant serving fleet tests (ISSUE 11): two-phase coordinated
+publish (serve/fleet.py), self-healing router (serve/router.py —
+health-check ejection/readmission, retry-onto-another-replica,
+hedging), and the /healthz observability the ejection decision reads.
+
+The retry/hedging edge cases the issue names are pinned here:
+
+* hedged request races — both replicas answer, the first wins, the
+  loser's work is discarded WITHOUT double-counting router metrics/SLO;
+* retry against a replica that dies BETWEEN health check and dispatch;
+* deadline exhaustion mid-hedge returns 504 (RequestTimeout), not 500.
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import lightgbmv1_tpu as lgb
+from lightgbmv1_tpu.serve import (Fleet, FleetPublishError, Router,
+                                  RouterConfig, RequestTimeout,
+                                  ServeConfig, ServeHTTP, Server)
+from lightgbmv1_tpu.utils import faults
+from lightgbmv1_tpu.utils.faults import FaultSpec
+
+
+@pytest.fixture(scope="module")
+def boosters():
+    rng = np.random.RandomState(1)
+    X = rng.randn(1000, 6)
+    y = (1.2 * X[:, 0] - X[:, 1] + rng.randn(1000) * 0.3 > 0).astype(float)
+    P = {"objective": "binary", "num_leaves": 15, "min_data_in_leaf": 5,
+         "verbosity": -1}
+    b1 = lgb.train(P, lgb.Dataset(X, label=y), num_boost_round=3,
+                   verbose_eval=False)
+    b2 = lgb.train(P, lgb.Dataset(X, label=y), num_boost_round=6,
+                   verbose_eval=False)
+    return b1, b2, X
+
+
+def _cfg(**over):
+    kw = dict(max_batch_rows=64, max_batch_delay_ms=1.0, f64_scores=True,
+              predictor_kwargs={"bucket_min": 64})
+    kw.update(over)
+    return ServeConfig(**kw)
+
+
+def _host(b, X):
+    return np.asarray(b.predict(X, raw_score=True,
+                                predict_method="host"), np.float64)
+
+
+# ---------------------------------------------------------------------------
+# two-phase fleet publish
+# ---------------------------------------------------------------------------
+
+
+def test_fleet_two_phase_publish_abort_rolls_nobody(boosters):
+    """One replica's warm failure aborts the WHOLE publish: no replica
+    swaps, every replica keeps serving the prior version bit-exactly,
+    version tags stay aligned, and a later clean publish lands one tag
+    fleet-wide."""
+    b1, b2, X = boosters
+    want1 = _host(b1, X[:8])
+    with Fleet(b1, n_replicas=3, config=_cfg()) as fleet:
+        assert fleet.version() == "v1"
+        with faults.inject(FaultSpec("publish_warm", mode="raise",
+                                     match="r1:")):
+            with pytest.raises(FleetPublishError) as ei:
+                fleet.publish(b2)
+        assert "r1" in ei.value.causes
+        assert fleet.version() == "v1"
+        for r in fleet.replicas:
+            res = r.submit(X[:8])
+            assert res.version == "v1"
+            assert np.array_equal(res.values[:, 0], want1)
+        tag = fleet.publish(b2)               # clean publish recovers
+        assert fleet.version() == tag
+        want2 = _host(b2, X[:8])
+        for r in fleet.replicas:
+            assert np.array_equal(r.submit(X[:8]).values[:, 0], want2)
+
+
+def test_fleet_rollback_is_fleet_wide(boosters):
+    b1, b2, X = boosters
+    with Fleet(b1, n_replicas=2, config=_cfg()) as fleet:
+        fleet.publish(b2)
+        assert fleet.version() == "v2"
+        fleet.rollback()
+        assert fleet.version() == "v1"
+        want1 = _host(b1, X[:4])
+        for r in fleet.replicas:
+            assert np.array_equal(r.submit(X[:4]).values[:, 0], want1)
+
+
+# ---------------------------------------------------------------------------
+# router: retry / hedging / deadline edge cases
+# ---------------------------------------------------------------------------
+
+
+def test_retry_replica_dies_between_health_check_and_dispatch(boosters):
+    """The replica is healthy at the last health check, then closes
+    before the request dispatches: the router must retry transparently
+    onto another replica (zero client-visible errors) and stop offering
+    the dead replica traffic immediately."""
+    b1, _, X = boosters
+    want = _host(b1, X[:4])
+    with Fleet(b1, n_replicas=2, config=_cfg()) as fleet:
+        # health period long enough that the poller CANNOT observe the
+        # death before the request does
+        with Router(fleet, RouterConfig(health_period_ms=5000.0,
+                                        retry_max=2)) as router:
+            # round-robin starts at r0 — kill exactly the replica the
+            # next request will pick
+            fleet.replica("r0").close()
+            res = router.submit(X[:4])
+            assert np.array_equal(res.values[:, 0], want)
+            snap = router.metrics_snapshot()
+            assert snap["retries"] >= 1
+            assert snap["errors"] == 0
+            assert snap["router"]["replicas"]["r0"]["healthy"] is False
+            assert snap["router"]["replicas"]["r0"]["ejections"] == 1
+
+
+def test_hedged_race_first_wins_no_double_count(boosters):
+    """Both the delayed primary AND the hedge answer; the first
+    completion wins and the loser is discarded: the router records
+    EXACTLY one completion (metrics and SLO), and the win is attributed
+    to the hedge."""
+    b1, _, X = boosters
+    want = _host(b1, X[:4])
+    stall_s = 0.4
+    with Fleet(b1, n_replicas=2, config=_cfg()) as fleet:
+        with Router(fleet, RouterConfig(health_period_ms=5000.0,
+                                        hedge_ms=30.0)) as router:
+            router.submit(X[:4])              # warm both buckets
+            base = router.metrics_snapshot()
+            with faults.inject(FaultSpec("rpc_delay", mode="stall",
+                                         at=1, stall_s=stall_s)):
+                t0 = time.monotonic()
+                res = router.submit(X[:4])
+                dt = time.monotonic() - t0
+            assert np.array_equal(res.values[:, 0], want)
+            assert dt < stall_s               # the hedge answered first
+            snap = router.metrics_snapshot()
+            assert snap["router"]["hedges"] \
+                == base["router"]["hedges"] + 1
+            assert snap["router"]["hedge_wins"] \
+                == base["router"]["hedge_wins"] + 1
+            assert snap["completed"] == base["completed"] + 1
+            # the loser drains later; its completion must change nothing
+            time.sleep(stall_s + 0.2)
+            snap2 = router.metrics_snapshot()
+            assert snap2["completed"] == snap["completed"]
+            assert snap2["errors"] == 0 and snap2["timeouts"] == 0
+            # SLO totals advanced by exactly the completions seen —
+            # the hedged loser spent no availability budget
+            fast = router.slo.snapshot()["availability"]["windows"]["fast"]
+            assert fast["total"] == snap2["completed"]
+            assert fast["errors"] == 0
+
+
+def test_deadline_exhaustion_mid_hedge_is_504_not_500(boosters):
+    """Every attempt is stalled past the request deadline: the router
+    raises RequestTimeout — and over HTTP the client sees 504, never a
+    500 — even while hedge attempts are still in flight."""
+    b1, _, X = boosters
+    with Fleet(b1, n_replicas=2, config=_cfg()) as fleet:
+        with Router(fleet, RouterConfig(health_period_ms=5000.0,
+                                        hedge_ms=25.0,
+                                        deadline_ms=150.0)) as router:
+            router.submit(X[:4])
+            with faults.inject(FaultSpec("rpc_delay", mode="stall",
+                                         at=1, count=2, stall_s=1.0)):
+                t0 = time.monotonic()
+                with pytest.raises(RequestTimeout):
+                    router.submit(X[:4])
+                assert time.monotonic() - t0 < 0.9
+            assert router.metrics_snapshot()["timeouts"] >= 1
+
+            http = ServeHTTP(router).start()
+            try:
+                with faults.inject(FaultSpec("rpc_delay", mode="stall",
+                                             at=1, count=2,
+                                             stall_s=1.0)):
+                    req = urllib.request.Request(
+                        f"http://127.0.0.1:{http.port}/predict",
+                        data=json.dumps(
+                            {"rows": X[:2].tolist()}).encode(),
+                        headers={"Content-Type": "application/json"})
+                    with pytest.raises(urllib.error.HTTPError) as ei:
+                        urllib.request.urlopen(req, timeout=10)
+                    assert ei.value.code == 504, ei.value.code
+                    body = json.loads(ei.value.read())
+                    assert body.get("timeout") is True
+            finally:
+                http.shutdown()
+
+
+def test_router_health_ejection_and_readmission(boosters):
+    """A wedged replica (watchdog-overdue in-flight batch) is ejected by
+    the health poller and readmitted once the stall drains."""
+    b1, _, X = boosters
+    with Fleet(b1, n_replicas=2,
+               config=_cfg(watchdog_ms=80.0)) as fleet:
+        with Router(fleet, RouterConfig(health_period_ms=10.0,
+                                        eject_after=2, readmit_after=2,
+                                        retry_max=2)) as router:
+            router.submit(X[:4])
+            with faults.inject(FaultSpec("replica_wedge", mode="stall",
+                                         at=1, stall_s=0.5,
+                                         match="r0")):
+                errors = 0
+                t0 = time.monotonic()
+                while time.monotonic() - t0 < 0.6:
+                    try:
+                        router.submit(X[:4])
+                    except Exception:   # noqa: BLE001
+                        errors += 1
+                    time.sleep(0.03)
+            assert errors == 0
+            states = router.replica_states()
+            assert states["r0"]["ejections"] >= 1
+            deadline = time.monotonic() + 3.0
+            while time.monotonic() < deadline and \
+                    not router.replica_states()["r0"]["healthy"]:
+                time.sleep(0.05)
+            assert router.replica_states()["r0"]["healthy"]
+            assert router.replica_states()["r0"]["readmissions"] >= 1
+
+
+# ---------------------------------------------------------------------------
+# /healthz observability (satellite: ejection decision is observable)
+# ---------------------------------------------------------------------------
+
+
+def test_healthz_surfaces_restarts_and_wedge_timestamp(boosters):
+    """Per-replica /healthz carries the router's ejection evidence:
+    dispatcher restart count and the last watchdog-declared wedge
+    timestamp."""
+    b1, _, X = boosters
+    srv = Server(b1, config=_cfg(watchdog_ms=80.0), name="r9")
+    try:
+        srv.submit(X[:4])
+        h0 = srv.health()
+        assert h0["dispatcher_restarts"] == 0
+        assert h0["last_wedge_unix"] is None
+        assert h0["wedged"] is False and h0["name"] == "r9"
+
+        t_before = time.time()
+        with faults.inject(FaultSpec("replica_wedge", mode="stall",
+                                     at=1, stall_s=0.4)):
+            try:
+                srv.submit(X[:4])
+            except Exception:   # noqa: BLE001 — watchdog may 503 it
+                pass
+        time.sleep(0.1)
+        h1 = srv.health()
+        assert h1["last_wedge_unix"] is not None
+        assert h1["last_wedge_unix"] >= t_before
+
+        with faults.inject(FaultSpec("dispatch", mode="exit_thread",
+                                     at=1)):
+            try:
+                srv.submit(X[:4])
+            except Exception:   # noqa: BLE001
+                pass
+        deadline = time.monotonic() + 3.0
+        while time.monotonic() < deadline and \
+                srv.health()["dispatcher_restarts"] < 1:
+            time.sleep(0.05)
+        assert srv.health()["dispatcher_restarts"] >= 1
+    finally:
+        srv.close()
+
+
+def test_breaker_watchdog_events_reach_fleet_merged_log(boosters,
+                                                        tmp_path):
+    """The watchdog-stall and dispatcher-restart events published by a
+    replica flow into the FLEET-merged event log (obs/agg.py): export
+    the process artifacts after the faults and assert the merged
+    events carry both kinds."""
+    from lightgbmv1_tpu.obs import agg as obs_agg
+
+    b1, _, X = boosters
+    srv = Server(b1, config=_cfg(watchdog_ms=80.0), name="rA")
+    try:
+        srv.submit(X[:4])
+        with faults.inject(FaultSpec("replica_wedge", mode="stall",
+                                     at=1, stall_s=0.4)):
+            try:
+                srv.submit(X[:4])
+            except Exception:   # noqa: BLE001
+                pass
+        with faults.inject(FaultSpec("dispatch", mode="exit_thread",
+                                     at=1)):
+            try:
+                srv.submit(X[:4])
+            except Exception:   # noqa: BLE001
+                pass
+        deadline = time.monotonic() + 3.0
+        while time.monotonic() < deadline and \
+                srv.health()["dispatcher_restarts"] < 1:
+            time.sleep(0.05)
+    finally:
+        srv.close()
+    obs_agg.export_process_artifacts(str(tmp_path), label="replica-rA",
+                                     registry=srv.metrics.registry)
+    obs_agg.aggregate_dir(str(tmp_path))
+    with open(tmp_path / "merged.metrics.json") as fh:
+        merged = json.load(fh)
+    kinds = {e.get("kind") for e in merged.get("events", [])}
+    assert "serve.watchdog_stall" in kinds
+    assert "serve.dispatcher_restart" in kinds
+
+
+def test_router_http_front_end_serves_fleet(boosters):
+    """ServeHTTP duck-types over the Router: /predict, /metrics,
+    /healthz and /slo all answer with fleet-level payloads."""
+    b1, _, X = boosters
+    want = _host(b1, X[:3])
+    with Fleet(b1, n_replicas=2, config=_cfg()) as fleet:
+        with Router(fleet, RouterConfig(health_period_ms=20.0)) as router:
+            http = ServeHTTP(router).start()
+            try:
+                u = f"http://127.0.0.1:{http.port}"
+                req = urllib.request.Request(
+                    u + "/predict",
+                    data=json.dumps({"rows": X[:3].tolist()}).encode())
+                out = json.loads(urllib.request.urlopen(req).read())
+                assert out["version"] == "v1"
+                assert np.array_equal(
+                    np.asarray(out["values"])[:, 0], want)
+                health = json.loads(
+                    urllib.request.urlopen(u + "/healthz").read())
+                assert health["ok"] is True
+                assert set(health["healthy_replicas"]) == {"r0", "r1"}
+                assert health["replicas"]["r0"]["version"] == "v1"
+                m = json.loads(
+                    urllib.request.urlopen(u + "/metrics").read())
+                assert m["completed"] >= 1
+                assert "router" in m
+                slo = json.loads(
+                    urllib.request.urlopen(u + "/slo").read())
+                assert slo["version"] == "v1"
+            finally:
+                http.shutdown()
+
+
+def test_overload_on_all_replicas_surfaces_as_shed(boosters):
+    """When EVERY replica sheds, the router raises ServerOverloaded —
+    overload stays visible as overload, not a generic error."""
+    from lightgbmv1_tpu.serve import ServerOverloaded
+
+    b1, _, X = boosters
+    cfg = _cfg(max_batch_rows=8, queue_depth_rows=8,
+               max_batch_delay_ms=50.0)
+    with Fleet(b1, n_replicas=2, config=cfg) as fleet:
+        with Router(fleet, RouterConfig(health_period_ms=5000.0,
+                                        retry_max=2)) as router:
+            # saturate both queues with slow-collecting batches, then
+            # one oversized submit must shed everywhere
+            for _ in range(2):
+                threading.Thread(
+                    target=lambda: router.submit(X[:8]),
+                    daemon=True).start()
+            time.sleep(0.05)
+            with pytest.raises(ServerOverloaded):
+                router.submit(X[:9])
+            assert router.metrics_snapshot()["shed"] >= 1

@@ -8,73 +8,16 @@ mesh and must produce the same model as a single-process serial run.
 
 The reference never CI-tests multi-machine training (SURVEY §4: the socket
 path is exercised only by a manual 2-machine example); this test does.
-"""
 
-import os
-import socket
-import subprocess
-import sys
+Spawn/retry/probe mechanics live in tests/mh_harness.py: ports are
+allocated per attempt with collision retry, and a failure only SKIPS when
+the capability probe shows the sandbox blocks gRPC or the jax build lacks
+CPU cross-process collectives — otherwise it is a regression and fails.
+"""
 
 import numpy as np
-import pytest
 
-_PROBE = r"""
-import os, sys
-rank = int(sys.argv[1]); port = sys.argv[2]
-os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
-os.environ["JAX_PLATFORMS"] = "cpu"
-import jax
-jax.config.update("jax_platforms", "cpu")
-jax.distributed.initialize(coordinator_address=f"127.0.0.1:{port}",
-                           num_processes=2, process_id=rank)
-print("PROBE OK")
-"""
-
-_grpc_ok_cache = {}
-
-
-def _grpc_coordination_works(tmp_path) -> bool:
-    """One cheap 2-process jax.distributed bootstrap.  If THIS succeeds but
-    the real test later times out, the timeout is a regression and must
-    FAIL; only a genuinely blocked sandbox (probe also times out) skips
-    (VERDICT r3 item 8)."""
-    if "ok" in _grpc_ok_cache:
-        return _grpc_ok_cache["ok"]
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        port = s.getsockname()[1]
-    probe = tmp_path / "probe.py"
-    probe.write_text(_PROBE)
-    env = dict(os.environ)
-    env["PYTHONPATH"] = os.path.dirname(os.path.dirname(
-        os.path.abspath(__file__))) + os.pathsep + env.get("PYTHONPATH", "")
-    env.pop("XLA_FLAGS", None)
-    env.pop("JAX_PLATFORMS", None)
-    procs = [subprocess.Popen(
-        [sys.executable, str(probe), str(r), str(port)], env=env,
-        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
-        for r in range(2)]
-    ok = True
-    for p in procs:
-        try:
-            out, _ = p.communicate(timeout=90)
-            ok = ok and p.returncode == 0 and "PROBE OK" in out
-        except subprocess.TimeoutExpired:
-            for q in procs:
-                q.kill()
-            ok = False
-    _grpc_ok_cache["ok"] = ok
-    return ok
-
-
-def _skip_or_fail_timeout(tmp_path):
-    if _grpc_coordination_works(tmp_path):
-        pytest.fail("jax.distributed coordination works in this sandbox "
-                    "(probe succeeded) but the training run timed out — "
-                    "a real multihost regression, not an environment skip")
-    pytest.skip("jax.distributed coordination blocked in this sandbox "
-                "(probe also timed out)")
-
+from mh_harness import skip_or_fail, spawn_workers
 
 _WORKER = r"""
 import os, sys
@@ -108,31 +51,13 @@ print("RANK", rank, "DONE")
 
 
 def test_two_process_data_parallel(tmp_path):
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        port = s.getsockname()[1]
     worker = tmp_path / "worker.py"
     worker.write_text(_WORKER)
-    env = dict(os.environ)
-    env["PYTHONPATH"] = os.path.dirname(os.path.dirname(
-        os.path.abspath(__file__))) + os.pathsep + env.get("PYTHONPATH", "")
-    env.pop("XLA_FLAGS", None)
-    env.pop("JAX_PLATFORMS", None)
-    procs = [subprocess.Popen(
-        [sys.executable, str(worker), str(r), str(port), str(tmp_path)],
-        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
-        for r in range(2)]
-    outs = []
-    for p in procs:
-        try:
-            out, _ = p.communicate(timeout=240)
-        except subprocess.TimeoutExpired:
-            for q in procs:
-                q.kill()
-            _skip_or_fail_timeout(tmp_path)
-        outs.append(out)
-    for r, (p, out) in enumerate(zip(procs, outs)):
-        assert p.returncode == 0, f"rank {r} failed:\n{out[-3000:]}"
+    ok, _, outs, _ = spawn_workers(
+        str(worker), lambda r: [str(tmp_path)])
+    if not ok:
+        skip_or_fail(tmp_path, "data-parallel training run",
+                     detail="\n".join(o[-3000:] for o in outs))
     s0 = np.load(tmp_path / "scores_rank0.npy")
     s1 = np.load(tmp_path / "scores_rank1.npy")
     # both processes computed the same (replicated) model state
@@ -207,31 +132,13 @@ def test_two_process_sharded_storage(tmp_path):
     """Process-local shards -> global sharded training (VERDICT r2 #2):
     per-process host memory is O(N/world) for the binned matrix, and the
     model must match replicated-storage training on the same data."""
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        port = s.getsockname()[1]
     worker = tmp_path / "worker_sharded.py"
     worker.write_text(_WORKER_SHARDED)
-    env = dict(os.environ)
-    env["PYTHONPATH"] = os.path.dirname(os.path.dirname(
-        os.path.abspath(__file__))) + os.pathsep + env.get("PYTHONPATH", "")
-    env.pop("XLA_FLAGS", None)
-    env.pop("JAX_PLATFORMS", None)
-    procs = [subprocess.Popen(
-        [sys.executable, str(worker), str(r), str(port), str(tmp_path)],
-        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
-        for r in range(2)]
-    outs = []
-    for p in procs:
-        try:
-            out, _ = p.communicate(timeout=240)
-        except subprocess.TimeoutExpired:
-            for q in procs:
-                q.kill()
-            _skip_or_fail_timeout(tmp_path)
-        outs.append(out)
-    for r, (p, out) in enumerate(zip(procs, outs)):
-        assert p.returncode == 0, f"rank {r} failed:\n{out[-3000:]}"
+    ok, _, outs, _ = spawn_workers(
+        str(worker), lambda r: [str(tmp_path)])
+    if not ok:
+        skip_or_fail(tmp_path, "sharded-storage training run",
+                     detail="\n".join(o[-3000:] for o in outs))
     s0 = np.load(tmp_path / "sharded_scores_rank0.npy")
     s1 = np.load(tmp_path / "sharded_scores_rank1.npy")
     np.testing.assert_allclose(s0, s1, rtol=1e-6, atol=1e-7)

@@ -115,6 +115,10 @@ def test_data_parallel_with_bagging_and_weights():
     )
 
 
+@pytest.mark.slow    # tier-1 budget (ISSUE 11): dryrun_multichip asserts
+# data-learner exact parity per driver capture; multiclass wave parity is
+# separately pinned (test_wave1_multiclass, full suite) — this full
+# multiclass data-parallel run stays in the full suite
 def test_data_parallel_multiclass():
     rng = np.random.RandomState(0)
     X = rng.randn(900, 5)
@@ -147,6 +151,9 @@ def test_num_shards_subset():
     )
 
 
+@pytest.mark.slow    # tier-1 budget (ISSUE 11): voting-parallel exact
+# parity is asserted by dryrun_multichip per driver capture (incl. the
+# int8sr variant, re-marked in PR 9 with the same cover); full suite only
 def test_voting_matches_data_parallel_with_full_top_k():
     """PV-Tree voting with top_k >= F reduces every feature => must equal
     the data-parallel learner exactly (reference: GlobalVoting selects all
@@ -225,6 +232,9 @@ def test_feature_parallel_levelwise_matches_serial():
         np.testing.assert_allclose(s[3], p[3], rtol=1e-3, atol=1e-5)
 
 
+@pytest.mark.slow    # tier-1 budget (ISSUE 11): the fallback's parity
+# cover = dryrun voting parity per capture + the levelwise rs/feature
+# parity pins (full suite, re-marked in PR 7); full suite only
 def test_voting_levelwise_falls_back_to_data():
     X, y = make_binary_problem(600, f=5)
     par = _train({"objective": "binary", "tree_learner": "voting",

@@ -322,6 +322,38 @@ def test_forensics_slo_section_renders_fields():
     assert "No forensics/SLO fields" in "\n".join(lines)
 
 
+def test_fleet_section_renders_fields():
+    """The Fleet section (ISSUE 11) is generated from the BENCH fleet_*
+    / router_* fields (bench.py measure_fleet): the loadgen-under-kill
+    row, the hedge rate, the recovery clock and every sub-guard grep to
+    record fields."""
+    import perf_report
+
+    rec = {
+        "fleet_ok": True, "fleet_requests": 625, "fleet_qps": 247.1,
+        "fleet_p99_ms": 18.44, "router_hedge_frac": 0.0163,
+        "fleet_router_retries": 3, "fleet_recovery_s": 5.21,
+        "fleet_elastic_world": 2, "fleet_zero_error_ok": True,
+        "fleet_replica_ejected_ok": True, "fleet_publish_ok": True,
+        "fleet_kill_resume_ok": True, "chaos_fleet_ok": True,
+    }
+    lines = []
+    perf_report.fleet_section(lines.append, rec)
+    txt = "\n".join(lines)
+    assert "## Fleet" in txt
+    for needle in ("625", "247.1", "18.44", "0.0163", "5.21",
+                   "fleet_ok=True", "fleet_zero_error_ok=True",
+                   "fleet_replica_ejected_ok=True",
+                   "fleet_publish_ok=True", "fleet_kill_resume_ok=True",
+                   "chaos_fleet_ok=True", "`serve_replicas`",
+                   "BYTE-IDENTICAL"):
+        assert needle in txt, needle
+    # a record with no fleet capture renders the placeholder
+    lines = []
+    perf_report.fleet_section(lines.append, {})
+    assert "No fleet fields" in "\n".join(lines)
+
+
 def test_trend_section_renders_sentinel_rows(tmp_path):
     """The Trend section is rendered BY the sentinel (bench_trend.run),
     so PERF.md's table and the gate's verdict cannot disagree."""

@@ -78,6 +78,11 @@ WATCHED: Tuple[Tuple[str, str, float], ...] = (
     # chaos_forensics_ok are booleans — the guard sweep below flags any
     # False automatically
     ("slo_availability", "up", 0.005),
+    # fault-tolerant fleet (ISSUE 11): the elastic re-bootstrap clock is
+    # lease-timeout-dominated, so the bar is loose; fleet_ok /
+    # chaos_fleet_ok / the *_ok sub-guards are booleans the guard sweep
+    # flags automatically
+    ("fleet_recovery_s", "down", 0.50),
 )
 
 _PARITY_RE = re.compile(r"dryrun_multichip PARITY (\{.*\})")

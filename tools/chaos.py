@@ -155,6 +155,8 @@ def _check_bundles(crash_dir: str, expect: int,
         for b in bundles:
             manifest = dump.validate_bundle(b)
             out["bundle_reason"] = manifest["reason"]
+            out["bundle_error_text"] = str(
+                manifest.get("error", ""))[:300]
         ok = (not reasons or out.get("bundle_reason") in reasons)
     except Exception as e:  # noqa: BLE001 — an invalid bundle FAILS
         out["bundle_error"] = f"{type(e).__name__}: {e}"[:200]
@@ -572,6 +574,330 @@ def scenario_h2d_transient() -> dict:
 
 
 # ---------------------------------------------------------------------------
+# fleet scenarios (ISSUE 11) — elastic training recovery + self-healing
+# replicated serving.  Forensics contract per scenario: kill/wedge-grade
+# events leave EXACTLY ONE validated bundle, faults recovered at the
+# fleet layer leave ZERO, and every scenario's per-process obs artifacts
+# merge into ONE obs/agg.py trace.
+# ---------------------------------------------------------------------------
+
+
+def _fleet_cfg(**over):
+    from lightgbmv1_tpu.serve import ServeConfig
+
+    kw = dict(max_batch_rows=64, max_batch_delay_ms=1.0,
+              queue_depth_rows=4096, f64_scores=True,
+              retry_max=1, retry_backoff_ms=2.0, breaker_failures=0,
+              watchdog_ms=150.0, predictor_kwargs={"bucket_min": 64})
+    kw.update(over)
+    return ServeConfig(**kw)
+
+
+def scenario_trainer_worker_kill(tmp: str, two_process: bool) -> dict:
+    """Elastic training recovery: a worker of a (2-process jax.distributed
+    when supported) elastic run is KILLED at iteration 3 via the
+    ``peer_dead`` seam; survivors detect the stale lease within the
+    bounded window and exit for re-bootstrap; the coordinator respawns
+    the fleet from the newest checkpoint bundle; the recovered final
+    model text is BYTE-IDENTICAL to an uninterrupted run.  Forensics:
+    exactly ONE bundle (the killed worker's ``fault_kill``), and every
+    worker generation's obs artifacts merge into one trace."""
+    import numpy as np
+
+    from lightgbmv1_tpu.obs import agg as obs_agg
+    from lightgbmv1_tpu.parallel.cluster import cpu_multiprocess_supported
+    from lightgbmv1_tpu.parallel.elastic import (ElasticConfig,
+                                                 ElasticCoordinator)
+
+    world = 2 if (two_process and cpu_multiprocess_supported()) else 1
+    rng = np.random.RandomState(0)
+    X = rng.randn(1600, 5)
+    y = (X[:, 0] - X[:, 1] > 0).astype(float)
+    data = os.path.join(tmp, "train.tsv")
+    np.savetxt(data, np.column_stack([y, X]), fmt="%.7g", delimiter="\t")
+    cfg = ElasticConfig(world=world, devices_per_proc=2,
+                        lease_timeout_s=2.0, max_restarts=1)
+    base_env = {k: v for k, v in os.environ.items()
+                if k not in ("LGBMV1_CRASH_DIR", "LGBMV1_OBS_DIR",
+                             "LGBMV1_FAULTS")}
+
+    def run_one(name, fault_env=None, crash=None, obsd=None):
+        workdir = os.path.join(tmp, name)
+        env = dict(base_env)
+        if crash:
+            env["LGBMV1_CRASH_DIR"] = crash
+        if obsd:
+            env["LGBMV1_OBS_DIR"] = obsd
+        coord = ElasticCoordinator(
+            workdir,
+            worker_args={"data": data,
+                         "model_out": os.path.join(workdir, "model.txt"),
+                         "iterations": 6, "snapshot_freq": 2},
+            config=cfg, fault_env=fault_env, env=env)
+        res = coord.run()
+        model = os.path.join(workdir, "model.txt")
+        text = open(model).read() if os.path.exists(model) else None
+        return res, text
+
+    res_a, straight = run_one("straight")
+    kill_rank = world - 1
+    crash = os.path.join(tmp, "crash")
+    obsd = os.path.join(tmp, "obs")
+    plan = [{"kind": "peer_dead", "mode": "kill",
+             "match": f"rank{kill_rank}:iter3"}]
+    res_b, resumed = run_one(
+        "killed", fault_env={"LGBMV1_FAULTS": json.dumps(plan)},
+        crash=crash, obsd=obsd)
+    forensics = _check_bundles(crash, expect=1, reasons=("fault_kill",))
+    agg_ok = False
+    try:
+        summ = obs_agg.aggregate_dir(obsd)
+        # every completed worker exported an artifact; the killed one's
+        # evidence is its crash bundle.  world lanes minimum: each
+        # surviving/respawned rank traces its iterations.
+        agg_ok = (len(summ["sources"]) >= world
+                  and summ["lanes"] >= world)
+    except Exception as e:  # noqa: BLE001
+        forensics["agg_error"] = f"{type(e).__name__}: {e}"[:200]
+    forensics["forensics_ok"] = bool(forensics["forensics_ok"] and agg_ok)
+    bit_identical = (straight is not None and resumed is not None
+                     and straight == resumed)
+    detected = (world == 1 or res_b.peer_lost_exits >= 1)
+    ok = (res_a.ok and res_b.ok and res_b.restarts == 1 and detected
+          and bit_identical and forensics["forensics_ok"])
+    return {"ok": ok, "world": world, "restarts": res_b.restarts,
+            "peer_lost_exits": res_b.peer_lost_exits,
+            "recovery_s": res_b.recovery_s,
+            "bit_identical": bit_identical, "agg_ok": agg_ok,
+            **forensics}
+
+
+def _export_fleet_artifacts(obsd: str, fleet, router) -> None:
+    from lightgbmv1_tpu.obs import agg as obs_agg
+
+    for r in fleet.replicas:
+        obs_agg.export_process_artifacts(
+            obsd, label=f"replica-{r.name}",
+            registry=r.metrics.registry)
+    obs_agg.export_process_artifacts(
+        obsd, label="router", registry=router.metrics.registry)
+
+
+def scenario_replica_kill() -> dict:
+    """A replica killed mid-traffic under open-loop loadgen: the router
+    retries its in-flight/queued failures onto healthy replicas — ZERO
+    client-visible errors (bounded retry latency only), the dead
+    replica is health-check ejected.  Forensics: a fleet-recovered kill
+    writes NO bundle; the ejection is a first-class event; all
+    per-process artifacts merge into one trace."""
+    import numpy as np
+
+    from lightgbmv1_tpu.obs import dump, events
+    from lightgbmv1_tpu.serve import Fleet, Router, RouterConfig
+    from tools.loadgen import run_loadgen
+
+    b1, _, X = _tiny_boosters()
+    want = {}
+
+    def check(start, n_rows, res):
+        key = (start, n_rows)
+        if key not in want:
+            want[key] = _host_raw(b1, X[start:start + n_rows])
+        return np.array_equal(res.values[:, 0], want[key])
+
+    mark = events.seq()
+    crash_dir = tempfile.mkdtemp(prefix="lgbm_chaos_rk_")
+    obsd = tempfile.mkdtemp(prefix="lgbm_chaos_rk_obs_")
+    dump.arm(crash_dir)
+    fleet = Fleet(b1, n_replicas=3, config=_fleet_cfg())
+    router = Router(fleet, RouterConfig(health_period_ms=15.0,
+                                        retry_max=2, hedge_ms=60.0))
+    try:
+        router.submit(X[:4])          # warm every bucket path
+        lg = run_loadgen(
+            router, X[:512], rate_qps=250.0, duration_s=1.6,
+            rows_per_req=4, n_threads=6,
+            swap_at_frac=0.4,
+            swap_fn=lambda: fleet.replica("r1").close(),
+            check_fn=check)
+        deadline = time.monotonic() + 3.0
+        while time.monotonic() < deadline:
+            h = router.health()
+            if "r1" in h["ejected_replicas"]:
+                break
+            time.sleep(0.05)
+        h = router.health()
+        ejected = "r1" in h["ejected_replicas"]
+        zero_errors = (lg["error"] == 0 and lg["timeout"] == 0
+                       and lg["shed"] == 0 and lg["check_failures"] == 0)
+        snap = router.metrics_snapshot()
+        from lightgbmv1_tpu.obs import agg as obs_agg
+
+        _export_fleet_artifacts(obsd, fleet, router)
+        try:
+            summ = obs_agg.aggregate_dir(obsd)
+            agg_ok = len(summ["sources"]) >= 4   # 3 replicas + router
+        except Exception:  # noqa: BLE001
+            agg_ok = False
+        forensics = _check_bundles(crash_dir, expect=0)
+        forensics["eject_events"] = _count_events(
+            mark, "router.replica_ejected")
+        forensics["forensics_ok"] = bool(
+            forensics["forensics_ok"]
+            and forensics["eject_events"] >= 1 and agg_ok)
+        ok = (zero_errors and ejected and lg["ok"] > 0
+              and snap["retries"] >= 1
+              and forensics["forensics_ok"])
+        return {"ok": ok, "served": lg["ok"], "errors": lg["error"],
+                "timeouts": lg["timeout"], "sheds": lg["shed"],
+                "check_failures": lg["check_failures"],
+                "router_retries": snap["retries"],
+                "ejected": ejected, "agg_ok": agg_ok, **forensics}
+    finally:
+        router.close()
+        fleet.close()
+        dump.disarm()
+        shutil.rmtree(crash_dir, ignore_errors=True)
+        shutil.rmtree(obsd, ignore_errors=True)
+
+
+def scenario_wedged_replica() -> dict:
+    """One replica's device batch wedges (``replica_wedge`` stall): its
+    watchdog fails the stuck requests fast, the router retries them
+    onto healthy replicas (zero client-visible errors), the health
+    poller EJECTS the wedged replica (``wedged`` rides /healthz) and
+    READMITS it once the stall drains.  Forensics: a wedge is
+    crash-grade — exactly ONE bundle, reason watchdog_stall."""
+    import numpy as np
+
+    from lightgbmv1_tpu.obs import dump, events
+    from lightgbmv1_tpu.serve import Fleet, Router, RouterConfig
+    from lightgbmv1_tpu.utils import faults
+    from lightgbmv1_tpu.utils.faults import FaultSpec
+
+    b1, _, X = _tiny_boosters()
+    want = _host_raw(b1, X[:4])
+    mark = events.seq()
+    crash_dir = tempfile.mkdtemp(prefix="lgbm_chaos_wr_")
+    dump.arm(crash_dir)
+    fleet = Fleet(b1, n_replicas=3, config=_fleet_cfg())
+    router = Router(fleet, RouterConfig(health_period_ms=15.0,
+                                        eject_after=2, readmit_after=2,
+                                        retry_max=2, hedge_ms=50.0))
+    try:
+        router.submit(X[:4])
+        stall_s = 1.0
+        errors = 0
+        served = 0
+        with faults.inject(FaultSpec("replica_wedge", mode="stall",
+                                     at=1, stall_s=stall_s, match="r0")):
+            t0 = time.monotonic()
+            while time.monotonic() - t0 < stall_s + 0.3:
+                try:
+                    r = router.submit(X[:4])
+                    served += 1
+                    if not np.array_equal(r.values[:, 0], want):
+                        errors += 1
+                except Exception:  # noqa: BLE001
+                    errors += 1
+                time.sleep(0.03)
+        ejected_during = any(
+            rs["ejections"] >= 1
+            for rs in router.replica_states().values())
+        deadline = time.monotonic() + 3.0
+        readmitted = False
+        while time.monotonic() < deadline:
+            h = router.health()
+            if "r0" in h["healthy_replicas"]:
+                readmitted = True
+                break
+            time.sleep(0.05)
+        forensics = _check_bundles(crash_dir, expect=1,
+                                   reasons=("watchdog_stall",))
+        forensics["stall_events"] = _count_events(
+            mark, "serve.watchdog_stall")
+        forensics["eject_events"] = _count_events(
+            mark, "router.replica_ejected")
+        forensics["forensics_ok"] = bool(
+            forensics["forensics_ok"] and forensics["stall_events"] >= 1
+            and forensics["eject_events"] >= 1)
+        ok = (errors == 0 and served > 0 and ejected_during
+              and readmitted and forensics["forensics_ok"])
+        return {"ok": ok, "served": served, "errors": errors,
+                "ejected_during_wedge": ejected_during,
+                "readmitted": readmitted, **forensics}
+    finally:
+        router.close()
+        fleet.close()
+        dump.disarm()
+        shutil.rmtree(crash_dir, ignore_errors=True)
+
+
+def scenario_partial_publish_rollback() -> dict:
+    """Two-phase fleet publish with one replica's warm phase dying
+    (``publish_warm`` fault targeted at replica r2): the WHOLE fleet
+    publish aborts with zero replicas swapped — every replica keeps
+    serving the prior version BIT-EXACTLY, tags stay aligned, and a
+    later clean publish succeeds fleet-wide.  Forensics: recovered
+    fault — no bundle; the abort and per-replica reject are first-class
+    events."""
+    import numpy as np
+
+    from lightgbmv1_tpu.obs import dump, events
+    from lightgbmv1_tpu.serve import (Fleet, FleetPublishError, Router,
+                                      RouterConfig)
+    from lightgbmv1_tpu.utils import faults
+    from lightgbmv1_tpu.utils.faults import FaultInjected, FaultSpec
+
+    b1, b2, X = _tiny_boosters()
+    mark = events.seq()
+    crash_dir = tempfile.mkdtemp(prefix="lgbm_chaos_pp_")
+    dump.arm(crash_dir)
+    fleet = Fleet(b1, n_replicas=3, config=_fleet_cfg())
+    router = Router(fleet, RouterConfig(health_period_ms=15.0))
+    try:
+        want_v1 = _host_raw(b1, X[:16])
+        aborted = False
+        with faults.inject(FaultSpec("publish_warm", mode="raise",
+                                     match="r2:")):
+            try:
+                fleet.publish(b2)
+            except FleetPublishError as e:
+                aborted = "r2" in e.causes
+        still_v1 = fleet.version() == "v1"
+        per_replica_exact = all(
+            np.array_equal(
+                np.asarray(r.submit(X[:16]).values[:, 0]), want_v1)
+            and r.submit(X[:16]).version == "v1"
+            for r in fleet.replicas)
+        clean_tag = fleet.publish(b2)
+        aligned = fleet.version() == clean_tag
+        want_v2 = _host_raw(b2, X[:16])
+        recovered = np.array_equal(
+            np.asarray(router.submit(X[:16]).values[:, 0]), want_v2)
+        forensics = _check_bundles(crash_dir, expect=0)
+        forensics["abort_events"] = _count_events(
+            mark, "fleet.publish_abort")
+        forensics["reject_events"] = _count_events(
+            mark, "serve.publish_reject")
+        forensics["forensics_ok"] = bool(
+            forensics["forensics_ok"]
+            and forensics["abort_events"] >= 1
+            and forensics["reject_events"] >= 1)
+        ok = (aborted and still_v1 and per_replica_exact and aligned
+              and recovered and forensics["forensics_ok"])
+        return {"ok": ok, "aborted": aborted, "still_v1": still_v1,
+                "per_replica_exact": per_replica_exact,
+                "clean_tag": clean_tag, "tags_aligned": aligned,
+                "clean_publish_recovered": recovered, **forensics}
+    finally:
+        router.close()
+        fleet.close()
+        dump.disarm()
+        shutil.rmtree(crash_dir, ignore_errors=True)
+
+
+# ---------------------------------------------------------------------------
 # suite
 # ---------------------------------------------------------------------------
 
@@ -608,6 +934,22 @@ def run_suite(fast: bool = False) -> dict:
     run("overload", scenario_overload)
     run("h2d_transient", scenario_h2d_transient)
 
+    # fleet scenarios (ISSUE 11): full suite runs the trainer kill on a
+    # REAL 2-process jax.distributed cluster; --fast degrades to a
+    # 1-process elastic run (same coordinator/bundle/resume machinery,
+    # no cross-process collectives) to bound the bench wall
+    tmp = tempfile.mkdtemp(prefix="lgbm_chaos_fleet_")
+    try:
+        run("trainer_worker_kill", scenario_trainer_worker_kill,
+            tmp, not fast)
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    run("replica_kill", scenario_replica_kill)
+    run("wedged_replica", scenario_wedged_replica)
+    run("partial_publish_rollback", scenario_partial_publish_rollback)
+
+    fleet_names = ("trainer_worker_kill", "replica_kill",
+                   "wedged_replica", "partial_publish_rollback")
     record = {
         "metric": "chaos suite (scripted fault injection, CPU)",
         "n_scenarios": len(scenarios),
@@ -617,6 +959,9 @@ def run_suite(fast: bool = False) -> dict:
         # kills/wedges, none for recovered faults, every bundle valid
         "forensics_ok": all(s.get("forensics_ok", False)
                             for s in scenarios.values()),
+        # the fault-tolerant-fleet subset (ISSUE 11) as its own guard
+        "chaos_fleet_ok": all(scenarios.get(k, {}).get("ok")
+                              for k in fleet_names),
         "fast": bool(fast),
     }
     return record

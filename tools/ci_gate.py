@@ -22,8 +22,10 @@ single command:
   and False; this check additionally fails a capture that silently
   dropped the field (a guard that vanishes is a guard that failed).
   Off by default so records predating a guard still gate cleanly;
-  driver captures after ISSUE 10 pass
-  ``--require-guards obs_ok,slo_ok,forensics_ok,chaos_ok``.
+  driver captures after ISSUE 11 pass ``--require-guards`` with the
+  full set in :data:`REQUIRED_GUARDS` (obs/slo/forensics/chaos plus the
+  fleet guards ``fleet_ok`` and ``chaos_fleet_ok``) — or simply
+  ``--require-guards default``, which expands to it.
 
 Exit code 0 only when every enabled guard passes; each guard's own
 report is printed so the failing one is obvious.
@@ -39,6 +41,12 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 import bench_trend  # noqa: E402
 import tier1_budget  # noqa: E402
+
+# the full post-ISSUE-11 driver guard set: ``--require-guards default``
+# expands to this, so the driver command line stops rotting as guards
+# are added (a new *_ok lands here in the same PR that records it)
+REQUIRED_GUARDS = ("obs_ok", "slo_ok", "forensics_ok", "chaos_ok",
+                   "fleet_ok", "chaos_fleet_ok")
 
 
 def check_required_guards(records_dir: str, guards, out=print) -> bool:
@@ -117,10 +125,13 @@ def main(argv=None) -> int:
     ap.add_argument("--frac", type=float, default=None)
     ap.add_argument("--require-guards", default="",
                     help="comma-separated guard fields the NEWEST bench "
-                         "record must carry as True (e.g. "
-                         "obs_ok,slo_ok,forensics_ok,chaos_ok)")
+                         "record must carry as True; 'default' expands "
+                         "to " + ",".join(REQUIRED_GUARDS))
     args = ap.parse_args(argv)
     guards = tuple(g for g in args.require_guards.split(",") if g)
+    if "default" in guards:
+        guards = tuple(g for g in guards if g != "default") \
+            + REQUIRED_GUARDS
     results = run_gate(args.records, args.t1_log,
                        skip_trend=args.skip_trend, skip_t1=args.skip_t1,
                        budget=args.budget, frac=args.frac,

@@ -406,6 +406,53 @@ def forensics_slo_section(w, rec):
     w("")
 
 
+def fleet_section(w, rec):
+    """Fault-tolerant fleet (ISSUE 11 — bench.py measure_fleet): the
+    replica-kill-under-loadgen drill (zero client-visible errors,
+    router hedge rate, health-check ejection), the coordinated
+    two-phase publish, and the elastic training kill-resume byte-parity
+    drill with its recovery clock.  Placeholder until the first capture
+    that carries the fields."""
+    w("## Fleet (elastic recovery + self-healing serving, "
+      "parallel/elastic.py + serve/router.py)")
+    w("")
+    if rec.get("fleet_ok") is None:
+        w("No fleet fields in this record yet — the next driver capture "
+          "runs bench.py's measure_fleet (a 3-replica fleet behind the "
+          "self-healing router with one replica killed under open-loop "
+          "loadgen, a coordinated two-phase publish onto the degraded "
+          "fleet, and an elastic-coordinator training run killed at "
+          "iteration 3 and re-bootstrapped from its checkpoint bundle) "
+          "and this section renders the zero-error/ejection/parity "
+          "guards, `router_hedge_frac` and `fleet_recovery_s`.")
+        w("")
+        return
+    w("| requests | qps | p99 ms | hedge frac | router retries | "
+      "recovery s | elastic world |")
+    w("|---|---|---|---|---|---|---|")
+    w(f"| {get(rec, 'fleet_requests', 0)} | {get(rec, 'fleet_qps', 1)} | "
+      f"{get(rec, 'fleet_p99_ms', 2)} | "
+      f"{get(rec, 'router_hedge_frac', 4)} | "
+      f"{get(rec, 'fleet_router_retries', 0)} | "
+      f"{get(rec, 'fleet_recovery_s', 2)} | "
+      f"{get(rec, 'fleet_elastic_world', 0)} |")
+    w("")
+    w(f"Guard `fleet_ok={rec.get('fleet_ok')}`: replica killed "
+      "mid-loadgen with ZERO client-visible errors "
+      f"(`fleet_zero_error_ok={rec.get('fleet_zero_error_ok')}`), the "
+      "dead replica health-check ejected "
+      f"(`fleet_replica_ejected_ok={rec.get('fleet_replica_ejected_ok')}"
+      "`), a two-phase publish landing one aligned tag fleet-wide "
+      f"(`fleet_publish_ok={rec.get('fleet_publish_ok')}`), and the "
+      "elastic kill-at-k run resuming to BYTE-IDENTICAL model text "
+      f"(`fleet_kill_resume_ok={rec.get('fleet_kill_resume_ok')}`).  "
+      "The chaos suite's fleet subset rides `chaos_fleet_ok="
+      f"{rec.get('chaos_fleet_ok')}`.  Knobs: `serve_replicas`, "
+      "`router_*` (hedge/retry/health), `elastic_*` (lease timeout, "
+      "max restarts) — BASELINE.md \"Fault-tolerant fleet\".")
+    w("")
+
+
 def trend_section(w, root=ROOT):
     """Trend: the regression sentinel's view of the whole BENCH record
     trajectory (tools/bench_trend.py — the same comparator that gates
@@ -682,6 +729,8 @@ def generate(rec, name, prev=None, prev_name=None):
     observability_section(w, rec)
 
     forensics_slo_section(w, rec)
+
+    fleet_section(w, rec)
 
     mc_name, mc = load_multichip()
     comm_section(w, mc_name, mc)
