@@ -558,13 +558,21 @@ def measure_predict(gb_lw, X):
     fields["predict_device_M_rows_per_s"] = round(n / wall_d / 1e6, 3)
 
     # compile-amortization: repeated calls at varying batch sizes within
-    # one bucket must not retrace (the predictor-cache contract the
-    # tests pin; recorded so a driver capture would flag a regression)
+    # one bucket must not compile (the predictor-cache contract the
+    # tests pin; recorded so a driver capture would flag a regression).
+    # Read from the obs/xla.py per-label compile counters — the same
+    # instrument the obs_device_ok guard and the serve smoke watch —
+    # instead of the predictor's ad-hoc trace counter.
+    from lightgbmv1_tpu.obs import xla as obs_xla
+
     bp.predict_raw(X[:1000])            # warm the 1024-row bucket
-    t0_traces = bp.trace_count
+    t0_compiles = obs_xla.compile_counts()
     for nn in (1000, 777, 600, 513):    # all pad to the same bucket
         bp.predict_raw(X[:nn])
-    fields["predict_cache_retraces"] = bp.trace_count - t0_traces
+    t1_compiles = obs_xla.compile_counts()
+    fields["predict_cache_retraces"] = sum(
+        t1_compiles.get(k, 0) - t0_compiles.get(k, 0)
+        for k in ("predict.leaf", "predict.scores", "predict.scan"))
 
     # ---- legacy scan walk (parity pin; the r05-era device figure) --------
     stacked = host_trees_to_stacked(trees)
@@ -996,11 +1004,26 @@ def measure_obs(X, y, backend: str, phase_fields=None):
     * **aggregation probe** (ISSUE 10) — the loadgen + server artifacts
       of the window must merge into one Chrome trace with distinct pid
       lanes and one additive metrics snapshot (``obs_agg_ok``).
+    * **device truth** (ISSUE 12) — the compile/memory telemetry of
+      obs/xla.py, read back as record fields: ``compile_ms_total`` and
+      per-label ``compile_counts``/``retrace_counts`` of every
+      instrumented dispatch this bench process compiled; a serving
+      bucket probe whose per-label compile counters must NOT move across
+      varied batch sizes inside one bucket (``serve_bucket_retraces`` —
+      the zero-retrace contract asserted via the new counters instead of
+      the predictor's ad-hoc trace counter); ``hbm_peak_bytes`` from
+      ``device.memory_stats()`` (None on CPU — graceful absence)
+      reconciled against the streaming ``DeviceLedger`` gauge
+      (``ledger_agreement``); and, when the capture carries phase fields
+      and a matmul peak, the per-phase roofline join
+      (``phase_roofline`` — tools/phase_attrib.roofline_attribution over
+      the cost-analysis split).  Guard ``obs_device_ok``.
 
     ``obs_ok`` = overhead <= 2% AND parity AND both traces valid AND the
-    exposition healthy AND slo/forensics/aggregation green — the events
-    ring and SLO tracker are always-on, so their cost sits inside the
-    measured A/B walls."""
+    exposition healthy AND slo/forensics/aggregation green AND the
+    device-truth block green — the events ring, SLO tracker and compile
+    telemetry are always-on, so their cost sits inside the measured A/B
+    walls."""
     import shutil
     import tempfile
 
@@ -1204,6 +1227,88 @@ def measure_obs(X, y, backend: str, phase_fields=None):
     finally:
         shutil.rmtree(fdir, ignore_errors=True)
 
+    # ---- device truth (ISSUE 12): compile/memory/cost telemetry --------
+    try:
+        from lightgbmv1_tpu.models.predict import BatchPredictor
+        from lightgbmv1_tpu.obs import xla as obs_xla
+        from lightgbmv1_tpu.obs.metrics import default_registry
+
+        # serving bucket path: warm one bucket, then varied batch sizes
+        # INSIDE it — the per-label compile counters must not move (the
+        # compile-amortization contract, now watched by the obs/xla.py
+        # counters every instrumented dispatch shares)
+        trees = bst._gbdt.materialize_host_trees()
+        bp = BatchPredictor(trees, 1, Xs.shape[1], bucket_min=64)
+        bp.predict_raw(pool[:200])          # warm the 256-row bucket
+        before = obs_xla.compile_counts()
+        for nn in (200, 180, 150, 129):
+            bp.predict_raw(pool[:nn])
+        after = obs_xla.compile_counts()
+        serve_retraces = sum(
+            after.get(k, 0) - before.get(k, 0)
+            for k in ("predict.leaf", "predict.scores", "predict.scan"))
+        fields["serve_bucket_retraces"] = int(serve_retraces)
+
+        # process-cumulative compile telemetry: every labeled dispatch
+        # this bench compiled (train step/scan, growers, predict walks)
+        stats = obs_xla.compile_stats()
+        fields["compile_ms_total"] = round(obs_xla.compile_ms_total(), 1)
+        fields["compile_counts"] = obs_xla.compile_counts()
+        fields["retrace_counts"] = obs_xla.retrace_counts()
+        fallbacks = {k: v["fallbacks"] for k, v in stats.items()
+                     if v.get("fallbacks")}
+        if fallbacks:
+            fields["xla_instrument_fallbacks"] = fallbacks
+        step = stats.get("train.scan") or stats.get("train.step") or {}
+        fields["train_step_flops"] = step.get("flops")
+        fields["train_step_bytes_accessed"] = step.get("bytes_accessed")
+        fields["train_step_temp_bytes"] = step.get("temp_bytes")
+
+        # live device memory vs the streaming ledger's analytic bound
+        mem = obs_xla.sample_device_memory()
+        fields["hbm_peak_bytes"] = (
+            int(mem["peak_bytes_in_use"])
+            if mem and "peak_bytes_in_use" in mem else None)
+        gauge = default_registry().get("stream_peak_device_bytes")
+        ledger_peak = gauge.get() if gauge is not None else None
+        fields["ledger_agreement"] = obs_xla.ledger_agreement(
+            ledger_peak, fields["hbm_peak_bytes"])
+
+        # roofline join: measured phase ms x cost-analysis flops/bytes
+        # against the same-session matmul peak (device captures only —
+        # the CPU smoke has neither phase fields nor a peak)
+        if phase_fields and phase_fields.get("phase_hist_ms") is not None \
+                and phase_fields.get("device_matmul_peak_tf_s"):
+            from tools.phase_attrib import (roofline_attribution,
+                                            split_cost_by_ms)
+
+            pms = {k[len("phase_"):-len("_ms")]: phase_fields[k]
+                   for k in ("phase_hist_ms", "phase_partition_ms",
+                             "phase_split_ms", "phase_other_ms")
+                   if phase_fields.get(k)}
+            cost = split_cost_by_ms(step.get("flops"),
+                                    step.get("bytes_accessed"), pms)
+            rl = roofline_attribution(
+                pms, cost,
+                phase_fields["device_matmul_peak_tf_s"] * 1e12)
+            if rl:
+                fields["phase_roofline"] = rl
+
+        train_labels = [k for k in fields["compile_counts"]
+                        if k.startswith(("train.", "grow."))]
+        fields["obs_device_ok"] = bool(
+            fields["compile_ms_total"] > 0
+            and train_labels
+            and serve_retraces == 0
+            and not fallbacks
+            and (fields["hbm_peak_bytes"] is None
+                 or fields["hbm_peak_bytes"] > 0)
+            and (fields["ledger_agreement"] is None
+                 or 0 < fields["ledger_agreement"] <= 1.5))
+    except Exception as e:   # noqa: BLE001 — a broken instrument FAILS
+        fields["obs_device_error"] = f"{type(e).__name__}: {e}"[:200]
+        fields["obs_device_ok"] = False
+
     fields["obs_ok"] = bool(
         fields.get("obs_overhead_frac", 1.0) <= 0.02
         and fields.get("obs_parity_ok")
@@ -1212,7 +1317,8 @@ def measure_obs(X, y, backend: str, phase_fields=None):
         and fields.get("obs_prom_ok")
         and fields.get("slo_ok")
         and fields.get("forensics_ok")
-        and fields.get("obs_agg_ok"))
+        and fields.get("obs_agg_ok")
+        and fields.get("obs_device_ok"))
     return fields
 
 

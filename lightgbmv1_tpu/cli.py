@@ -162,6 +162,29 @@ def _prune_snapshots(output_model: str, keep: int) -> None:
                 pass
 
 
+def _arm_profiler(config: Config):
+    """Arm the ``profile_dir`` jax.profiler device capture for this task
+    window and return an EXPORT-ONCE finisher — safe to call from every
+    exit path (clean completion, the dying-run handler, finally blocks):
+    only the first call stops the trace and writes the wall-clock anchor
+    sidecar (obs/xla.py) that lets tools/obs_aggregate.py merge the
+    device lane onto the host span timeline.  The pre-ISSUE-12 inline
+    start/stop was train-only and could leak an armed profiler when the
+    run died between arm and the stop path."""
+    if not config.profile_dir:
+        return lambda: None
+    from .obs import xla as obs_xla
+
+    session = obs_xla.start_profiler(config.profile_dir)
+
+    def finish():
+        if obs_xla.stop_profiler(session):
+            log_info(f"Wrote device trace to {config.profile_dir} "
+                     "(merge the lane with tools/obs_aggregate.py "
+                     f"--profile-dir {config.profile_dir})")
+    return finish
+
+
 def run_train(config: Config) -> Booster:
     """reference: Application::InitTrain + Train, application.cpp:164-211."""
     if not config.data:
@@ -213,7 +236,6 @@ def run_train(config: Config) -> Booster:
 
     n_iter = max(config.num_iterations - done_iters, 0)
     t0 = time.time()
-    profiling = False
     tracing = False
     if config.obs_trace or config.trace_out:
         # host-side span tracer (obs/trace.py); composes with the jax
@@ -224,11 +246,7 @@ def run_train(config: Config) -> Booster:
 
         obs_trace.arm(ring_events=config.obs_ring_events)
         tracing = True
-    if config.profile_dir:
-        import jax
-
-        jax.profiler.start_trace(config.profile_dir)
-        profiling = True
+    finish_profile = _arm_profiler(config)
 
     def _finish_trace():
         # export + disarm exactly once — on clean completion (after the
@@ -293,13 +311,8 @@ def run_train(config: Config) -> Booster:
 
         obs_dump.dump("train_crash", exc=e)
         _finish_trace()
-        raise
-    finally:
-        if profiling:
-            import jax
-
-            jax.profiler.stop_trace()
-            log_info(f"Wrote device trace to {config.profile_dir}")
+        finish_profile()    # export-once: a dying run still gets its
+        raise               # partial device trace + anchor sidecar
     try:
         if config.output_model:
             # still inside the traced region: the final model save
@@ -308,6 +321,7 @@ def run_train(config: Config) -> Booster:
             booster.save_model(config.output_model)
     finally:
         _finish_trace()
+        finish_profile()
     log_info("Finished training")
     return booster
 
@@ -349,41 +363,49 @@ def run_predict(config: Config) -> None:
                       model_file=config.input_model)
     log_info("Finished initializing prediction, total used "
              f"{booster.current_iteration()} iterations")
+    # profile_dir now covers the predict window too (it was train-only):
+    # the device walk + H2D of the batched inference engine is exactly
+    # what a serving-perf capture needs to see
+    finish_profile = _arm_profiler(config)
     t0 = time.time()
-    # honor the same loader options as training (header/label/ignore cols)
-    df = load_data_file(
-        config.data,
-        has_header=config.header,
-        label_column=config.label_column,
-        weight_column=config.weight_column,
-        group_column=config.group_column,
-        ignore_column=config.ignore_column,
-        is_predict=True,
-    )
-    X = df.X
-    if X.shape[1] == booster.num_feature() + 1:
-        X = X[:, 1:]   # prediction files may still carry the label column
-    t_parse = time.time()
-    out = booster.predict(
-        X,
-        raw_score=config.predict_raw_score,
-        pred_leaf=config.predict_leaf_index,
-        pred_contrib=config.predict_contrib,
-        start_iteration=config.start_iteration_predict,
-        num_iteration=(config.num_iteration_predict
-                       if config.num_iteration_predict > 0 else None),
-        pred_early_stop=config.pred_early_stop,
-        pred_early_stop_freq=config.pred_early_stop_freq,
-        pred_early_stop_margin=config.pred_early_stop_margin,
-        predict_disable_shape_check=config.predict_disable_shape_check,
-    )
-    t_pred = time.time()
-    out = np.asarray(out)
-    if out.ndim == 1:
-        out = out[:, None]
-    fmt = "%d" if config.predict_leaf_index else "%.18g"
-    np.savetxt(config.output_result, out, fmt=fmt, delimiter="\t")
-    t1 = time.time()
+    try:
+        # honor the same loader options as training (header/label/ignore)
+        df = load_data_file(
+            config.data,
+            has_header=config.header,
+            label_column=config.label_column,
+            weight_column=config.weight_column,
+            group_column=config.group_column,
+            ignore_column=config.ignore_column,
+            is_predict=True,
+        )
+        X = df.X
+        if X.shape[1] == booster.num_feature() + 1:
+            X = X[:, 1:]   # prediction files may still carry the label col
+        t_parse = time.time()
+        out = booster.predict(
+            X,
+            raw_score=config.predict_raw_score,
+            pred_leaf=config.predict_leaf_index,
+            pred_contrib=config.predict_contrib,
+            start_iteration=config.start_iteration_predict,
+            num_iteration=(config.num_iteration_predict
+                           if config.num_iteration_predict > 0 else None),
+            pred_early_stop=config.pred_early_stop,
+            pred_early_stop_freq=config.pred_early_stop_freq,
+            pred_early_stop_margin=config.pred_early_stop_margin,
+            predict_disable_shape_check=config.predict_disable_shape_check,
+        )
+        t_pred = time.time()
+        out = np.asarray(out)
+        if out.ndim == 1:
+            out = out[:, None]
+        fmt = "%d" if config.predict_leaf_index else "%.18g"
+        np.savetxt(config.output_result, out, fmt=fmt, delimiter="\t")
+        t1 = time.time()
+    finally:
+        finish_profile()    # export-once: no leaked armed profiler on a
+        # failed parse/predict/write — the partial capture still lands
     log_info(f"Prediction window: parse {t_parse - t0:.3f}s, predict "
              f"{t_pred - t_parse:.3f}s ({config.predict_method}), write "
              f"{t1 - t_pred:.3f}s ({X.shape[0]} rows)")
@@ -418,6 +440,24 @@ def run_serve(config: Config):
 
         obs_trace.arm(ring_events=config.obs_ring_events)
         tracing = True
+    # profile_dir covers the serving window too (it was train-only): the
+    # micro-batched device walks of live traffic are the capture target
+    finish_profile = _arm_profiler(config)
+    try:
+        return _run_serve_armed(config, finish_profile, tracing)
+    except BaseException:
+        # a failed model load / fleet build / port bind must not leak an
+        # armed profiler (export-once: no-op when shutdown already ran)
+        finish_profile()
+        raise
+
+
+def _run_serve_armed(config: Config, finish_profile, tracing: bool):
+    import time as _time
+
+    from .serve import ServeHTTP
+    from .serve.server import build_server
+
     booster = Booster(params=_config_to_params(config),
                       model_file=config.input_model)
     fleet = None
@@ -474,6 +514,7 @@ def run_serve(config: Config):
         server.close()
         if fleet is not None:
             fleet.close()
+        finish_profile()
         if tracing:
             from .obs import trace as obs_trace
 

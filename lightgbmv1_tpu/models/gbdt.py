@@ -32,6 +32,7 @@ from ..io.dataset import BinnedDataset
 from ..metrics import Metric, create_metrics
 from ..objectives import ObjectiveFunction, create_objective
 from ..obs import trace as obs_trace
+from ..obs import xla as obs_xla
 from ..ops.histogram import default_hist_method, hist_one_leaf
 from ..ops.split import SplitParams, make_feature_meta
 from ..utils.log import log_fatal, log_info, log_warning
@@ -424,9 +425,13 @@ class GBDT:
 
         self._step_fn = step
         # args 2/3 are the train/valid score caches — the buffers the
-        # fused step updates in place under donation
-        return jax.jit(step,
-                       donate_argnums=(2, 3) if self._donate else ())
+        # fused step updates in place under donation.  The labeled
+        # lower/compile wrapper (obs/xla.py) makes every compilation of
+        # the fused step an observed event (compile_ms, retrace count,
+        # cost/memory analysis) without touching its semantics.
+        return obs_xla.instrument_jit(
+            step, "train.step",
+            donate_argnums=(2, 3) if self._donate else ())
 
     def _objective_grads(self, s, iteration=None):
         if getattr(self.objective, "is_stochastic", False):
@@ -545,8 +550,9 @@ class GBDT:
                 )
                 return ts, vs, trees, cu
 
-            self._scan = jax.jit(
-                scan_fn, donate_argnums=(2, 3) if self._donate else ())
+            self._scan = obs_xla.instrument_jit(
+                scan_fn, "train.scan",
+                donate_argnums=(2, 3) if self._donate else ())
 
         K = self.num_class
         feat_masks = jnp.asarray(np.stack([
@@ -1459,7 +1465,9 @@ class DART(GBDT):
 
         # same donation contract as the plain fused step: args 2/3 are the
         # score caches, updated in place (rollback snapshots keep copies)
-        return jax.jit(full, donate_argnums=(2, 3) if self._donate else ())
+        return obs_xla.instrument_jit(
+            full, "train.dart_step",
+            donate_argnums=(2, 3) if self._donate else ())
 
     def _dart_step_for(self, P: int, use_lids: bool):
         key = (P, use_lids)

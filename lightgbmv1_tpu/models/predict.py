@@ -50,6 +50,7 @@ from typing import Any, Dict, List, NamedTuple, Optional, Tuple
 import numpy as np
 
 from ..io.binning import K_ZERO_THRESHOLD, MISSING_NAN, MISSING_ZERO
+from ..obs import xla as obs_xla
 from ..utils import faults
 from ..utils.log import log_warning
 from .tree import HostTree, host_tree_depth, validate_host_tree
@@ -559,7 +560,10 @@ class BatchPredictor:
             from ..parallel.trainer import shard_rows
 
             fn = shard_rows(walk, self._mesh, "rows", n_replicated=1)
-        jfn = jax.jit(fn)
+        # labeled compile telemetry (obs/xla.py): every (bucket, kind)
+        # compile is an observed event, and the per-label retrace
+        # counters are the serving zero-retrace contract's instrument
+        jfn = obs_xla.instrument_jit(fn, "predict.leaf")
         if self.method == "pallas":
             jfn = self._pallas_guard(jfn, bucket)
         return self._cache_put(key, jfn)
@@ -606,7 +610,8 @@ class BatchPredictor:
             from ..parallel.trainer import shard_rows
 
             fn = shard_rows(walk, self._mesh, "rows", n_replicated=1)
-        return self._cache_put(key, jax.jit(fn))
+        return self._cache_put(key, obs_xla.instrument_jit(
+            fn, "predict.leaf"))
 
     def _scan_fn(self, bucket: int):
         """The parity-pin scan walk (models/tree.ensemble_predict_raw) as
@@ -628,7 +633,8 @@ class BatchPredictor:
             from ..parallel.trainer import shard_rows
 
             fn = shard_rows(fwd, self._mesh, "rows", n_replicated=1)
-        return self._cache_put(key, jax.jit(fn))
+        return self._cache_put(key, obs_xla.instrument_jit(
+            fn, "predict.scan"))
 
     # -- host <-> device ------------------------------------------------
     def encode(self, X: np.ndarray) -> np.ndarray:
@@ -731,7 +737,8 @@ class BatchPredictor:
             self.trace_count += 1
             return leaves_to_scores(leaf_value, leaf, K)
 
-        return self._cache_put(key, jax.jit(fn))
+        return self._cache_put(key, obs_xla.instrument_jit(
+            fn, "predict.scores"))
 
     def _predict_raw_scan(self, X, chunk_rows):
         import jax

@@ -33,6 +33,15 @@ The forensics-and-fleet half (ISSUE 10) builds on those:
   multi-window burn-rate evaluation and exemplar trace ids
   (``GET /slo``).
 
+The device-truth half (ISSUE 12) closes the host/chip gap:
+
+* :mod:`~lightgbmv1_tpu.obs.xla` — a labeled lower/compile wrapper
+  (compile walls, retrace counts, cost/memory analysis of the compiled
+  executables, always-on), live device-memory gauges reconciled against
+  the streaming ``DeviceLedger``, and the XLA-profiler lane (wall-clock
+  anchored device capture) obs/agg.py merges next to the host spans;
+  ``tools/capture.py`` is the one-command driver-capture orchestrator.
+
 Contract: tracing is OFF by default and its off-path must cost nothing
 measurable (one module-level flag check, no allocation); armed tracing
 must stay within 2% of train wall (the BENCH ``obs_ok`` guard measures
@@ -40,9 +49,9 @@ both).  Metrics are always on — counter bumps are nanoseconds against
 millisecond iterations and requests.
 """
 
-from . import agg, dump, events, metrics, trace
+from . import agg, dump, events, metrics, trace, xla
 from .metrics import Registry, default_registry
 from .trace import span
 
-__all__ = ["agg", "dump", "events", "metrics", "trace", "Registry",
+__all__ = ["agg", "dump", "events", "metrics", "trace", "xla", "Registry",
            "default_registry", "span"]

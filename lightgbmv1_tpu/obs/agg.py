@@ -31,6 +31,18 @@ Inputs are the artifact files :func:`export_process_artifacts` writes
 forensic bundle instead of a clean export — ``crash-*.zip`` bundles
 (obs/dump.py), whose members are pulled in the same way.  CLI driver:
 ``tools/obs_aggregate.py``.
+
+ISSUE 12 adds the **device lane**: a ``jax.profiler`` capture directory
+(``profile_dir`` / the ``tools/capture.py`` harness) is ingested as one
+more trace source per ``*.trace.json(.gz)`` it holds, rebased onto the
+shared wall axis via the ``profile.anchor.json`` sidecar obs/xla.py
+writes at ``start_trace``.  Host phase spans that PR 9 rendered as
+ESTIMATED (``phase.*`` children with ``estimated: true`` — the host
+cannot see inside the jitted while-loop) are then RECONCILED against the
+measured device rows carrying the ``lgbm.*`` named scopes: when a phase
+has measured device milliseconds, its spans flip ``estimated: false``
+and the per-phase agreement ratio (measured / estimated) is recorded in
+``otherData.phase_agreement``.
 """
 
 from __future__ import annotations
@@ -95,6 +107,126 @@ def export_process_artifacts(out_dir: str,
         site="obs_artifact")
     paths["events"] = ep
     return paths
+
+
+# ---------------------------------------------------------------------------
+# device lane: jax.profiler capture ingestion + phase reconciliation
+# ---------------------------------------------------------------------------
+
+# host phase span name -> the jax.named_scope tokens the device rows
+# carry (ops/histogram.py, ops/split.py, models/grower*.py); phases
+# without a scope (valid_route, other) stay estimated by construction
+PHASE_SCOPE_TOKENS: Dict[str, Tuple[str, ...]] = {
+    "hist": ("lgbm.hist",),
+    "split": ("lgbm.split",),
+    "partition": ("lgbm.partition",),
+}
+
+
+def load_profiler_traces(profile_dir: str) -> List[Tuple[str, dict]]:
+    """``[(label, chrome_doc)]`` from a ``jax.profiler`` capture
+    directory: every ``*.trace.json(.gz)`` under ``plugins/profile/``
+    (or directly in the directory) becomes one device-lane source,
+    anchored by the ``profile.anchor.json`` sidecar when present so the
+    merger can rebase it onto the shared wall-clock axis."""
+    import glob as _glob
+    import gzip
+
+    from . import xla as obs_xla
+
+    profile_dir = str(profile_dir)
+    anchor = obs_xla.read_anchor(profile_dir) or {}
+    ident = anchor.get("identity") or {}
+    paths = sorted(
+        _glob.glob(os.path.join(profile_dir, "plugins", "profile", "*",
+                                "*.trace.json.gz"))
+        + _glob.glob(os.path.join(profile_dir, "plugins", "profile", "*",
+                                  "*.trace.json"))
+        + _glob.glob(os.path.join(profile_dir, "*.trace.json.gz")))
+    docs: List[Tuple[str, dict]] = []
+    for path in paths:
+        try:
+            if path.endswith(".gz"):
+                with gzip.open(path, "rt") as fh:
+                    doc = json.load(fh)
+            else:
+                with open(path) as fh:
+                    doc = json.load(fh)
+        except (OSError, ValueError) as e:
+            from ..utils.log import log_warning
+
+            log_warning(f"obs/agg: skipping unreadable profiler trace "
+                        f"{path} ({type(e).__name__}: {e})")
+            continue
+        if not isinstance(doc, dict) or "traceEvents" not in doc:
+            continue
+        # the profiler's host lane interleaves a python-interpreter frame
+        # event (``$file:line fn``) for nearly every call — megabytes of
+        # noise per second of capture that drowns the XLA op rows the
+        # device lane exists for.  Drop the interpreter frames, keep
+        # everything else (XLA ops, TraceAnnotations, metadata).
+        kept = [e for e in doc["traceEvents"]
+                if not (e.get("ph") == "X"
+                        and str(e.get("name", "")).startswith("$"))]
+        dropped_frames = len(doc["traceEvents"]) - len(kept)
+        doc["traceEvents"] = kept
+        other = dict(doc.get("otherData") or {})
+        if dropped_frames:
+            other["python_frames_dropped"] = dropped_frames
+        other.setdefault("t0_unix_ns", anchor.get("t0_unix_ns"))
+        other.setdefault("role", "device")
+        other.setdefault("host", ident.get("host", "?"))
+        other.setdefault("pid", ident.get("pid", 0))
+        other.setdefault("run_id", ident.get("run_id"))
+        other.setdefault("exporter", "jax.profiler")
+        doc["otherData"] = other
+        stem = os.path.basename(path).split(".trace.json")[0]
+        docs.append(("device-" + _safe_label(stem), doc))
+    return docs
+
+
+def reconcile_estimated(doc: dict) -> Dict[str, Optional[float]]:
+    """Reconcile estimated host phase spans against measured device rows
+    in a MERGED trace document (mutates ``doc``; see module docstring).
+
+    Returns ``{phase: agreement ratio}`` for every phase that had both
+    an estimated span total and measured ``lgbm.<phase>``-scoped device
+    milliseconds; those spans flip to ``estimated: false`` and carry
+    ``measured_device_ms`` + ``agreement``.  Phases with no measured
+    rows are untouched — an estimate stays labeled an estimate."""
+    sources = (doc.get("otherData") or {}).get("sources") or []
+    device_lanes = {s.get("lane") for s in sources
+                    if s.get("role") == "device"}
+    est: Dict[str, List[dict]] = {}
+    meas: Dict[str, float] = {}
+    for ev in doc.get("traceEvents", []):
+        if ev.get("ph") != "X":
+            continue
+        name = str(ev.get("name", ""))
+        if name.startswith("phase.") and (ev.get("args") or {}).get(
+                "estimated"):
+            est.setdefault(name[len("phase."):], []).append(ev)
+        elif ev.get("pid") in device_lanes:
+            low = name.lower()
+            for phase, tokens in PHASE_SCOPE_TOKENS.items():
+                if any(t in low for t in tokens):
+                    meas[phase] = meas.get(phase, 0.0) \
+                        + float(ev.get("dur", 0) or 0) / 1e3
+    agreement: Dict[str, Optional[float]] = {}
+    for phase, spans in est.items():
+        measured_ms = meas.get(phase)
+        if not measured_ms:
+            continue
+        est_ms = sum(float(e.get("dur", 0) or 0) for e in spans) / 1e3
+        ratio = round(measured_ms / est_ms, 4) if est_ms > 0 else None
+        agreement[phase] = ratio
+        for e in spans:
+            args = e.setdefault("args", {})
+            args["estimated"] = False
+            args["measured_device_ms"] = round(measured_ms, 3)
+            args["agreement"] = ratio
+    doc.setdefault("otherData", {})["phase_agreement"] = agreement
+    return agreement
 
 
 # ---------------------------------------------------------------------------
@@ -242,14 +374,21 @@ def load_artifact_dir(art_dir: str) -> dict:
 
 
 def aggregate_dir(art_dir: str, out_trace: Optional[str] = None,
-                  out_metrics: Optional[str] = None) -> dict:
+                  out_metrics: Optional[str] = None,
+                  profile_dir: Optional[str] = None) -> dict:
     """One-call aggregation: scan ``art_dir``, merge, optionally write
     ``merged.trace.json`` / ``merged.metrics.json`` (defaults inside
-    ``art_dir``), return a summary dict."""
+    ``art_dir``), return a summary dict.  ``profile_dir`` additionally
+    ingests a ``jax.profiler`` capture as device lane(s) and reconciles
+    the estimated host phase spans against the measured device rows."""
     from ..utils import fileio
 
     arts = load_artifact_dir(art_dir)
-    trace_doc = merge_trace_docs(arts["traces"])
+    traces = list(arts["traces"])
+    if profile_dir:
+        traces.extend(load_profiler_traces(profile_dir))
+    trace_doc = merge_trace_docs(traces)
+    agreement = reconcile_estimated(trace_doc)
     metrics_doc = merge_metrics_snapshots(arts["metrics"])
     merged_events = merge_event_lists(arts["events"])
     out_trace = out_trace or os.path.join(str(art_dir), MERGED_TRACE)
@@ -265,10 +404,14 @@ def aggregate_dir(art_dir: str, out_trace: Optional[str] = None,
         site="obs_merged")
     lanes = {e["pid"] for e in trace_doc["traceEvents"]
              if e.get("ph") == "X"}
+    device_lanes = {s["lane"] for s in trace_doc["otherData"]["sources"]
+                    if s.get("role") == "device"}
     return {
         "sources": [s["label"] for s in
                     trace_doc["otherData"]["sources"]],
         "lanes": len(lanes),
+        "device_lanes": len(device_lanes & lanes),
+        "phase_agreement": agreement,
         "trace_events": sum(1 for e in trace_doc["traceEvents"]
                             if e.get("ph") == "X"),
         "merged_events": len(merged_events),

@@ -67,6 +67,7 @@ from ..config import Config
 from ..models.grower import make_leafwise_grower
 from ..models.grower_wave import make_wave_grower
 from ..models.tree import TreeArrays
+from ..obs import xla as obs_xla
 from ..ops.histogram import (default_hist_method, hist_one_leaf, hist_wave,
                              hist_wave_quant)
 from ..ops.split import (FeatureMeta, SplitParams, SplitResult,
@@ -550,11 +551,14 @@ def build_trainer(
                 partition=(config.tree_growth != "leafwise_masked"
                            and cegb_lazy is None),
                 **lw_pool, **common)
-        # jax.jit copies grow.__dict__ (functools.wraps), so the wave
-        # grower's _supports_valids capability flag — valid rows routed
-        # through each round's splits instead of per-tree walks — rides
-        # the jitted callable automatically
-        return jax.jit(grow), jnp.asarray(binned_np), N
+        # the instrumented jit copies grow.__dict__ (the jax.jit /
+        # functools.wraps contract), so the wave grower's
+        # _supports_valids capability flag — valid rows routed through
+        # each round's splits instead of per-tree walks — rides the
+        # wrapped callable automatically; compile telemetry (obs/xla.py)
+        # labels this dispatch per learner
+        return obs_xla.instrument_jit(grow, "grow.serial"), \
+            jnp.asarray(binned_np), N
 
     if learner == "voting" and levelwise:
         log_warning("tree_learner=voting requires the leaf-wise grower; "
@@ -715,7 +719,6 @@ def build_trainer(
             check_vma=False,
         )
 
-        @jax.jit
         def grow_fn(binned, g3, base_mask, key, cegb_used):
             pad = N_pad - N
             g3p = jnp.pad(g3, ((0, pad), (0, 0)))
@@ -723,7 +726,8 @@ def build_trainer(
                                           cegb_used)
             return tree, leaf_id[:N], root
 
-        return grow_fn, binned_dev, N
+        return obs_xla.instrument_jit(grow_fn, f"grow.{learner}"), \
+            binned_dev, N
 
     if learner == "data":
         mesh = _make_mesh(config.num_shards, "data")
@@ -909,7 +913,6 @@ def build_trainer(
             check_vma=False,
         )
 
-        @jax.jit
         def grow_fn(binned, g3, base_mask, key, cegb_used):
             pad = N_pad - N
             g3p = jnp.pad(g3, ((0, pad), (0, 0)))
@@ -917,7 +920,8 @@ def build_trainer(
                                           cegb_used)
             return tree, leaf_id[:N], root
 
-        return grow_fn, binned_dev, N
+        return obs_xla.instrument_jit(grow_fn, f"grow.{learner}"), \
+            binned_dev, N
 
     if learner == "feature":
         mesh = _make_mesh(config.num_shards, "feature")
@@ -1058,12 +1062,12 @@ def build_trainer(
             check_vma=False,
         )
 
-        @jax.jit
         def grow_fn(binned, g3, base_mask, key, cegb_used):
             maskp = jnp.pad(base_mask, (0, pad_f))
             return sharded(binned, g3, maskp, key,
                            jnp.pad(cegb_used, (0, pad_f)))
 
-        return grow_fn, binned_dev, N
+        return obs_xla.instrument_jit(grow_fn, f"grow.{learner}"), \
+            binned_dev, N
 
     log_fatal(f"Unknown tree_learner: {learner}")

@@ -101,7 +101,18 @@ class ModelRegistry:
         land in, BEFORE the version becomes visible — the first real
         request must never pay a trace.  Every warm output is
         finite-checked: a version whose executables produce NaN/Inf is
-        rejected here, pre-swap."""
+        rejected here, pre-swap.
+
+        Device truth (ISSUE 12): the warm phase IS this publish's
+        compile bill — the obs/xla.py per-label counters price it, and
+        the version carries ``warm_compile_ms``/``warm_compiles`` in its
+        meta (plus a ``serve.publish_warm`` event), so a publish that
+        suddenly compiles more than its predecessor is a visible number,
+        not a mystery pause before the swap."""
+        from ..obs import xla as obs_xla
+
+        ms0 = obs_xla.compile_ms_total()
+        counts0 = obs_xla.compile_counts()
         n_compiled = 0
         for bp in filter(None, (mv.predictor, mv.degraded)):
             buckets = self._warm_buckets
@@ -127,6 +138,24 @@ class ModelRegistry:
                         f"{mv.tag}: non-finite scores from the "
                         f"{bucket}-row warm batch")
                 n_compiled += 1
+        counts1 = obs_xla.compile_counts()
+        warm_ms = round(obs_xla.compile_ms_total() - ms0, 1)
+        warm_compiles = sum(
+            counts1.get(k, 0) - counts0.get(k, 0)
+            for k in counts1 if k.startswith("predict."))
+        mv.meta["warm_compile_ms"] = warm_ms
+        mv.meta["warm_compiles"] = warm_compiles
+        try:
+            from ..obs import events
+
+            events.publish(
+                "serve.publish_warm",
+                f"{mv.tag}: warmed {n_compiled} batches, "
+                f"{warm_compiles} compiles in {warm_ms} ms",
+                tag=mv.tag, replica=self.name or "",
+                warm_compile_ms=warm_ms, warm_compiles=warm_compiles)
+        except Exception:   # noqa: BLE001 — telemetry must never block
+            pass            # a publish
         return n_compiled
 
     # -- pre-swap validation ---------------------------------------------

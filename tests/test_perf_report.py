@@ -354,6 +354,44 @@ def test_fleet_section_renders_fields():
     assert "No fleet fields" in "\n".join(lines)
 
 
+def test_device_truth_section_renders_fields():
+    """The Device truth section (ISSUE 12) is generated from the BENCH
+    device-truth fields (bench.py measure_obs's device block via
+    obs/xla.py): compile clock, per-label counters, the zero-retrace
+    probe, HBM/ledger reconciliation and the roofline rows all grep to
+    record fields."""
+    import perf_report
+
+    rec = {
+        "obs_device_ok": True, "compile_ms_total": 1234.5,
+        "serve_bucket_retraces": 0, "hbm_peak_bytes": 987654321,
+        "ledger_agreement": 0.9312,
+        "compile_counts": {"train.scan": 3, "predict.leaf": 2},
+        "retrace_counts": {"train.scan": 1, "predict.leaf": 0},
+        "train_step_flops": 5.0e9, "train_step_bytes_accessed": 2.5e9,
+        "train_step_temp_bytes": 123456,
+        "phase_roofline": {
+            "hist": {"ms": 40.0, "achieved_tf_s": 21.5,
+                     "frac_of_peak": 0.1612, "bound": "compute"},
+        },
+    }
+    lines = []
+    perf_report.device_truth_section(lines.append, rec)
+    txt = "\n".join(lines)
+    assert "## Device truth" in txt
+    for needle in ("1234.5", "987654321", "0.9312",
+                   "train.scan 3 (1)", "predict.leaf 2 (0)",
+                   "obs_device_ok=True", "| hist | 40 | 21.5 | 0.1612 "
+                   "| compute |", "compile_ms_total", "hbm_peak_bytes"):
+        assert needle in txt, needle
+    # a record with no device-truth capture renders the placeholder
+    lines = []
+    perf_report.device_truth_section(lines.append, {})
+    txt = "\n".join(lines)
+    assert "No device-truth fields" in txt
+    assert "tools/capture.py" in txt
+
+
 def test_trend_section_renders_sentinel_rows(tmp_path):
     """The Trend section is rendered BY the sentinel (bench_trend.run),
     so PERF.md's table and the gate's verdict cannot disagree."""

@@ -83,6 +83,13 @@ WATCHED: Tuple[Tuple[str, str, float], ...] = (
     # chaos_fleet_ok / the *_ok sub-guards are booleans the guard sweep
     # flags automatically
     ("fleet_recovery_s", "down", 0.50),
+    # device truth (ISSUE 12): compile time is noisy (cache state, load,
+    # whole-process cumulative) — generous bar, watched so a retrace
+    # storm or a compile-time explosion is still a flagged number; the
+    # HBM footprint gets the standard 10% bar so the Pallas-megakernel
+    # work of ROADMAP item 2 lands against a baseline
+    ("compile_ms_total", "down", 0.50),
+    ("hbm_peak_bytes", "down", 0.10),
 )
 
 _PARITY_RE = re.compile(r"dryrun_multichip PARITY (\{.*\})")

@@ -42,11 +42,13 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 import bench_trend  # noqa: E402
 import tier1_budget  # noqa: E402
 
-# the full post-ISSUE-11 driver guard set: ``--require-guards default``
+# the full post-ISSUE-12 driver guard set: ``--require-guards default``
 # expands to this, so the driver command line stops rotting as guards
-# are added (a new *_ok lands here in the same PR that records it)
+# are added (a new *_ok lands here in the same PR that records it);
+# obs_device_ok is the device-truth telemetry guard (compile counters,
+# serving zero-retrace, HBM/ledger reconciliation — bench.py measure_obs)
 REQUIRED_GUARDS = ("obs_ok", "slo_ok", "forensics_ok", "chaos_ok",
-                   "fleet_ok", "chaos_fleet_ok")
+                   "fleet_ok", "chaos_fleet_ok", "obs_device_ok")
 
 
 def check_required_guards(records_dir: str, guards, out=print) -> bool:

@@ -18,7 +18,13 @@ Usage::
 
     python tools/obs_aggregate.py <artifact_dir>
         [--out merged.trace.json] [--metrics-out merged.metrics.json]
-        [--json]
+        [--profile-dir DIR] [--json]
+
+``--profile-dir`` ingests a ``jax.profiler`` capture (the device lane:
+``profile_dir=`` CLI knob or ``tools/capture.py``) next to the host
+lanes, wall-clock-anchored by its ``profile.anchor.json`` sidecar, and
+reconciles estimated host phase spans against the measured device rows
+(per-phase agreement ratio in the merged trace's ``otherData``).
 
 Exit 0 with a one-line summary (or the full JSON summary under
 ``--json``); exit 1 when the directory holds no artifacts at all.
@@ -47,6 +53,9 @@ def main(argv=None) -> int:
     ap.add_argument("--metrics-out", default=None,
                     help="merged metrics path "
                          "(default <dir>/merged.metrics.json)")
+    ap.add_argument("--profile-dir", default=None,
+                    help="jax.profiler capture directory to merge as the "
+                         "device lane (profile.anchor.json aligns it)")
     ap.add_argument("--json", action="store_true",
                     help="print the machine-readable summary")
     args = ap.parse_args(argv)
@@ -54,7 +63,8 @@ def main(argv=None) -> int:
         print(f"obs_aggregate: {args.artifact_dir!r} is not a directory")
         return 1
     summary = agg.aggregate_dir(args.artifact_dir, out_trace=args.out,
-                                out_metrics=args.metrics_out)
+                                out_metrics=args.metrics_out,
+                                profile_dir=args.profile_dir)
     if not summary["sources"]:
         print(f"obs_aggregate: no artifacts in {args.artifact_dir!r} "
               "(expected *.trace.json / *.metrics.json / *.events.jsonl "
@@ -65,7 +75,8 @@ def main(argv=None) -> int:
     else:
         print(f"obs_aggregate: merged {len(summary['sources'])} "
               f"process(es) {summary['sources']} -> "
-              f"{summary['lanes']} lane(s), "
+              f"{summary['lanes']} lane(s) "
+              f"({summary['device_lanes']} device), "
               f"{summary['trace_events']} spans, "
               f"{summary['merged_events']} events; wrote "
               f"{summary['merged_trace']} and "

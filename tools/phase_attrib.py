@@ -510,6 +510,81 @@ def measure_other_breakdown(*, N, F, B, L, K, rounds_per_iter,
     return bd
 
 
+def split_cost_by_ms(total_flops, total_bytes, phase_ms):
+    """Attribute ONE compiled executable's cost analysis (flops, bytes
+    accessed — obs/xla.py compile telemetry of the fused/scanned train
+    step) over the measured per-phase milliseconds, proportionally.
+
+    This is an ESTIMATE by construction (XLA reports whole-executable
+    totals; the proportionality assumption is that arithmetic intensity
+    is uniform across phases) — the honest per-phase ground truth is the
+    profiler lane, but the proportional table is what makes the roofline
+    column computable from an always-on capture.  Returns the
+    ``{phase: {"flops", "bytes"}}`` cost table
+    :func:`roofline_attribution` consumes, or ``{}`` when either input
+    is missing."""
+    total_ms = sum(v for v in (phase_ms or {}).values()
+                   if isinstance(v, (int, float)) and v > 0)
+    if not total_ms or not (total_flops or total_bytes):
+        return {}
+    table = {}
+    for phase, ms in phase_ms.items():
+        if not isinstance(ms, (int, float)) or ms <= 0:
+            continue
+        frac = ms / total_ms
+        table[phase] = {
+            "flops": float(total_flops) * frac if total_flops else None,
+            "bytes": float(total_bytes) * frac if total_bytes else None,
+        }
+    return table
+
+
+def roofline_attribution(phase_ms, cost_table, peak_flops_per_s,
+                         peak_bytes_per_s=None):
+    """Per-phase achieved-fraction-of-peak: join cost-analysis flops /
+    bytes (``cost_table`` — ``{phase: {"flops", "bytes"}}``, e.g. from
+    :func:`split_cost_by_ms` or a per-phase profiler capture) with the
+    MEASURED phase milliseconds against the device ceilings.
+
+    Per phase: ``achieved_tf_s = flops / s / 1e12`` and
+    ``frac_of_peak_flops`` against ``peak_flops_per_s``;
+    ``achieved_gb_s`` / ``frac_of_peak_bw`` against ``peak_bytes_per_s``
+    when given.  ``frac_of_peak`` is the max of the two (the roofline:
+    a kernel is as good as its binding resource) and ``bound`` names
+    which resource binds.  Phases missing ms or cost rows are omitted —
+    absent truth is absent, never zero-filled."""
+    rows = {}
+    for phase, ms in (phase_ms or {}).items():
+        if not isinstance(ms, (int, float)) or ms <= 0:
+            continue
+        cost = (cost_table or {}).get(phase) or {}
+        flops = cost.get("flops")
+        nbytes = cost.get("bytes")
+        if not flops and not nbytes:
+            continue
+        sec = ms / 1e3
+        row = {"ms": round(float(ms), 3)}
+        frac_f = frac_b = None
+        if flops and peak_flops_per_s:
+            row["achieved_tf_s"] = round(flops / sec / 1e12, 4)
+            frac_f = flops / sec / float(peak_flops_per_s)
+            row["frac_of_peak_flops"] = round(frac_f, 4)
+        if nbytes and peak_bytes_per_s:
+            row["achieved_gb_s"] = round(nbytes / sec / 1e9, 3)
+            frac_b = nbytes / sec / float(peak_bytes_per_s)
+            row["frac_of_peak_bw"] = round(frac_b, 4)
+        candidates = [f for f in (frac_f, frac_b) if f is not None]
+        if not candidates:
+            continue
+        row["frac_of_peak"] = round(max(candidates), 4)
+        row["bound"] = ("compute"
+                        if frac_f is not None
+                        and (frac_b is None or frac_f >= frac_b)
+                        else "memory")
+        rows[phase] = row
+    return rows
+
+
 def main():
     """Standalone small-shape run (CPU-safe); prints one JSON line."""
     bd = measure_other_breakdown(N=20_000, F=8, B=16, L=31, K=8,
