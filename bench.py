@@ -437,6 +437,235 @@ def measure_phases(ds, N, gb_lw, schedule, hist_fields, n_valid,
     }
 
 
+def measure_fused(ds, N, backend, n_iters):
+    """``hist_method=fused`` A/B (ISSUE 13 — ops/wave_fused.py), every
+    backend:
+
+    * **parity** — trees of the fused run must byte-compare to the
+      staged ``hist_method=pallas`` run's model text at the bench
+      config (the same histogram arithmetic, fused vs staged
+      scheduling; on CPU both ride the Pallas interpreter — the lane
+      tests/test_wave_fused.py pins).
+    * **throughput** — the fused run's M row-trees/s next to the
+      headline.
+    * **HBM accounting** — the compiled executables' own
+      ``cost_analysis()`` bytes for ONE sustained-bucket wave round:
+      staged (hist pass → subtraction → vmapped split scan) minus fused
+      (one kernel, residue out).  ``fused_hbm_bytes_saved_per_round``
+      is that difference — the measured form of the "the (F, B, 3)
+      histogram stack never materializes off-chip" claim, with the
+      analytic stack size recorded beside it for scale.
+
+    ``fused_ok`` itself is joined in main(): parity AND (on device) the
+    measured fused round <= staged ``phase_hist_ms + phase_split_ms``.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from lightgbmv1_tpu.basic import _objective_string
+    from lightgbmv1_tpu.config import Config
+    from lightgbmv1_tpu.io.model_text import model_to_string
+    from lightgbmv1_tpu.models.gbdt import create_boosting
+
+    fields = {}
+    base = {
+        "objective": "binary", "num_leaves": 255, "max_bin": 63,
+        "learning_rate": 0.1, "min_data_in_leaf": 20, "verbosity": -1,
+        "tree_growth": "leafwise",
+    }
+
+    def run(hist_method):
+        cfg = Config.from_dict({**base, "hist_method": hist_method})
+        gb = create_boosting(cfg, ds)
+        gb.train_iters(n_iters)
+        jax.device_get(gb._train_scores.score)
+        dt = 1e30
+        for _ in range(2):
+            t0 = time.time()
+            gb.train_iters(n_iters)
+            jax.device_get(gb._train_scores.score)
+            dt = min(dt, time.time() - t0)
+        text = model_to_string(
+            gb.materialize_host_trees(),
+            objective_string=_objective_string(cfg), num_class=1,
+            num_tree_per_iteration=1,
+            feature_names=list(ds.feature_names),
+            feature_infos=ds.feature_infos())
+        return gb, dt, text
+
+    gb_fu, fu_dt, fu_text = run("fused")
+    _, st_dt, st_text = run("pallas")
+    fields["fused_parity_ok"] = bool(fu_text == st_text)
+    fields["fused_M_row_trees_per_s"] = round(N * n_iters / fu_dt / 1e6, 3)
+    fields["fused_staged_pallas_M_row_trees_per_s"] = round(
+        N * n_iters / st_dt / 1e6, 3)
+
+    # ---- compiled-executable HBM accounting (cost_analysis bytes) ------
+    # own guard region: a backend that cannot lower (or cost-analyze)
+    # the round executables must not take the parity fields down with it
+    try:
+        fields.update(_fused_round_bytes(ds, N, backend, gb_fu))
+    except Exception as e:  # noqa: BLE001
+        fields["fused_bytes_error"] = f"{type(e).__name__}: {e}"[:200]
+    return fields
+
+
+def _fused_round_bytes(ds, N, backend, gb_fu):
+    import jax
+    import jax.numpy as jnp
+
+    from lightgbmv1_tpu.models.grower_wave import (auto_wave_size,
+                                                   subtract_child_hists)
+    from lightgbmv1_tpu.obs.xla import _extract_cost
+    from lightgbmv1_tpu.ops.histogram import hist_wave
+    from lightgbmv1_tpu.ops.split import NO_CONSTRAINT, find_best_split
+    from lightgbmv1_tpu.ops.wave_fused import make_fused_round
+
+    fields = {}
+    interp = backend == "cpu"
+    K = auto_wave_size(255)
+    B = 64
+    binned = jnp.asarray(ds.train_matrix)
+    F = binned.shape[0]
+    meta, params = gb_fu.meta, gb_fu.split_params
+    rng = np.random.RandomState(13)
+    g3 = jnp.asarray(rng.randn(N, 3).astype(np.float32))
+    label = jnp.asarray(rng.randint(0, K + 1, N).astype(np.int32))
+    parent = jnp.asarray(
+        np.abs(rng.randn(K, F, B, 3)).astype(np.float32)) * 4.0
+    sml = jnp.asarray(rng.rand(K) < 0.5)
+    csums = jnp.asarray(np.abs(rng.randn(2 * K, 3)).astype(np.float32))
+    mask = jnp.ones((2 * K, F), bool)
+    nc = jnp.asarray(NO_CONSTRAINT, jnp.float32)
+    ar = jnp.arange(K, dtype=jnp.int32)
+
+    def staged_round(g3_, parent_, sml_):
+        h = hist_wave(binned, g3_, label, K, B, method="pallas",
+                      precision="bf16x2", interpret=interp)
+        hist, _, _ = subtract_child_hists(h, parent_, ar, ar, sml_,
+                                          h_parent=parent_)
+        res = jax.vmap(lambda hh, ps: find_best_split(
+            hh, ps, meta, mask[0], params, nc, 1, 0.0, 0.0, None, None)
+        )(hist, csums)
+        return res.gain, res.feature, hist
+
+    fn = make_fused_round(meta=meta, params=params, num_bins=B,
+                          precision="bf16x2", deep_precision="bf16",
+                          interpret=interp)
+
+    def fused_round(g3_, parent_, sml_):
+        packed, hsm, _ = fn(binned, g3_, label, K, mask=mask,
+                            csums=csums, constr=jnp.tile(nc, (2 * K, 1)),
+                            depth=jnp.ones(2 * K, jnp.int32),
+                            pout=jnp.zeros(2 * K, jnp.float32),
+                            sml=sml_, parent=parent_)
+        # the per-leaf table update the grower still performs (the K
+        # smaller-child stack IS emitted); keep it in the accounting so
+        # the comparison prices the whole round fairly
+        hist, _, _ = subtract_child_hists(hsm, parent_, ar, ar, sml_,
+                                          h_parent=parent_)
+        return packed, hist
+
+    st_c = jax.jit(staged_round).lower(g3, parent, sml).compile()
+    fu_c = jax.jit(fused_round).lower(g3, parent, sml).compile()
+    _, st_bytes = _extract_cost(st_c)
+    _, fu_bytes = _extract_cost(fu_c)
+    if st_bytes and fu_bytes:
+        fields["staged_round_bytes_accessed"] = int(st_bytes)
+        fields["fused_round_bytes_accessed"] = int(fu_bytes)
+        fields["fused_hbm_bytes_saved_per_round"] = int(
+            st_bytes - fu_bytes)
+        # the analytic scan-stack size the fused path keeps on-chip
+        fields["fused_hbm_stack_bytes_analytic"] = int(
+            2 * K * F * B * 3 * 4)
+        # CPU smoke caveat: in interpret mode the kernel lowers to plain
+        # XLA ops with per-grid-step block copies, so the byte
+        # comparison does NOT reflect device behavior (it typically
+        # reads NEGATIVE there); the honest number is the device
+        # capture's, where the kernel is one custom call and the VMEM
+        # accumulator never appears in the byte accounting
+        if interp:
+            fields["fused_bytes_interpret_mode"] = True
+    return fields
+
+
+def measure_fused_round_ms(ds, N, gb_lw, schedule, hist_fields, backend):
+    """The fused wave round timed per slot bucket with the two-length
+    scan differential and priced over the REPLAYED round schedule —
+    ``hist_split_fused_ms_per_iter``, directly comparable to
+    ``phase_hist_ms + phase_split_ms`` (the staged root pass is added on
+    both sides of that comparison: the fused path keeps the staged root
+    histogram, so its cost rides this field via
+    ``hist_ms_per_pass_root``)."""
+    import jax
+    import jax.numpy as jnp
+
+    from lightgbmv1_tpu.models.grower_wave import (auto_wave_size,
+                                                   slot_buckets_for)
+    from lightgbmv1_tpu.ops.split import NO_CONSTRAINT
+    from lightgbmv1_tpu.ops.wave_fused import make_fused_round
+
+    B = 64
+    K = auto_wave_size(255)
+    BUCKETS = tuple(slot_buckets_for(K, N))
+    binned = jnp.asarray(ds.train_matrix)
+    F = binned.shape[0]
+    rng = np.random.RandomState(14)
+    g3 = jnp.asarray(rng.randn(N, 3).astype(np.float32))
+    nc = jnp.asarray(NO_CONSTRAINT, jnp.float32)
+    fn = make_fused_round(meta=gb_lw.meta, params=gb_lw.split_params,
+                          num_bins=B, precision="bf16x2",
+                          deep_precision="bf16",
+                          interpret=backend == "cpu")
+
+    def make_for(S):
+        label = jnp.asarray(rng.randint(0, S + 1, N).astype(np.int32))
+        parent = jnp.asarray(
+            np.abs(rng.randn(S, F, B, 3)).astype(np.float32)) * 4.0
+        sml = jnp.asarray(rng.rand(S) < 0.5)
+        csums = jnp.asarray(
+            np.abs(rng.randn(2 * S, 3)).astype(np.float32))
+        mask = jnp.ones((2 * S, F), bool)
+        deep = S == K and K >= 32 and len(BUCKETS) > 1
+
+        def make(r):
+            @jax.jit
+            def reps():
+                def body(c, i):
+                    g = g3 * (1.0 + 1e-6 * i.astype(jnp.float32))
+                    packed, hsm, _ = fn(
+                        binned, g, label, S, deep=deep, mask=mask,
+                        csums=csums, constr=jnp.tile(nc, (2 * S, 1)),
+                        depth=jnp.ones(2 * S, jnp.int32),
+                        pout=jnp.zeros(2 * S, jnp.float32),
+                        sml=sml, parent=parent)
+                    return c + packed.sum() + hsm.sum(), None
+                s, _ = jax.lax.scan(body, jnp.float32(0), jnp.arange(r))
+                return s
+            return reps
+        return make
+
+    pass_ms = {S: timed_per_rep(make_for(S), 4, 16) * 1e3
+               for S in BUCKETS}
+
+    def bucket_of(k):
+        for s in BUCKETS:
+            if k <= s:
+                return s
+        return K
+
+    rounds = schedule["schedule"]
+    iters = max(1, round(len(rounds) / schedule["rounds_per_tree"]))
+    root_ms = hist_fields.get("hist_ms_per_pass_root", 0.0)
+    per_iter = (sum(pass_ms[bucket_of(k)] for k in rounds) / iters
+                + root_ms)
+    out = {"hist_split_fused_ms_per_iter": round(per_iter, 2),
+           "fused_ms_per_pass": round(pass_ms[K], 2)}
+    for s in BUCKETS[:-1]:
+        out[f"fused_ms_per_pass_s{s}"] = round(pass_ms[s], 2)
+    return out
+
+
 def measure_predict(gb_lw, X):
     """Prediction throughput, file->file (VERDICT r5 #6) — the role of the
     reference CLI's ``task=predict`` (src/application/predictor.hpp):
@@ -1055,12 +1284,13 @@ def measure_obs(X, y, backend: str, phase_fields=None):
         if armed:
             trace.arm(ring_events=1 << 16)
             if phase_fields:
-                parts = {k[len("phase_"):-3]: phase_fields[k]
-                         for k in ("phase_hist_ms", "phase_partition_ms",
-                                   "phase_valid_route_ms", "phase_split_ms",
-                                   "phase_other_ms") if phase_fields.get(k)}
+                from tools.phase_attrib import phase_ms_from_fields
+
+                # the canonical phase list (tools/phase_attrib.py): a
+                # fused capture's merged hist+split row rides along
                 trace.set_phase_profile(
-                    parts, phase_fields.get("wave_rounds_per_tree"))
+                    phase_ms_from_fields(phase_fields),
+                    phase_fields.get("wave_rounds_per_tree"))
         else:
             trace.disarm()
         t0 = time.perf_counter()
@@ -1277,15 +1507,22 @@ def measure_obs(X, y, backend: str, phase_fields=None):
         # roofline join: measured phase ms x cost-analysis flops/bytes
         # against the same-session matmul peak (device captures only —
         # the CPU smoke has neither phase fields nor a peak)
-        if phase_fields and phase_fields.get("phase_hist_ms") is not None \
+        if phase_fields and (
+                phase_fields.get("phase_hist_ms") is not None
+                or phase_fields.get("phase_hist_split_fused_ms")
+                is not None) \
                 and phase_fields.get("device_matmul_peak_tf_s"):
-            from tools.phase_attrib import (roofline_attribution,
+            from tools.phase_attrib import (phase_ms_from_fields,
+                                            roofline_attribution,
                                             split_cost_by_ms)
 
-            pms = {k[len("phase_"):-len("_ms")]: phase_fields[k]
-                   for k in ("phase_hist_ms", "phase_partition_ms",
-                             "phase_split_ms", "phase_other_ms")
-                   if phase_fields.get(k)}
+            # canonical phase list (tools/phase_attrib.py): a fused
+            # capture's single merged hist+split phase gets its own
+            # labeled roofline row instead of pooling into phase_other
+            pms = phase_ms_from_fields(phase_fields)
+            pms.pop("valid_route", None)   # valid routing is not part of
+                                           # the compiled train step's
+                                           # cost analysis split
             cost = split_cost_by_ms(step.get("flops"),
                                     step.get("bytes_accessed"), pms)
             rl = roofline_attribution(
@@ -1510,6 +1747,17 @@ def main():
     except Exception as e:  # noqa: BLE001
         extra["precision_expt_error"] = f"{type(e).__name__}: {e}"[:200]
 
+    # ---- fused wave-round megakernel A/B (hist_method=fused, ISSUE 13) --
+    # Parity + throughput + compiled-executable HBM accounting on every
+    # backend (CPU rides the interpreter lane); the perf leg of fused_ok
+    # joins the device phase fields below.
+    try:
+        extra.update(measure_fused(ds, N, backend,
+                                   n_iters=min(lw_trees, 3)))
+    except Exception as e:  # noqa: BLE001 — partial records beat none
+        extra["fused_error"] = f"{type(e).__name__}: {e}"[:200]
+        extra["fused_parity_ok"] = False
+
     if backend != "cpu" and os.environ.get("BENCH_FULL", "1") == "1":
         schedule = None
         try:
@@ -1585,6 +1833,19 @@ def main():
                     extra["phase_split_ms"] - sbd.total_attributed(), 3)
         except Exception as e:  # noqa: BLE001
             extra["split_attrib_error"] = f"{type(e).__name__}: {e}"[:200]
+
+        # ---- fused wave round, measured (ISSUE 13): the merged
+        # hist+split pass per bucket priced over the replayed schedule —
+        # the number the fused_ok perf leg and bench_trend's 10% bar
+        # watch.  A capture training with hist_method=fused would carry
+        # this as its phase row (phase_hist_split_fused_ms,
+        # tools/phase_attrib.PHASE_MS_KEYS).
+        try:
+            if schedule:
+                extra.update(measure_fused_round_ms(
+                    ds, N, gb_lw, schedule, hist_fields, backend))
+        except Exception as e:  # noqa: BLE001
+            extra["fused_round_error"] = f"{type(e).__name__}: {e}"[:200]
 
         # DART per-iteration cost (fused single-dispatch iteration):
         # VERDICT r3 #7 asks this within ~2x of the scanned GBDT path
@@ -1781,6 +2042,21 @@ def main():
             extra["vs_ref_500iter"] = round(ref_500_wall_s / wall500, 4)
         except Exception as e:  # noqa: BLE001
             extra["northstar_error"] = f"{type(e).__name__}: {e}"[:200]
+
+    # ---- fused_ok (ISSUE 13): parity AND, on device, the measured
+    # fused round at or under the staged hist+split it replaces.  The
+    # staged path stays the default until a device capture lands this
+    # guard True with the ms comparison actually evaluated (a CPU
+    # capture proves parity only — the perf leg is trivially true
+    # there, like pipeline_ok).
+    fused_ms = extra.get("hist_split_fused_ms_per_iter")
+    staged_ms = ((extra.get("phase_hist_ms") or 0)
+                 + (extra.get("phase_split_ms") or 0))
+    extra["fused_ok"] = bool(
+        extra.get("fused_parity_ok")
+        and (backend == "cpu"
+             or (fused_ms is not None and staged_ms > 0
+                 and fused_ms <= staged_ms)))
 
     # Online-serving loadgen block (serve/ subsystem): runs on every
     # backend — the acceptance record for hot-swap-under-traffic and

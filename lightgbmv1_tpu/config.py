@@ -317,8 +317,18 @@ class Config:
                                    # exact sequential best-first order
     # auto: static pick, measured only for ambiguous shapes; bench: ALWAYS
     # time the applicable implementations at init and pick the winner
-    # (reference Dataset::GetShareStates, src/io/dataset.cpp:590-684)
-    hist_method: str = "auto"      # auto | bench | scatter | onehot | pallas
+    # (reference Dataset::GetShareStates, src/io/dataset.cpp:590-684).
+    # fused (OPT-IN until a device capture lands the `fused_ok` guard):
+    # wave rounds run the fused histogram+split Pallas megakernel
+    # (ops/wave_fused.py) — per-slot histograms accumulate in VMEM and
+    # the split scan runs in the SAME kernel invocation, so the
+    # (F, B, 3) histogram stack never round-trips HBM; trees are
+    # bit-identical to hist_method=pallas (interpret-mode pin,
+    # tests/test_wave_fused.py).  Ineligible configs (categorical,
+    # extra_trees, EFB/packed/int16 bins, row-sharded learners,
+    # non-wave growth, Mosaic lowering failure) fall back to the staged
+    # path with a logged reason (the fallback taxonomy, BASELINE.md).
+    hist_method: str = "auto"  # auto | bench | scatter | onehot | pallas | fused
     hist_dtype: str = "bf16x2"     # bf16 | bf16x2 | f32 | int8 (quantized) precision
     # histogram precision for the wave grower's SUSTAINED rounds (the
     # largest slot bucket of a big wave — deep-frontier rounds whose
@@ -679,6 +689,11 @@ class Config:
                 self.hist_method = "scatter"
             elif self.force_row_wise:
                 self.hist_method = "onehot"
+        if self.hist_method not in (
+                "auto", "bench", "scatter", "onehot", "pallas", "fused"):
+            raise ValueError(
+                f"hist_method={self.hist_method!r}: expected auto | bench "
+                "| scatter | onehot | pallas | fused")
         if self.data_parallel_collective not in (
                 "reduce_scatter", "allreduce"):
             raise ValueError(

@@ -140,7 +140,10 @@ class GBDT:
             self._host_matrix = train_set.train_matrix
             method = default_hist_method(config.hist_method,
                                          self._host_matrix.dtype)
+            # hist_method=fused scans unpacked uint8 bins in-kernel, so
+            # 4-bit packing would force the staged fallback — skip it
             if (self._bundle is None and method == "pallas"
+                    and config.hist_method != "fused"
                     and train_set.num_total_bin <= 16
                     and config.tree_learner != "feature"):
                 from ..ops.hist_pallas import pack4bit

@@ -675,6 +675,7 @@ def make_wave_grower(
     split_fn: Callable = None,
     sums_fn: Callable = None,
     bins_of_fn: Callable = None,
+    fused_round_fn: Callable = None,
 ):
     """Build the jittable ``grow(binned, g3, base_mask, key)`` function.
 
@@ -708,6 +709,22 @@ def make_wave_grower(
     tables with one coalesced scatter each (_PackedStore, default) or the
     legacy per-field scatters (_FieldStore); trees are bit-identical
     either way on the exact-fp32 histogram path.
+    ``fused_round_fn`` (ops/wave_fused.make_fused_round, wired by
+    parallel/trainer.py under ``hist_method=fused``): the wave rounds'
+    histogram pass + smaller-child subtraction + split scan collapse
+    into ONE fused kernel call per slot bucket — the kernel accumulates
+    the slot histograms in VMEM, subtracts the parent stack it reads as
+    an input, runs the staged scan's own stage functions on the VMEM
+    values and returns only the packed per-child SplitInfo (plus, in
+    subtraction mode, the smaller-child histograms the per-leaf state
+    scatter needs).  The staged ``hist_wave_fn`` still runs the root
+    pass, and ``hist_wave_quant_fn``'s PRESENCE still gates the int8sr
+    buckets — the fused path quantizes through the same
+    ``sr_quantize_g3`` stream, so the (iteration, round) determinism
+    contract and the root/ramp never-quantize rule are shared, not
+    re-implemented.  Trees are bit-identical to the staged path on the
+    same histogram arithmetic (tests/test_wave_fused.py pins this in
+    interpret mode).
     ``async_wave_pipeline`` (default on) software-pipelines the round
     loop: the per-leaf histogram-state scatter and the valid-row routing
     of round r are DEFERRED into a pending carry and applied at the
@@ -738,6 +755,9 @@ def make_wave_grower(
               if interaction_groups is not None else None)
     store = (_PackedStore if fused_bookkeeping else _FieldStore)(
         L, L1, W, use_mc, use_cat)
+    use_fused = fused_round_fn is not None
+    if use_fused:
+        from ..ops.wave_fused import unpack_children as _unpack_children
 
     # the default split accepts a per-child hist_scale (dequantize-aware
     # scan, ops/split.py), as do custom split_fns that declare
@@ -991,161 +1011,33 @@ def make_wave_grower(
             rkey = (jax.random.fold_in(key, 8_000_011 + st.num_leaves)
                     if quant_buckets else None)
 
-            # ---- decision + labeling + histogram, sliced to S slots -------
-            # One vectorized (S, N) decision pass (the analog of K
-            # DataPartition::Split scatters) + one (S+1)-slot histogram.
-            # ``round_pass(S)`` is traced per slot bucket; the round's
-            # n_split <= S splits are compacted to slots 0..n_split-1 via
-            # ``order`` (cumsum of valid — dense even when the intermediate-
-            # monotone deferral clears mid-prefix picks).
-            def round_pass(S):
-                sidx = jnp.where(valid, order_c, S)          # (K,) slot|drop
-
-                def to_slot(v, fill):
-                    base = jnp.full((S,) + v.shape[1:], fill, v.dtype)
-                    return base.at[sidx].set(v, mode="drop")
-
-                feats_s = to_slot(feats, 0)
-                thrs_s = to_slot(thrs, 0)
-                dls_s = to_slot(dls, False)
-                # empty slots carry leaf id L: matches no row's leaf
-                leafs_s = to_slot(leafs, L)
-                nls_s = to_slot(nls, 0)
-                sml_s = to_slot(sm_left, False)
-                iscats_s = to_slot(iscats, False) if use_cat else None
-                bitsets_s = to_slot(bitsets, 0) if use_cat else None
-
-                def go_left_s(matrix):
-                    """(S, rows) left-decision of this round's splits —
-                    shared by the train partition and valid routing."""
-                    mt_k = meta.missing_type[feats_s][:, None]
-                    bk = jax.vmap(lambda f: bins_of_fn(matrix, f))(feats_s)
-                    bk = bk.astype(jnp.int32)
-                    na = ((mt_k == MISSING_NAN)
-                          & (bk == meta.nan_bin[feats_s][:, None])) | (
-                        (mt_k == MISSING_ZERO)
-                        & (bk == meta.zero_bin[feats_s][:, None]))
-                    g = jnp.where(na, dls_s[:, None], bk <= thrs_s[:, None])
-                    if use_cat:  # categorical bitset membership (bin-space)
-                        word = jnp.zeros(bk.shape, jnp.uint32)
-                        for wv in range(W):
-                            word = jnp.where((bk >> 5) == wv,
-                                             bitsets_s[:, wv][:, None], word)
-                        in_set = ((word >> (bk.astype(jnp.uint32) & 31))
-                                  & 1) == 1
-                        g = jnp.where(iscats_s[:, None], in_set, g)
-                    return g
-
-                siota = jnp.arange(S, dtype=jnp.int32)
-                with jax.named_scope("lgbm.partition"):
-                    gl = go_left_s(binned)                    # (S, N)
-                    mine = st.leaf_id[None, :] == leafs_s[:, None]
-                    go_r = mine & (~gl)                       # disjoint rows
-                    leaf_id = st.leaf_id + jnp.sum(
-                        jnp.where(go_r, nls_s[:, None] - st.leaf_id[None, :],
-                                  0), axis=0)
-                    vl_new = []
-                    if not pipeline:
-                        # pipelined rounds defer valid routing to the next
-                        # body's drain (route_pending) — off this round's
-                        # critical path, bit-identical updates
-                        for vb, vl in zip(valids, st.valid_lids):
-                            gv = go_left_s(vb)
-                            mine_v = vl[None, :] == leafs_s[:, None]
-                            go_rv = mine_v & (~gv)
-                            vl_new.append(vl + jnp.sum(
-                                jnp.where(go_rv,
-                                          nls_s[:, None] - vl[None, :], 0),
-                                axis=0))
-                    if use_sub:
-                        # label only the SMALLER child of each split (known
-                        # up front from the recorded left/right counts)
-                        in_small = gl == sml_s[:, None]
-                        label = jnp.sum(
-                            jnp.where(mine & in_small, siota[:, None] - S, 0),
-                            axis=0) + S
-                    else:
-                        slot2 = 2 * siota[:, None] + (~gl).astype(jnp.int32)
-                        label = jnp.sum(jnp.where(mine, slot2 - 2 * S, 0),
-                                        axis=0) + 2 * S
-
-                # sustained rounds (the LARGEST bucket of a big wave) may
-                # run the configured cheaper deep precision; ramp rounds
-                # and the root pass always keep full precision.  With
-                # bucketing off (small N) there ARE no separate ramp
-                # variants — everything stays full precision
-                deep = S == K and K >= 32 and len(slot_buckets) > 1
-                nsl = S if use_sub else 2 * S
-                if S in quant_buckets:
-                    # stochastic-rounded int8 pass: integer histogram +
-                    # per-slot dequant scales, rounding stream keyed per
-                    # (tree, round)
-                    h, hsc = hist_wave_quant_fn(binned, g3, label, nsl,
-                                                rkey)
-                else:
-                    h = hist_wave_fn(binned, g3, label, nsl, deep=deep)
-                    hsc = jnp.ones((nsl, 3), jnp.float32)
-                full = 2 * K if not use_sub else K
-                if h.shape[0] < full:   # pad to the bucket-invariant width
-                    h = jnp.concatenate(
-                        [h, jnp.zeros((full - h.shape[0],) + h.shape[1:],
-                                      h.dtype)], axis=0)
-                    # padded slots dequantize as identity
-                    hsc = jnp.concatenate(
-                        [hsc, jnp.ones((full - hsc.shape[0], 3), hsc.dtype)],
-                        axis=0)
-                return (h, hsc, leaf_id) + tuple(vl_new)
-
-            if len(slot_buckets) > 1:
-                s_idx = jnp.zeros((), jnp.int32)
-                for S in slot_buckets[:-1]:
-                    s_idx = s_idx + (n_split > S).astype(jnp.int32)
-                outs = lax.switch(
-                    s_idx, [lambda S=S: round_pass(S) for S in slot_buckets])
-            else:
-                outs = round_pass(slot_buckets[0])
-            h_slot, hscale, leaf_id = outs[0], outs[1], outs[2]
-            new_vlids = vlids_in if pipeline else tuple(outs[3:])
-
-            cscale = None                   # per-child dequant (quant rounds)
-            if use_sub:
-                # ---- smaller-child histograms + subtraction --------------
-                # quant rounds fold the per-slot dequantization into the
-                # subtraction pass (slot_scale); non-quant rounds carry
-                # all-ones scales and skip the multiply entirely
-                h_parent = None
-                if pipeline:
-                    # value forwarding: gather the parents from the ONE-
-                    # ROUND-STALE table and patch rows whose slot was
-                    # (over)written by the pending commit — identical
-                    # values to a post-scatter gather, but the subtracted
-                    # sibling's split scan starts without waiting for the
-                    # drained scatter (or the partition) to complete
-                    h_parent = st.leaf_hist[leafs]
-                    match = leafs[:, None] == st.pending["cidx"][None, :]
-                    hit = jnp.any(match, axis=1)
-                    src = jnp.argmax(match, axis=1)
-                    h_parent = jnp.where(hit[:, None, None, None],
-                                         p_hist[src], h_parent)
-                hist, h_left, h_right = subtract_child_hists(
-                    h_slot, leaf_hist_in, leafs, order_c, sm_left,
-                    slot_scale=hscale if quant_buckets else None,
-                    h_parent=h_parent)
-            else:
-                ch_idx = jnp.stack([2 * order_c, 2 * order_c + 1],
-                                   axis=1).reshape(2 * K)
-                hist = h_slot[ch_idx]              # slot-order -> rank-order
-                if quant_buckets:
-                    # children come straight from the (possibly quantized)
-                    # pass: hand the split scan the integer histograms +
-                    # per-child scales (dequantize-aware scan) when the
-                    # split accepts them, else dequantize here
-                    cscale = hscale[ch_idx]                       # (2K, 3)
-                    if not takes_scale:
-                        hist = hist * cscale[:, None, None, :]
-                        cscale = None
+            # value-forwarded parent histogram rows, hoisted ahead of the
+            # slot-bucket switch: the staged subtraction and the fused
+            # kernel (which streams the parent stack as a kernel input)
+            # must read the SAME forwarded values
+            h_parent = None
+            if use_sub and pipeline:
+                # value forwarding: gather the parents from the ONE-
+                # ROUND-STALE table and patch rows whose slot was
+                # (over)written by the pending commit — identical
+                # values to a post-scatter gather, but the subtracted
+                # sibling's split scan starts without waiting for the
+                # drained scatter (or the partition) to complete
+                h_parent = st.leaf_hist[leafs]
+                match = leafs[:, None] == st.pending["cidx"][None, :]
+                hit = jnp.any(match, axis=1)
+                src = jnp.argmax(match, axis=1)
+                h_parent = jnp.where(hit[:, None, None, None],
+                                     p_hist[src], h_parent)
+            elif use_fused and use_sub:
+                h_parent = leaf_hist_in[leafs]
 
             # ---- children metadata --------------------------------------
+            # Hoisted ahead of the histogram dispatch (it depends only on
+            # the store read): the fused kernel consumes the per-child
+            # masks/constraints/outputs INSIDE its scan, so they must
+            # exist before the slot-bucket switch; the staged split reads
+            # the identical values after it.
             cleafs = jnp.stack([leafs, nls], axis=1).reshape(2 * K)
             csums = jnp.stack([lsums, rsums], axis=1).reshape(2 * K, 3)
             if use_inter:
@@ -1243,8 +1135,220 @@ def make_wave_grower(
                 box_l = pbox.at[kio, feats, 1].set(cut)
                 cut_lo = jnp.where(iscats, pbox[kio, feats, 0], thrs + 1)
                 box_r = pbox.at[kio, feats, 0].set(cut_lo)
+
+            # ---- decision + labeling + histogram, sliced to S slots -------
+            # One vectorized (S, N) decision pass (the analog of K
+            # DataPartition::Split scatters) + one (S+1)-slot histogram.
+            # ``round_pass(S)`` is traced per slot bucket; the round's
+            # n_split <= S splits are compacted to slots 0..n_split-1 via
+            # ``order`` (cumsum of valid — dense even when the intermediate-
+            # monotone deferral clears mid-prefix picks).
+            def round_pass(S):
+                sidx = jnp.where(valid, order_c, S)          # (K,) slot|drop
+
+                def to_slot(v, fill):
+                    base = jnp.full((S,) + v.shape[1:], fill, v.dtype)
+                    return base.at[sidx].set(v, mode="drop")
+
+                feats_s = to_slot(feats, 0)
+                thrs_s = to_slot(thrs, 0)
+                dls_s = to_slot(dls, False)
+                # empty slots carry leaf id L: matches no row's leaf
+                leafs_s = to_slot(leafs, L)
+                nls_s = to_slot(nls, 0)
+                sml_s = to_slot(sm_left, False)
+                iscats_s = to_slot(iscats, False) if use_cat else None
+                bitsets_s = to_slot(bitsets, 0) if use_cat else None
+
+                def go_left_s(matrix):
+                    """(S, rows) left-decision of this round's splits —
+                    shared by the train partition and valid routing."""
+                    mt_k = meta.missing_type[feats_s][:, None]
+                    bk = jax.vmap(lambda f: bins_of_fn(matrix, f))(feats_s)
+                    bk = bk.astype(jnp.int32)
+                    na = ((mt_k == MISSING_NAN)
+                          & (bk == meta.nan_bin[feats_s][:, None])) | (
+                        (mt_k == MISSING_ZERO)
+                        & (bk == meta.zero_bin[feats_s][:, None]))
+                    g = jnp.where(na, dls_s[:, None], bk <= thrs_s[:, None])
+                    if use_cat:  # categorical bitset membership (bin-space)
+                        word = jnp.zeros(bk.shape, jnp.uint32)
+                        for wv in range(W):
+                            word = jnp.where((bk >> 5) == wv,
+                                             bitsets_s[:, wv][:, None], word)
+                        in_set = ((word >> (bk.astype(jnp.uint32) & 31))
+                                  & 1) == 1
+                        g = jnp.where(iscats_s[:, None], in_set, g)
+                    return g
+
+                siota = jnp.arange(S, dtype=jnp.int32)
+                with jax.named_scope("lgbm.partition"):
+                    gl = go_left_s(binned)                    # (S, N)
+                    mine = st.leaf_id[None, :] == leafs_s[:, None]
+                    go_r = mine & (~gl)                       # disjoint rows
+                    leaf_id = st.leaf_id + jnp.sum(
+                        jnp.where(go_r, nls_s[:, None] - st.leaf_id[None, :],
+                                  0), axis=0)
+                    vl_new = []
+                    if not pipeline:
+                        # pipelined rounds defer valid routing to the next
+                        # body's drain (route_pending) — off this round's
+                        # critical path, bit-identical updates
+                        for vb, vl in zip(valids, st.valid_lids):
+                            gv = go_left_s(vb)
+                            mine_v = vl[None, :] == leafs_s[:, None]
+                            go_rv = mine_v & (~gv)
+                            vl_new.append(vl + jnp.sum(
+                                jnp.where(go_rv,
+                                          nls_s[:, None] - vl[None, :], 0),
+                                axis=0))
+                    if use_sub:
+                        # label only the SMALLER child of each split (known
+                        # up front from the recorded left/right counts)
+                        in_small = gl == sml_s[:, None]
+                        label = jnp.sum(
+                            jnp.where(mine & in_small, siota[:, None] - S, 0),
+                            axis=0) + S
+                    else:
+                        slot2 = 2 * siota[:, None] + (~gl).astype(jnp.int32)
+                        label = jnp.sum(jnp.where(mine, slot2 - 2 * S, 0),
+                                        axis=0) + 2 * S
+
+                # sustained rounds (the LARGEST bucket of a big wave) may
+                # run the configured cheaper deep precision; ramp rounds
+                # and the root pass always keep full precision.  With
+                # bucketing off (small N) there ARE no separate ramp
+                # variants — everything stays full precision
+                deep = S == K and K >= 32 and len(slot_buckets) > 1
+                nsl = S if use_sub else 2 * S
+                if use_fused:
+                    # ---- fused megakernel round: histogram + subtraction
+                    # + split scan in ONE Pallas pass (ops/wave_fused.py).
+                    # The per-child scan parameters are slot-compacted
+                    # exactly like the slot arrays above (child 2s+lr of
+                    # rank k with order_c[k] == s); dead ranks drop.
+                    csidx = (2 * sidx[:, None]
+                             + jnp.arange(2, dtype=jnp.int32)[None, :]
+                             ).reshape(2 * K)
+
+                    def to_cslot(v, fill):
+                        base = jnp.full((2 * S,) + v.shape[1:], fill,
+                                        v.dtype)
+                        return base.at[csidx].set(v, mode="drop")
+
+                    pr = None
+                    if use_sub:
+                        pr = jnp.zeros((S,) + h_parent.shape[1:],
+                                       jnp.float32) \
+                            .at[sidx].set(h_parent, mode="drop")
+                    packed, h_sm, hsc = fused_round_fn(
+                        binned, g3, label, S, deep=deep,
+                        quant_key=rkey if S in quant_buckets else None,
+                        scaled=bool(quant_buckets),
+                        mask=to_cslot(cmask, False),
+                        csums=to_cslot(csums, 1.0),
+                        constr=to_cslot(cconstr, 0.0),
+                        depth=to_cslot(cdepth, 1),
+                        pout=to_cslot(couts, 0.0),
+                        sml=sml_s if use_sub else None,
+                        parent=pr)
+                    if S < K:   # pad to the bucket-invariant width
+                        packed = jnp.pad(packed,
+                                         ((0, 2 * (K - S)), (0, 0)))
+                    if not use_sub:
+                        return (packed, leaf_id) + tuple(vl_new)
+                    if S < K:
+                        h_sm = jnp.pad(
+                            h_sm, ((0, K - S),) + ((0, 0),) * 3)
+                        hsc = jnp.concatenate(
+                            [hsc, jnp.ones((K - S, 3), hsc.dtype)],
+                            axis=0)
+                    return (packed, h_sm, hsc, leaf_id) + tuple(vl_new)
+                if S in quant_buckets:
+                    # stochastic-rounded int8 pass: integer histogram +
+                    # per-slot dequant scales, rounding stream keyed per
+                    # (tree, round)
+                    h, hsc = hist_wave_quant_fn(binned, g3, label, nsl,
+                                                rkey)
+                else:
+                    h = hist_wave_fn(binned, g3, label, nsl, deep=deep)
+                    hsc = jnp.ones((nsl, 3), jnp.float32)
+                full = 2 * K if not use_sub else K
+                if h.shape[0] < full:   # pad to the bucket-invariant width
+                    h = jnp.concatenate(
+                        [h, jnp.zeros((full - h.shape[0],) + h.shape[1:],
+                                      h.dtype)], axis=0)
+                    # padded slots dequantize as identity
+                    hsc = jnp.concatenate(
+                        [hsc, jnp.ones((full - hsc.shape[0], 3), hsc.dtype)],
+                        axis=0)
+                return (h, hsc, leaf_id) + tuple(vl_new)
+
+            if len(slot_buckets) > 1:
+                s_idx = jnp.zeros((), jnp.int32)
+                for S in slot_buckets[:-1]:
+                    s_idx = s_idx + (n_split > S).astype(jnp.int32)
+                outs = lax.switch(
+                    s_idx, [lambda S=S: round_pass(S) for S in slot_buckets])
+            else:
+                outs = round_pass(slot_buckets[0])
+            if use_fused:
+                if use_sub:
+                    packed, h_slot, hscale, leaf_id = outs[:4]
+                    tail = outs[4:]
+                else:
+                    packed, leaf_id = outs[:2]
+                    h_slot = hscale = None
+                    tail = outs[2:]
+                new_vlids = vlids_in if pipeline else tuple(tail)
+            else:
+                h_slot, hscale, leaf_id = outs[0], outs[1], outs[2]
+                new_vlids = vlids_in if pipeline else tuple(outs[3:])
+
+            cscale = None                   # per-child dequant (quant rounds)
+            if use_fused:
+                # the kernel already scanned the children in VMEM; what
+                # remains is the per-leaf table bookkeeping (subtraction
+                # mode: the SAME subtract the kernel ran, recomputed on
+                # the emitted smaller-child stack for the state scatter)
+                # and the slot->rank gather of the packed SplitInfo
+                if use_sub:
+                    hist, h_left, h_right = subtract_child_hists(
+                        h_slot, leaf_hist_in, leafs, order_c, sm_left,
+                        slot_scale=hscale if quant_buckets else None,
+                        h_parent=h_parent)
+                ch_idx = jnp.stack([2 * order_c, 2 * order_c + 1],
+                                   axis=1).reshape(2 * K)
+                res = _unpack_children(packed[ch_idx], B)
+            elif use_sub:
+                # ---- smaller-child histograms + subtraction --------------
+                # quant rounds fold the per-slot dequantization into the
+                # subtraction pass (slot_scale); non-quant rounds carry
+                # all-ones scales and skip the multiply entirely
+                hist, h_left, h_right = subtract_child_hists(
+                    h_slot, leaf_hist_in, leafs, order_c, sm_left,
+                    slot_scale=hscale if quant_buckets else None,
+                    h_parent=h_parent)
+            else:
+                ch_idx = jnp.stack([2 * order_c, 2 * order_c + 1],
+                                   axis=1).reshape(2 * K)
+                hist = h_slot[ch_idx]              # slot-order -> rank-order
+                if quant_buckets:
+                    # children come straight from the (possibly quantized)
+                    # pass: hand the split scan the integer histograms +
+                    # per-child scales (dequantize-aware scan) when the
+                    # split accepts them, else dequantize here
+                    cscale = hscale[ch_idx]                       # (2K, 3)
+                    if not takes_scale:
+                        hist = hist * cscale[:, None, None, :]
+                        cscale = None
+
             # ---- batched split finding over the 2K children ---------------
-            if cscale is not None:
+            # (fused rounds already hold `res` — the kernel's packed
+            # SplitInfo — and never route through split_fn)
+            if use_fused:
+                pass
+            elif cscale is not None:
                 # dequantize-aware scan: integer histograms + per-child
                 # scales go straight into the gain cumsum (ops/split.py)
                 res = jax.vmap(

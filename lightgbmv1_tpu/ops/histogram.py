@@ -160,6 +160,7 @@ def hist_one_leaf(
     precision: str = "bf16x2",
     packed: bool = False,
     num_features: int = 0,
+    interpret: bool = False,
 ) -> jax.Array:             # (F, B, 3)
     """Histogram over the rows currently in ``target_leaf`` only — the
     smaller-child pass of the histogram-subtraction trick (reference:
@@ -172,9 +173,12 @@ def hist_one_leaf(
         if method == "pallas":
             from .hist_pallas import hist_leaves_pallas
 
+            # forward interpret only when SET: callers (and tests) may
+            # bind it on hist_leaves_pallas itself via functools.partial
+            kw = {"interpret": True} if interpret else {}
             return hist_leaves_pallas(binned, g3m, zeros, 1, num_bins,
                                       precision=precision, packed=packed,
-                                      num_features=num_features)[0]
+                                      num_features=num_features, **kw)[0]
         if packed:
             raise ValueError(
                 "4-bit packed bins require the pallas hist method")
@@ -275,8 +279,13 @@ def hist_frontier(
     precision: str = "bf16x2",
     packed: bool = False,
     num_features: int = 0,
+    interpret: bool = False,
 ) -> jax.Array:
     """All-leaves histogram in a single pass (level-wise grower).
+
+    ``interpret`` reaches the Pallas kernel only: the CPU backend runs
+    ``hist_method=pallas`` through the interpreter — the bit-parity lane
+    the fused wave-round kernel (ops/wave_fused.py) is pinned against.
 
     Wrapped in ``jax.named_scope`` so device traces attribute histogram
     time the way the reference's USE_TIMETAG FunctionTimer tags host time
@@ -285,10 +294,12 @@ def hist_frontier(
         if method == "pallas":
             from .hist_pallas import hist_leaves_pallas
 
+            # forward interpret only when SET (see hist_one_leaf)
+            kw = {"interpret": True} if interpret else {}
             return hist_leaves_pallas(binned, g3, leaf_id, num_leaves,
                                       num_bins, precision=precision,
                                       packed=packed,
-                                      num_features=num_features)
+                                      num_features=num_features, **kw)
         if packed:
             raise ValueError(
                 "4-bit packed bins require the pallas hist method")
@@ -308,6 +319,7 @@ def hist_wave(
     precision: str = "bf16x2",
     packed: bool = False,
     num_features: int = 0,
+    interpret: bool = False,
 ) -> jax.Array:             # (nslots, F, B, 3)
     """Histograms of the rows labeled ``0..nslots-1`` in one pass; rows
     labeled ``nslots`` (not part of the current wave) contribute nothing.
@@ -315,7 +327,8 @@ def hist_wave(
     sacrificial slot absorbs the dead rows, then is sliced away."""
     return hist_frontier(binned, g3, label, nslots + 1, num_bins,
                          method=method, precision=precision,
-                         packed=packed, num_features=num_features)[:nslots]
+                         packed=packed, num_features=num_features,
+                         interpret=interpret)[:nslots]
 
 
 def hist_wave_quant(
@@ -329,6 +342,7 @@ def hist_wave_quant(
     packed: bool = False,
     num_features: int = 0,
     axis_name=None,
+    interpret: bool = False,
 ):
     """Stochastic-rounded int8 wave histogram: quantize the gradient rows
     (ops/quantize.sr_quantize_g3 — deterministic counter-based rounding
@@ -359,7 +373,7 @@ def hist_wave_quant(
         prec = "int8sr" if method == "pallas" else "f32"
         h = hist_wave(binned, q3, label, nslots, num_bins, method=method,
                       precision=prec, packed=packed,
-                      num_features=num_features)
+                      num_features=num_features, interpret=interpret)
         return h, scales
 
 
@@ -372,7 +386,19 @@ def default_hist_method(config_method: str = "auto",
     debug comparator, gpu_tree_learner.cpp:71-98).  int16-binned data
     (num_bins > 256) routes to the XLA one-hot path — the Pallas kernel is
     uint8-only (see hist_pallas.hist_leaves_pallas).
+
+    ``"fused"`` (the wave-round megakernel, ops/wave_fused.py) resolves to
+    its BASE method here — the implementation every non-fused pass (root
+    pass, sequential/level-wise growers, streaming) runs: the same
+    ``pallas`` arithmetic the fused kernel reuses, which is what makes
+    ``hist_method=fused`` trees bit-comparable to ``hist_method=pallas``
+    trees; int16 bins exclude the whole kernel family.  The fused
+    wave-round dispatch itself lives in parallel/trainer.py.
     """
+    if config_method == "fused":
+        if bin_dtype is not None and jnp.dtype(bin_dtype).itemsize > 1:
+            return "onehot"
+        return "pallas"
     if config_method not in ("auto", "bench"):
         return config_method
     platform = jax.default_backend()

@@ -477,10 +477,27 @@ def scan_left_sums(hist, meta, hist_scale=None):
     return jnp.stack([left_a, left_b]), hist          # (2, F, B, 3)
 
 
+def gain_shift(parent_sum, parent_output, params):
+    """The gain baseline every candidate is differenced against: parent
+    gain (at the smoothed current output when path smoothing is on) plus
+    ``min_gain_to_split``.  One function so the staged scan
+    (:func:`scan_direction_gains`) and the fused wave-round kernel's
+    outside-the-kernel tie band (ops/wave_fused.py) cannot drift."""
+    total_g, total_h = parent_sum[0], parent_sum[1]
+    if params.path_smooth > 0:
+        # reference: with smoothing the gain shift is the leaf's gain AT
+        # its current (already-smoothed) output value
+        parent_gain = leaf_gain_given_output(total_g, total_h,
+                                             parent_output, params)
+    else:
+        parent_gain = leaf_gain(total_g, total_h, params)
+    return parent_gain + params.min_gain_to_split
+
+
 def scan_direction_gains(left2, parent_sum, meta, feature_mask, params,
                          constraint=None, depth=0, monotone_penalty=0.0,
                          parent_output=0.0, rand_key=None,
-                         cegb_penalty=None):
+                         cegb_penalty=None, use_mc=None):
     """Phase 2 of the fused split scan: gains of every (direction,
     feature, bin) candidate in ONE stacked evaluation over the
     ``(2, F, B, 3)`` left sums from :func:`scan_left_sums` — the gain
@@ -488,12 +505,19 @@ def scan_direction_gains(left2, parent_sum, meta, feature_mask, params,
     doubled tensor instead of once per direction, so the whole
     cumsum → gain chain lowers as a single fused pass.
 
+    ``use_mc`` overrides the monotone-constraint probe for callers whose
+    ``meta`` arrays are traced values (the fused wave-round kernel reads
+    its per-feature-block meta slices from kernel refs, where the
+    ``np.asarray`` probe below cannot run); ``None`` derives it from the
+    concrete meta as before.
+
     Returns ``(gains (2, F, B), shift)`` with gains RELATIVE (shift =
     parent gain + min_gain_to_split already subtracted) and every
     penalty applied.  Module-level for tools/phase_attrib.py."""
     _, F, B, _ = left2.shape
     total_g, total_h, total_c = parent_sum[0], parent_sum[1], parent_sum[2]
-    use_mc = bool(np.asarray(meta.monotone_type).any())
+    if use_mc is None:
+        use_mc = bool(np.asarray(meta.monotone_type).any())
     use_smooth = params.path_smooth > 0
     if constraint is None:
         constraint = jnp.asarray(NO_CONSTRAINT, jnp.float32)
@@ -551,14 +575,7 @@ def scan_direction_gains(left2, parent_sum, meta, feature_mask, params,
     valid2 = jnp.stack([base_valid, base_valid & has_miss_dir])
     gains2 = jnp.where(valid2, eval_direction(left2), NEG_INF)
 
-    if use_smooth:
-        # reference: with smoothing the gain shift is the leaf's gain AT its
-        # current (already-smoothed) output value
-        parent_gain = leaf_gain_given_output(total_g, total_h,
-                                             parent_output, params)
-    else:
-        parent_gain = leaf_gain(total_g, total_h, params)
-    shift = parent_gain + params.min_gain_to_split
+    shift = gain_shift(parent_sum, parent_output, params)
 
     # Work in RELATIVE gains from here on — the reference's output->gain is
     # best_gain - min_gain_shift, and every penalty below operates on that
@@ -581,6 +598,37 @@ def scan_direction_gains(left2, parent_sum, meta, feature_mask, params,
     return gains, shift
 
 
+def scan_pick_feature(gains, shift, meta):
+    """Per-feature stage of the tie-band preference argmax: each
+    feature's best candidate gain over its ``2B`` (direction, bin) slots
+    plus the preferred in-band candidate index.  Returns
+    ``(fbest (F,), sel_f (F,))`` with ``sel_f`` encoding
+    ``direction * B + threshold``.
+
+    Split out of :func:`scan_pick` so the fused wave-round kernel
+    (ops/wave_fused.py) can run EXACTLY this reduction per feature block
+    in VMEM and emit only the O(F) residue — the cross-feature band
+    needs the global best, so that half stays outside the kernel — while
+    the staged path composes the same code object."""
+    _, F, B = gains.shape
+    t_idx = lax.broadcasted_iota(jnp.int32, (F, B), 1)
+    rev_like_a = ((meta.missing_type == MISSING_NONE)
+                  | (meta.num_bins <= 2))[:, None]        # (F, 1)
+    pref_a = jnp.where(rev_like_a, 2 * B + t_idx, B - 1 - t_idx)
+    pref_b = jnp.broadcast_to(2 * B + t_idx, (F, B))
+    gains_f = jnp.concatenate([gains[0], gains[1]], axis=1)   # (F, 2B)
+    pref_f = jnp.concatenate([pref_a, pref_b], axis=1)        # (F, 2B)
+    fbest = gains_f.max(axis=1)                               # (F,)
+    # near-tie band (tie_tol above): every candidate within the band of
+    # its feature's best competes on the deterministic preference order
+    # alone, so reduction-order ulp noise cannot flip the pick
+    tol_f = tie_tol(fbest, shift)                             # (F,)
+    sel_f = jnp.argmax(
+        jnp.where(gains_f >= (fbest - tol_f)[:, None], pref_f, -1),
+        axis=1)                                               # (F,)
+    return fbest, sel_f
+
+
 def scan_pick(gains, shift, meta):
     """Phase 3 of the fused split scan: the tie-band preference argmax.
 
@@ -598,21 +646,8 @@ def scan_pick(gains, shift, meta):
     Returns ``(best_gain, feature, threshold, direction)``.  Module-level
     for tools/phase_attrib.py."""
     _, F, B = gains.shape
-    t_idx = lax.broadcasted_iota(jnp.int32, (F, B), 1)
-    rev_like_a = ((meta.missing_type == MISSING_NONE)
-                  | (meta.num_bins <= 2))[:, None]        # (F, 1)
-    pref_a = jnp.where(rev_like_a, 2 * B + t_idx, B - 1 - t_idx)
-    pref_b = jnp.broadcast_to(2 * B + t_idx, (F, B))
+    fbest, sel_f = scan_pick_feature(gains, shift, meta)
     gains_f = jnp.concatenate([gains[0], gains[1]], axis=1)   # (F, 2B)
-    pref_f = jnp.concatenate([pref_a, pref_b], axis=1)        # (F, 2B)
-    fbest = gains_f.max(axis=1)                               # (F,)
-    # near-tie band (tie_tol above): every candidate within the band of
-    # its feature's best competes on the deterministic preference order
-    # alone, so reduction-order ulp noise cannot flip the pick
-    tol_f = tie_tol(fbest, shift)                             # (F,)
-    sel_f = jnp.argmax(
-        jnp.where(gains_f >= (fbest - tol_f)[:, None], pref_f, -1),
-        axis=1)                                               # (F,)
     gbest = jnp.max(fbest)
     feature = jnp.argmax(fbest >= gbest - tie_tol(gbest, shift)) \
         .astype(jnp.int32)                   # first in band = min feature

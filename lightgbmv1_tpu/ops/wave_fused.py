@@ -1,0 +1,514 @@
+"""Fused wave-round megakernel: histogram + split scan in ONE Pallas pass.
+
+The staged wave round (the r05 phase table) is a pure-bandwidth
+round-trip: ``hist_pallas`` writes the ``(slots, F, B, 3)`` histogram
+stack to HBM, ``models/grower_wave.subtract_child_hists`` reads it back
+to build the 2K-child stack, and ``ops/split.py``'s scan streams that
+stack in again — three traversals of a tensor that is consumed exactly
+once.  This kernel keeps the round's histograms in VMEM end to end:
+
+* the row-tile grid REUSES ``hist_pallas._kernel`` verbatim (the one-hot
+  MXU formulation with its bf16 / bf16x2 / int8 / int8sr precision
+  modes) to accumulate each wave slot's histogram into a VMEM scratch
+  accumulator,
+* on the LAST row tile the same kernel invocation runs the split scan on
+  the VMEM-resident stack: the smaller-child-subtraction path reads the
+  parent histograms as a kernel input and subtracts in VMEM before
+  scanning (the int8sr dequantize multiply folded in), then the staged
+  scan's own stages — ``scan_left_sums`` (stacked two-direction cumsum +
+  missing-mass adjust), ``scan_direction_gains`` (gain/penalty chain)
+  and ``scan_pick_feature`` (tie-band preference argmax, per-feature
+  half) — are composed AS THE SAME CODE OBJECTS on the VMEM values, so
+  interpret-mode results are bit-identical to the staged path by
+  construction, not by re-derivation,
+* only an O(F) per-(child, feature) residue (best gain, in-band pick,
+  left sums at the pick — ``RES_COLS`` floats per feature) leaves the
+  kernel; the grid iterates feature blocks and the cross-feature half of
+  ``scan_pick`` runs on the concatenated residue outside the kernel.
+  The tie band needs the GLOBAL best gain, so a running in-VMEM
+  reduction across feature blocks could mis-pick inside overlapping
+  near-tie bands; reducing to the O(F) residue in VMEM and finishing the
+  O(F) argmax outside keeps bit-exactness while still shrinking the
+  kernel's HBM output from O(F·B) histograms to O(F) floats,
+* the packed per-slot SplitInfo (``PACK_COLS`` floats per child) is all
+  the round emits in pool-free mode; the subtraction-composed mode also
+  emits the K smaller-child histograms (the per-leaf state the NEXT
+  round's subtraction needs) — the ``(2K, F, B, 3)`` scan stack itself
+  never materializes off-chip in either mode.
+
+Fallback taxonomy (every gate logs once at build time,
+parallel/trainer.py):
+
+* categorical features — the sorted two-direction categorical scan
+  (``_best_categorical``) argsorts per feature, which has no Mosaic
+  lowering; such datasets run the staged path,
+* ``extra_trees`` — per-node threshold sampling draws ``jax.random``
+  inside the scan,
+* EFB bundles / 4-bit packed bins / int16 bins — the scan runs in
+  original-feature uint8 bin space only,
+* row-sharded learners (``tree_learner=data``/``voting``) — the
+  cross-shard histogram reduce needs the explicit histogram on the wire;
+  the feature-parallel learner DOES run the kernel per feature slice and
+  elects through the existing ``_sync_best_split``,
+* Mosaic lowering failure on a device backend — auto-fallback with a
+  warning, the ``predict_pallas`` precedent; the CPU backend always runs
+  the kernel in interpret mode (the bit-parity lane the tests pin).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ..io.binning import MISSING_NAN, MISSING_ZERO
+from .hist_pallas import MAX_LANES, _kernel as _hist_tile, _row_tile_for
+from .split import (
+    NEG_INF,
+    FeatureMeta,
+    SplitResult,
+    gain_shift,
+    scan_direction_gains,
+    scan_left_sums,
+    scan_pick_feature,
+    tie_tol,
+)
+
+RES_COLS = 6    # fbest, gain_at_sel, sel (direction*B+thr), left g/h/c
+PACK_COLS = 10  # gain, feature, threshold, default_left, left(3), right(3)
+
+
+def _fused_kernel(*refs, nrt, lpad, num_bins, fblk, precision, interpret,
+                  params, use_mc, monotone_penalty, has_contri, sub,
+                  apply_scale, child_scale, nslots, nchildren):
+    """Grid ``(1, row_tiles)``: every tile accumulates its rows via the
+    REUSED ``hist_pallas._kernel``; the last tile runs the split scan on
+    the VMEM accumulator and writes the per-feature residue (plus, in
+    subtraction mode, the raw smaller-child histograms)."""
+    names = ["iota", "bins", "g3", "leaf",
+             "nb", "mt", "nanb", "zb", "usbl", "mono"]
+    if has_contri:
+        names.append("contri")
+    names += ["mask", "csums", "constr", "depth", "pout"]
+    if child_scale:
+        names.append("cscale")
+    if sub and apply_scale:
+        names.append("sscale")
+    if sub:
+        names += ["sml", "parent"]
+    names.append("res")
+    if sub:
+        names.append("hsmall")
+    names.append("acc")
+    r = dict(zip(names, refs))
+
+    _hist_tile(r["iota"], r["bins"], r["g3"], r["leaf"], r["acc"],
+               lpad=lpad, num_bins=num_bins, fblk=fblk,
+               precision=precision, interpret=interpret)
+
+    rt = pl.program_id(1)
+    B = num_bins
+
+    @pl.when(rt == nrt - 1)
+    def _scan():
+        # accumulator rows are (slot-major, channel-minor), lanes are
+        # (bin-major, feature-minor) — the same unscramble
+        # hist_leaves_pallas applies outside, here on VMEM values
+        acc = r["acc"][0]                               # (3*lpad, B*fblk)
+        h = acc.reshape(lpad, 3, B, fblk).transpose(0, 3, 2, 1)
+        meta_blk = FeatureMeta(
+            num_bins=r["nb"][...][0],
+            missing_type=r["mt"][...][0],
+            nan_bin=r["nanb"][...][0],
+            zero_bin=r["zb"][...][0],
+            is_categorical=jnp.zeros(fblk, bool),
+            usable=r["usbl"][...][0] != 0,
+            monotone_type=r["mono"][...][0],
+            contri=(r["contri"][...][0] if has_contri else None),
+        )
+        if sub:
+            # smaller-child + parent subtraction IN VMEM — the exact op
+            # order of subtract_child_hists (dequant multiply first, then
+            # the smaller/larger select), so values are bit-identical
+            hsm = h[:nslots]                            # (S, fblk, B, 3)
+            r["hsmall"][...] = hsm                      # raw (int on quant)
+            if apply_scale:
+                hsm = hsm * r["sscale"][...][:, None, None, :]
+            sml = (r["sml"][...][:, 0] != 0)[:, None, None, None]
+            parent = r["parent"][...]
+            h_left = jnp.where(sml, hsm, parent - hsm)
+            h_right = parent - h_left
+            ch = jnp.stack([h_left, h_right], axis=1).reshape(
+                (2 * nslots,) + h_left.shape[1:])       # (2S, fblk, B, 3)
+        else:
+            ch = h[:nchildren]
+
+        mask = r["mask"][...] != 0                      # (C, fblk)
+        csums = r["csums"][...]
+        constr = r["constr"][...]
+        depth = r["depth"][...][:, 0]
+        pout = r["pout"][...][:, 0]
+        cscale = (r["cscale"][...] if child_scale
+                  else jnp.zeros((nchildren, 3), jnp.float32))
+
+        def child_scan(hc, mask_c, csum_c, constr_c, depth_c, pout_c,
+                       hsc_c):
+            # the staged scan's OWN stages on the VMEM stack
+            left2, _ = scan_left_sums(
+                hc, meta_blk, hsc_c if child_scale else None)
+            gains, shift = scan_direction_gains(
+                left2, csum_c, meta_blk, mask_c, params, constr_c,
+                depth_c, monotone_penalty, pout_c, None, None,
+                use_mc=use_mc)
+            fbest, sel = scan_pick_feature(gains, shift, meta_blk)
+            gains_f = jnp.concatenate([gains[0], gains[1]], axis=1)
+            gsel = jnp.take_along_axis(gains_f, sel[:, None],
+                                       axis=1)[:, 0]
+            lsel = left2[sel // B, jnp.arange(fblk), sel % B]  # (fblk, 3)
+            return jnp.concatenate(
+                [fbest[:, None], gsel[:, None],
+                 sel.astype(jnp.float32)[:, None], lsel], axis=1)
+
+        r["res"][...] = jax.vmap(child_scan)(
+            ch, mask, csums, constr, depth, pout, cscale)
+
+
+def fused_wave_scan(binned, g3, label, *, nslots, nchildren, num_bins,
+                    precision, interpret, meta, params, use_mc,
+                    monotone_penalty, mask, csums, constr, depth, pout,
+                    cscale=None, sscale=None, sml=None, parent=None,
+                    apply_scale=False, row_tile=0):
+    """One fused wave round over all feature blocks.
+
+    ``nslots`` counts the ACCUMULATED slots (smaller children in
+    subtraction mode, all 2S children pool-free); slot ``nslots`` is the
+    sacrificial dead-row slot, as in ``hist_wave``.  ``parent`` non-None
+    selects the subtraction-composed mode.  Returns ``(residue
+    (C, F, RES_COLS), hsmall (nslots, F, B, 3) or None)``.
+    """
+    sub = parent is not None
+    C = nchildren
+    F = mask.shape[1]
+    B = num_bins
+    N = binned.shape[1]
+    fblk = max(1, min(F, MAX_LANES // B))
+    nfb = -(-F // fblk)
+    f_pad = nfb * fblk
+    L = nslots + 1
+    lpad = -(-L // 8) * 8
+    m_pad = 3 * lpad
+    T = row_tile if row_tile > 0 else _row_tile_for(m_pad, fblk * B, B)
+    nrt = -(-N // T)
+    n_pad = nrt * T
+
+    # padding identical to hist_leaves_pallas: padded features collect
+    # bin 255 (no bin when B < 256; masked unusable below when B == 256),
+    # padded rows carry zero g3 and an out-of-range slot id
+    binned_rm = jnp.pad(binned, ((0, f_pad - F), (0, n_pad - N)),
+                        constant_values=255).T          # (n_pad, f_pad)
+    g3t = jnp.pad(g3.astype(jnp.float32), ((0, n_pad - N), (0, 0))).T
+    leaf_p = jnp.pad(label.astype(jnp.int32), (0, n_pad - N),
+                     constant_values=lpad)[None, :]
+    iota_bins = (jnp.arange(B * fblk, dtype=jnp.int32)
+                 // fblk).astype(jnp.float32)[None, :]
+
+    def padf(a, cv, dtype=jnp.int32):
+        return jnp.pad(a.astype(dtype), (0, f_pad - F),
+                       constant_values=cv)[None, :]
+
+    nb_p = padf(meta.num_bins, 1)
+    mt_p = padf(meta.missing_type, 0)
+    nanb_p = padf(meta.nan_bin, -1)
+    zb_p = padf(meta.zero_bin, 0)
+    us_p = padf(meta.usable, 0)
+    mono_p = padf(meta.monotone_type, 0)
+    has_contri = meta.contri is not None
+    contri_p = padf(meta.contri, 1.0, jnp.float32) if has_contri else None
+    mask_p = jnp.pad(mask.astype(jnp.int8), ((0, 0), (0, f_pad - F)))
+    parent_p = (jnp.pad(parent.astype(jnp.float32),
+                        ((0, 0), (0, f_pad - F), (0, 0), (0, 0)))
+                if sub else None)
+    csums2 = csums.astype(jnp.float32)
+    constr2 = constr.astype(jnp.float32)
+    depth2 = depth.astype(jnp.int32)[:, None]
+    pout2 = pout.astype(jnp.float32)[:, None]
+    sml2 = sml.astype(jnp.int32)[:, None] if sub else None
+    child_scale = cscale is not None
+
+    kern = functools.partial(
+        _fused_kernel, nrt=nrt, lpad=lpad, num_bins=B, fblk=fblk,
+        precision=precision, interpret=interpret, params=params,
+        use_mc=use_mc, monotone_penalty=monotone_penalty,
+        has_contri=has_contri, sub=sub, apply_scale=apply_scale,
+        child_scale=child_scale, nslots=nslots, nchildren=C)
+
+    def full_spec(shape):
+        nd = len(shape)
+        return pl.BlockSpec(shape, lambda fb, rt, _n=nd: (0,) * _n)
+
+    res_blocks, hs_blocks = [], []
+    for fb in range(nfb):
+        sl = slice(fb * fblk, (fb + 1) * fblk)
+        ins = [iota_bins, binned_rm[:, sl], g3t, leaf_p,
+               nb_p[:, sl], mt_p[:, sl], nanb_p[:, sl], zb_p[:, sl],
+               us_p[:, sl], mono_p[:, sl]]
+        specs = [
+            pl.BlockSpec((1, fblk * B), lambda fb_, rt: (0, 0)),
+            pl.BlockSpec((T, fblk), lambda fb_, rt: (rt, 0)),
+            pl.BlockSpec((3, T), lambda fb_, rt: (0, rt)),
+            pl.BlockSpec((1, T), lambda fb_, rt: (0, rt)),
+        ] + [full_spec((1, fblk))] * 6
+        if has_contri:
+            ins.append(contri_p[:, sl])
+            specs.append(full_spec((1, fblk)))
+        ins.append(mask_p[:, sl])
+        specs.append(full_spec((C, fblk)))
+        for a in (csums2, constr2, depth2, pout2):
+            ins.append(a)
+            specs.append(full_spec(a.shape))
+        if child_scale:
+            ins.append(cscale.astype(jnp.float32))
+            specs.append(full_spec((C, 3)))
+        if sub and apply_scale:
+            ins.append(sscale.astype(jnp.float32))
+            specs.append(full_spec((nslots, 3)))
+        if sub:
+            ins += [sml2, parent_p[:, sl]]
+            specs += [full_spec((nslots, 1)),
+                      full_spec((nslots, fblk, B, 3))]
+        out_shape = [jax.ShapeDtypeStruct((C, fblk, RES_COLS),
+                                          jnp.float32)]
+        out_specs = [full_spec((C, fblk, RES_COLS))]
+        if sub:
+            out_shape.append(
+                jax.ShapeDtypeStruct((nslots, fblk, B, 3), jnp.float32))
+            out_specs.append(full_spec((nslots, fblk, B, 3)))
+        out = pl.pallas_call(
+            kern,
+            grid=(1, nrt),
+            in_specs=specs,
+            out_specs=out_specs,
+            out_shape=out_shape,
+            scratch_shapes=[pltpu.VMEM((1, m_pad, fblk * B), jnp.float32)],
+            interpret=interpret,
+        )(*ins)
+        res_blocks.append(out[0])
+        if sub:
+            hs_blocks.append(out[1])
+    residue = (jnp.concatenate(res_blocks, axis=1)
+               if nfb > 1 else res_blocks[0])[:, :F]
+    hsmall = None
+    if sub:
+        hsmall = (jnp.concatenate(hs_blocks, axis=1)
+                  if nfb > 1 else hs_blocks[0])[:, :F]
+    return residue, hsmall
+
+
+def _pick_pack(residue_c, shift_c, parent_sum_c, meta, num_bins):
+    """Cross-feature half of ``scan_pick`` on one child's O(F) residue,
+    plus the non-categorical tail of ``_find_best_split`` (right sums,
+    missing default direction) — the packed per-slot SplitInfo the round
+    emits.  Formula-for-formula the staged code, evaluated on identical
+    inputs, so the pick is bit-identical."""
+    fbest = residue_c[:, 0]
+    gsel = residue_c[:, 1]
+    sel = residue_c[:, 2].astype(jnp.int32)
+    gbest = jnp.max(fbest)
+    feature = jnp.argmax(fbest >= gbest - tie_tol(gbest, shift_c)) \
+        .astype(jnp.int32)                   # first in band = min feature
+    best_gain = gsel[feature]
+    sc = sel[feature]
+    direction = (sc // num_bins).astype(jnp.int32)
+    threshold = (sc % num_bins).astype(jnp.int32)
+    left = residue_c[feature, 3:6]
+    right = parent_sum_c - left
+    mtype = meta.missing_type[feature]
+    default_left = jnp.where(
+        (mtype == MISSING_NAN) | (mtype == MISSING_ZERO),
+        direction == 1, False)
+    rel_gain = jnp.where(jnp.isfinite(best_gain), best_gain, NEG_INF)
+    return jnp.concatenate([
+        jnp.stack([rel_gain.astype(jnp.float32),
+                   feature.astype(jnp.float32),
+                   threshold.astype(jnp.float32),
+                   default_left.astype(jnp.float32)]),
+        left.astype(jnp.float32), right.astype(jnp.float32)])
+
+
+def pack_children(res: SplitResult) -> jnp.ndarray:
+    """Batched SplitResult -> the (C, PACK_COLS) wire rows (no bitset —
+    the fused path never produces categorical splits)."""
+    return jnp.concatenate([
+        res.gain[:, None],
+        res.feature.astype(jnp.float32)[:, None],
+        res.threshold_bin.astype(jnp.float32)[:, None],
+        res.default_left.astype(jnp.float32)[:, None],
+        res.left_sum, res.right_sum], axis=1)
+
+
+def unpack_children(packed: jnp.ndarray, num_bins: int) -> SplitResult:
+    """(C, PACK_COLS) rows -> batched SplitResult (is_cat False, zero
+    bitset — the fused gate excludes categorical datasets)."""
+    W = -(-num_bins // 32)
+    C = packed.shape[0]
+    return SplitResult(
+        gain=packed[:, 0],
+        feature=packed[:, 1].astype(jnp.int32),
+        threshold_bin=packed[:, 2].astype(jnp.int32),
+        default_left=packed[:, 3] != 0,
+        left_sum=packed[:, 4:7],
+        right_sum=packed[:, 7:10],
+        is_cat=jnp.zeros(C, bool),
+        cat_bitset=jnp.zeros((C, W), jnp.uint32),
+    )
+
+
+def make_fused_round(*, meta, params, num_bins, precision, deep_precision,
+                     monotone_penalty=0.0, interpret=False,
+                     axis_name=None):
+    """Build the grower-facing ``fused_round_fn``.
+
+    ``fused_round(binned, g3, label, S, *, deep, quant_key, scaled,
+    mask, csums, constr, depth, pout, sml, parent, meta_override,
+    feature_rebase) -> (packed (2S, PACK_COLS), hsmall or None,
+    slot_scales (nslots, 3))``
+
+    * ``deep`` — sustained-bucket round: the kernel accumulates at
+      ``deep_precision`` (the staged deep-dtype policy, so precision per
+      bucket cannot drift between the paths).
+    * ``quant_key`` non-None — an int8sr-eligible bucket
+      (models/grower_wave.py quant gate: the sustained bucket and the
+      16-slot ramp of a K>16 wave; root and <=4-slot ramps never reach
+      here): the gradients are stochastic-round quantized with the SAME
+      ``sr_quantize_g3`` call the staged pass makes, and the dequantize
+      multiply folds into the in-VMEM subtraction (or the scan's integer
+      cumsum pool-free) exactly where the staged path folds it.
+    * ``scaled`` — quant buckets exist this grow (the staged path then
+      applies identity scales on non-quant rounds too; mirrored for bit
+      parity).
+    * ``meta_override``/``feature_rebase`` — the feature-parallel
+      learner passes its (traced) per-shard meta slice and block offset;
+      packed feature ids come back shard-local and are rebased by the
+      caller after the SplitInfo election.
+    """
+    from .quantize import sr_quantize_g3
+
+    use_mc = bool(np.asarray(meta.monotone_type).any())
+
+    def fused_round(binned, g3, label, S, *, deep=False, quant_key=None,
+                    scaled=False, mask=None, csums=None, constr=None,
+                    depth=None, pout=None, sml=None, parent=None,
+                    meta_override=None):
+        sub = parent is not None
+        C = 2 * S
+        nslots = S if sub else C
+        m = meta_override if meta_override is not None else meta
+        if quant_key is not None:
+            q3, scales = sr_quantize_g3(g3, label, nslots, quant_key,
+                                        axis_name=axis_name)
+            g3u, prec = q3, "int8sr"
+        else:
+            scales = jnp.ones((nslots, 3), jnp.float32)
+            g3u = g3
+            prec = deep_precision if deep else precision
+        with jax.named_scope("lgbm.fused_round"):
+            residue, hsmall = fused_wave_scan(
+                binned, g3u, label, nslots=nslots, nchildren=C,
+                num_bins=num_bins, precision=prec, interpret=interpret,
+                meta=m, params=params, use_mc=use_mc,
+                monotone_penalty=monotone_penalty, mask=mask,
+                csums=csums, constr=constr, depth=depth, pout=pout,
+                cscale=(scales if (scaled and not sub) else None),
+                sscale=(scales if (scaled and sub) else None),
+                sml=sml, parent=parent, apply_scale=(scaled and sub))
+            shift = jax.vmap(
+                lambda ps, po: gain_shift(ps, po, params))(csums, pout)
+            packed = jax.vmap(
+                lambda rc, sh, ps: _pick_pack(rc, sh, ps, m, num_bins)
+            )(residue, shift, csums)
+        return packed, hsmall, scales
+
+    return fused_round
+
+
+def fused_ineligible_reason(*, meta, params, bin_dtype, num_bins,
+                            packed=False, bundled=False) -> str:
+    """Static eligibility gate — returns the fallback reason (one line of
+    the module-docstring taxonomy) or ``""`` when the fused kernel can
+    run.  Learner/grower routing gates live in parallel/trainer.py."""
+    if bundled:
+        return ("EFB bundle-space histograms expand to original features "
+                "before the scan")
+    if packed:
+        return "4-bit packed bins decode outside the fused kernel"
+    if np.dtype(bin_dtype).itemsize > 1:
+        return "int16 bins exceed the uint8 one-hot kernel family"
+    if num_bins > 256:
+        return "num_bins > 256 exceeds the uint8 kernel family"
+    if bool(np.asarray(meta.is_categorical).any()):
+        return ("categorical sorted-scan (per-feature argsort) has no "
+                "kernel lowering")
+    if params.extra_trees:
+        return "extra_trees draws per-node randomness inside the scan"
+    return ""
+
+
+_BACKEND_LOWERS: dict = {}
+
+
+def backend_lowers_fused() -> bool:
+    """One cached trial compile of a tiny fused round on the current
+    backend — the Mosaic-lowering auto-fallback probe (the
+    ``predict_pallas`` precedent: opt-in kernel, warn + staged fallback
+    when the local backend cannot lower it).  CPU always passes: the
+    kernel runs in interpret mode there (the bit-parity lane)."""
+    backend = jax.default_backend()
+    if backend in _BACKEND_LOWERS:
+        return _BACKEND_LOWERS[backend]
+    if backend == "cpu":
+        _BACKEND_LOWERS[backend] = True
+        return True
+    from ..utils.log import log_warning
+
+    try:
+        F, B, N, S = 4, 8, 64, 2
+        meta = FeatureMeta(
+            num_bins=jnp.full(F, B, jnp.int32),
+            missing_type=jnp.zeros(F, jnp.int32),
+            nan_bin=jnp.full(F, -1, jnp.int32),
+            zero_bin=jnp.zeros(F, jnp.int32),
+            is_categorical=jnp.zeros(F, bool),
+            usable=jnp.ones(F, bool),
+            monotone_type=jnp.zeros(F, jnp.int32),
+        )
+        from .split import SplitParams
+
+        fn = make_fused_round(meta=meta, params=SplitParams(),
+                              num_bins=B, precision="bf16x2",
+                              deep_precision="bf16")
+        rng = np.random.RandomState(0)
+        args = (jnp.asarray(rng.randint(0, B, (F, N)).astype(np.uint8)),
+                jnp.asarray(rng.randn(N, 3).astype(np.float32)),
+                jnp.asarray(rng.randint(0, 2 * S + 1, N).astype(np.int32)))
+        kw = dict(mask=jnp.ones((2 * S, F), bool),
+                  csums=jnp.abs(jnp.asarray(
+                      rng.randn(2 * S, 3).astype(np.float32))),
+                  constr=jnp.tile(jnp.asarray([-3e38, 3e38], jnp.float32),
+                                  (2 * S, 1)),
+                  depth=jnp.ones(2 * S, jnp.int32),
+                  pout=jnp.zeros(2 * S, jnp.float32))
+        jax.jit(lambda *a: fn(*a, S, **kw)).lower(*args).compile()
+        _BACKEND_LOWERS[backend] = True
+    except Exception as e:  # noqa: BLE001 — any lowering failure falls back
+        log_warning(
+            f"hist_method=fused: Mosaic could not lower the fused "
+            f"wave-round kernel on backend {backend!r} "
+            f"({type(e).__name__}); falling back to the staged "
+            "histogram+split path")
+        _BACKEND_LOWERS[backend] = False
+    return _BACKEND_LOWERS[backend]

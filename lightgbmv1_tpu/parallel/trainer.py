@@ -362,15 +362,24 @@ def build_trainer(
 
     levelwise = config.tree_growth == "levelwise"
 
+    # hist_method=pallas on the CPU backend runs the kernels through the
+    # Pallas interpreter — the bit-parity lane the fused wave-round
+    # kernel is pinned against (ops/wave_fused.py; the BatchPredictor
+    # precedent for interpret-on-CPU)
+    pallas_interpret = (method == "pallas"
+                        and jax.default_backend() == "cpu")
+
     def local_hist(binned, g3, leaf_id, target):
         return hist_one_leaf(binned, g3, leaf_id, target, Bh,
                              method=method, precision=precision,
-                             packed=packed, num_features=F)
+                             packed=packed, num_features=F,
+                             interpret=pallas_interpret)
 
     def local_frontier(binned, g3, leaf_id, L_level):
         return hist_frontier(binned, g3, leaf_id, L_level, Bh,
                              method=method, precision=precision,
-                             packed=packed, num_features=F)
+                             packed=packed, num_features=F,
+                             interpret=pallas_interpret)
 
     # depth-adaptive wave precision: the grower flags sustained
     # (largest-bucket) rounds of big waves with deep=True — those run a
@@ -404,7 +413,8 @@ def build_trainer(
         return hist_wave(binned, g3, label, nslots, Bh,
                          method=method,
                          precision=deep_precision if deep else precision,
-                         packed=packed, num_features=F)
+                         packed=packed, num_features=F,
+                         interpret=pallas_interpret)
 
     def local_wave_quant(binned, g3, label, nslots, key, axis_name=None):
         # axis_name: row-sharded learners pass their mesh axis so the
@@ -412,7 +422,8 @@ def build_trainer(
         # summable in the raw integer domain (ops/quantize.py)
         return hist_wave_quant(binned, g3, label, nslots, Bh, key,
                                method=method, packed=packed,
-                               num_features=F, axis_name=axis_name)
+                               num_features=F, axis_name=axis_name,
+                               interpret=pallas_interpret)
 
     # EFB: split search + decisions speak ORIGINAL features; only the
     # histogram pass runs over bundle columns
@@ -524,6 +535,41 @@ def build_trainer(
             forced = parse_forced_splits(config.forcedsplits_filename,
                                          bin_mappers, config.num_leaves)
 
+    # ---- hist_method=fused: the wave-round megakernel dispatch ----------
+    # (ops/wave_fused.py — histogram + smaller-child subtraction + split
+    # scan in one Pallas invocation, histograms resident in VMEM).  The
+    # static gates below are the documented fallback taxonomy; every
+    # ineligible config logs its reason once and runs the staged path.
+    fused_builder = None
+    if config.hist_method == "fused":
+        from ..ops import wave_fused
+
+        fused_reason = wave_fused.fused_ineligible_reason(
+            meta=meta, params=params, bin_dtype=binned_np.dtype,
+            num_bins=B, packed=packed, bundled=bundle is not None)
+        if not fused_reason and (levelwise or not use_wave
+                                 or forced is not None):
+            fused_reason = ("the fused kernel is a wave-round kernel; "
+                            "this config routes to the "
+                            + ("level-wise" if levelwise else "sequential")
+                            + " grower")
+        if not fused_reason and learner in ("data", "voting"):
+            fused_reason = (f"tree_learner={learner} reduces histograms "
+                            "across row shards (the collective needs the "
+                            "explicit histogram)")
+        if not fused_reason and jax.default_backend() != "cpu" \
+                and not wave_fused.backend_lowers_fused():
+            fused_reason = "Mosaic lowering failed (warned above)"
+        if fused_reason:
+            log_warning(f"hist_method=fused: {fused_reason}; running the "
+                        "staged histogram+split path")
+        else:
+            fused_builder = wave_fused.make_fused_round
+            log_info("hist_method=fused: wave rounds run the fused "
+                     "histogram+split kernel (ops/wave_fused.py"
+                     + (", interpret mode"
+                        if jax.default_backend() == "cpu" else "") + ")")
+
     if learner in ("serial", ""):
         if levelwise:
             grow = make_levelwise_grower(
@@ -533,12 +579,20 @@ def build_trainer(
         elif use_wave and forced is None:
             # wave-batched best-first: the leaf-wise default schedule
             # (models/grower_wave.py)
+            fused_fn = None
+            if fused_builder is not None:
+                fused_fn = fused_builder(
+                    meta=meta, params=params, num_bins=B,
+                    precision=precision, deep_precision=deep_precision,
+                    monotone_penalty=config.monotone_penalty,
+                    interpret=jax.default_backend() == "cpu")
             grow = make_wave_grower(hist_wave_fn=local_wave,
                                     hist_wave_quant_fn=(
                                         local_wave_quant if use_int8sr
                                         else None),
                                     split_fn=split_local,
-                                    bins_of_fn=bins_feat_fn, **wave_common)
+                                    bins_of_fn=bins_feat_fn,
+                                    fused_round_fn=fused_fn, **wave_common)
         else:
             # sequential best-first (the reference's exact split order):
             # DataPartition fast path by default; tree_growth=leafwise_masked
@@ -556,8 +610,14 @@ def build_trainer(
         # _supports_valids capability flag — valid rows routed through
         # each round's splits instead of per-tree walks — rides the
         # wrapped callable automatically; compile telemetry (obs/xla.py)
-        # labels this dispatch per learner
-        return obs_xla.instrument_jit(grow, "grow.serial"), \
+        # labels this dispatch per learner — `grow.fused_round` when the
+        # fused megakernel is engaged, so compile counters, cost
+        # analysis (flops / bytes accessed) and the roofline join track
+        # the fused executable as its own watched row
+        label = ("grow.fused_round" if fused_builder is not None
+                 else "grow.serial")   # gates above null the builder
+                                       # whenever a non-wave grower runs
+        return obs_xla.instrument_jit(grow, label), \
             jnp.asarray(binned_np), N
 
     if learner == "voting" and levelwise:
@@ -962,7 +1022,8 @@ def build_trainer(
             lo = lax.axis_index("feature") * F_loc
             block = lax.dynamic_slice(binned, (lo, 0), (F_loc, N))
             h = hist_one_leaf(block, g3, leaf_id, target, B,
-                              method=method, precision=precision)
+                              method=method, precision=precision,
+                              interpret=pallas_interpret)
             full = jnp.zeros((F_pad, B, 3), jnp.float32)
             return lax.dynamic_update_slice(full, h, (lo, 0, 0))
 
@@ -971,7 +1032,8 @@ def build_trainer(
             block = lax.dynamic_slice(binned, (lo, 0), (F_loc, N))
             h = hist_wave(block, g3, label, nslots, B,
                           method=method,
-                          precision=deep_precision if deep else precision)
+                          precision=deep_precision if deep else precision,
+                          interpret=pallas_interpret)
             full = jnp.zeros((nslots, F_pad, B, 3), jnp.float32)
             return lax.dynamic_update_slice(full, h, (0, lo, 0, 0))
 
@@ -983,7 +1045,8 @@ def build_trainer(
             lo = lax.axis_index("feature") * F_loc
             block = lax.dynamic_slice(binned, (lo, 0), (F_loc, N))
             h, sc = hist_wave_quant(block, g3, label, nslots, B, key,
-                                    method=method)
+                                    method=method,
+                                    interpret=pallas_interpret)
             full = jnp.zeros((nslots, F_pad, B, 3), jnp.float32)
             return lax.dynamic_update_slice(full, h, (0, lo, 0, 0)), sc
 
@@ -1022,6 +1085,70 @@ def build_trainer(
             # the level-wise grower is basic-only (warned above)
             fp_kwargs["monotone_mode"] = mono_mode
             fp_kwargs["async_wave_pipeline"] = config.async_wave_pipeline
+        # hist_method=fused per feature slice (ISSUE 13): each shard runs
+        # the fused kernel over its OWN feature block — histograms stay
+        # in that shard's VMEM, nothing crosses chips but the packed
+        # SplitInfo the existing _sync_best_split election already moves
+        fused_fp = None
+        if fused_builder is not None and use_wave and not levelwise:
+            from ..ops.wave_fused import pack_children, unpack_children
+
+            base_fused = fused_builder(
+                meta=meta_p, params=params, num_bins=B,
+                precision=precision, deep_precision=deep_precision,
+                monotone_penalty=config.monotone_penalty,
+                interpret=jax.default_backend() == "cpu")
+
+            def _slice_meta(lo):
+                def sl(a, wide=F_loc):
+                    return lax.dynamic_slice(a, (lo,), (wide,))
+                return FeatureMeta(
+                    num_bins=sl(meta_p.num_bins),
+                    missing_type=sl(meta_p.missing_type),
+                    nan_bin=sl(meta_p.nan_bin),
+                    zero_bin=sl(meta_p.zero_bin),
+                    is_categorical=sl(meta_p.is_categorical),
+                    usable=sl(meta_p.usable),
+                    monotone_type=sl(meta_p.monotone_type),
+                    contri=(sl(meta_p.contri)
+                            if meta_p.contri is not None else None),
+                )
+
+            def fused_fp(binned, g3, label, S, *, deep=False,
+                         quant_key=None, scaled=False, mask=None,
+                         csums=None, constr=None, depth=None, pout=None,
+                         sml=None, parent=None, meta_override=None):
+                del meta_override
+                lo = lax.axis_index("feature") * F_loc
+                block = lax.dynamic_slice(binned, (lo, 0), (F_loc, N))
+                mask_loc = lax.dynamic_slice(
+                    mask, (0, lo), (2 * S, F_loc))
+                par_loc = (lax.dynamic_slice(
+                    parent, (0, lo, 0, 0), (S, F_loc, B, 3))
+                    if parent is not None else None)
+                packed, hsm, sc = base_fused(
+                    block, g3, label, S, deep=deep, quant_key=quant_key,
+                    scaled=scaled, mask=mask_loc, csums=csums,
+                    constr=constr, depth=depth, pout=pout, sml=sml,
+                    parent=par_loc, meta_override=_slice_meta(lo))
+                # shard-local feature ids -> global, then the SplitInfo
+                # election (reference SyncUpGlobalBestSplit) per child
+                local = unpack_children(packed, B)
+                local = local._replace(feature=local.feature + lo)
+                synced = jax.vmap(
+                    lambda lc, ps: _sync_best_split(lc, ps, params,
+                                                    "feature")
+                )(local, csums)
+                packed_g = pack_children(synced)
+                if hsm is not None:
+                    # re-embed the shard's smaller-child block at its
+                    # offset of the full-width (zeros elsewhere) state —
+                    # the hist_wave_fp layout the subtraction table uses
+                    full = jnp.zeros((S, F_pad, B, 3), jnp.float32)
+                    hsm = lax.dynamic_update_slice(full, hsm,
+                                                   (0, lo, 0, 0))
+                return packed_g, hsm, sc
+
         if levelwise:
             # feature-sharded frontier histograms + vmapped all_gather
             # argmax per leaf — the level-wise grower composes with the
@@ -1030,7 +1157,8 @@ def build_trainer(
                 lo = lax.axis_index("feature") * F_loc
                 block = lax.dynamic_slice(binned, (lo, 0), (F_loc, N))
                 h = hist_frontier(block, g3, leaf_id, L_level, Bh,
-                                  method=method, precision=precision)
+                                  method=method, precision=precision,
+                                  interpret=pallas_interpret)
                 full = jnp.zeros((L_level, F_pad, Bh, 3), jnp.float32)
                 return lax.dynamic_update_slice(full, h, (0, lo, 0, 0))
 
@@ -1043,6 +1171,7 @@ def build_trainer(
                 hist_wave_quant_fn=(hist_wave_quant_fp if use_int8sr
                                     else None),
                 split_fn=split_fn,
+                fused_round_fn=fused_fp,
                 wave_size=wave_size, **fp_kwargs)
         else:
             grow = make_leafwise_grower(
@@ -1067,7 +1196,8 @@ def build_trainer(
             return sharded(binned, g3, maskp, key,
                            jnp.pad(cegb_used, (0, pad_f)))
 
-        return obs_xla.instrument_jit(grow_fn, f"grow.{learner}"), \
-            binned_dev, N
+        return obs_xla.instrument_jit(
+            grow_fn, ("grow.fused_round" if fused_fp is not None
+                      else f"grow.{learner}")), binned_dev, N
 
     log_fatal(f"Unknown tree_learner: {learner}")

@@ -264,6 +264,39 @@ def test_split_breakdown_and_pipeline_render():
     assert "AUC-parity experiment" not in txt0
 
 
+def test_fused_section_renders_fused_fields():
+    """The Fused-wave-round section (ISSUE 13) is generated from the
+    BENCH fused_* fields (bench.py measure_fused /
+    measure_fused_round_ms): parity, the merged hist+split row inside
+    the phase table, the cost-analysis HBM accounting and the fused_ok
+    guard all grep to record fields; records without them render
+    nothing (older records stay stable)."""
+    import perf_report
+
+    rec = {
+        "phase_hist_ms": 66.78, "phase_partition_ms": 9.7,
+        "phase_valid_route_ms": 2.1, "phase_split_ms": 22.8,
+        "phase_other_ms": 50.48, "phase_total_measured_ms": 151.9,
+        "hist_split_fused_ms_per_iter": 41.25,
+        "fused_parity_ok": True, "fused_ok": True,
+        "fused_M_row_trees_per_s": 11.5,
+        "fused_staged_pallas_M_row_trees_per_s": 9.875,
+        "staged_round_bytes_accessed": 500_000_000,
+        "fused_round_bytes_accessed": 180_000_000,
+        "fused_hbm_bytes_saved_per_round": 320_000_000,
+        "fused_hbm_stack_bytes_analytic": 170_698_752,
+    }
+    txt = perf_report.generate(rec, "BENCH_rTEST.json")
+    for needle in ("## Fused wave round", "41.25", "fused_ok=True",
+                   "fused_parity_ok=True", "320000000", "hist+split fused",
+                   "ops/wave_fused.py"):
+        assert needle in txt, needle
+    # absent fields: no fused section, legacy phase-table header — the
+    # on-disk PERF.md (generated from an r05-era record) stays stable
+    txt0 = perf_report.generate({"auc": 0.9}, "BENCH_rTEST.json")
+    assert "## Fused wave round" not in txt0
+
+
 def test_observability_section_renders_obs_fields():
     """The Observability section (ISSUE 9) is generated from the BENCH
     obs_* fields (bench.py measure_obs): overhead vs the 2% contract,

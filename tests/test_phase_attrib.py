@@ -195,3 +195,27 @@ def test_assembly_measures_real_store_codecs():
         ms = measure_assembly_scatter_ms(31, 8, 6, 16, fused=fused,
                                          probes=3)
         assert np.isfinite(ms) and ms >= 0
+
+
+def test_fused_merged_phase_recognized():
+    """ISSUE 13: a record training with hist_method=fused carries the
+    merged hist+split phase (`phase_hist_split_fused_ms`) — the
+    canonical phase list must route it into the cost split and the
+    roofline join as its own labeled row, never into phase_other."""
+    from tools.phase_attrib import (PHASE_MS_KEYS, phase_ms_from_fields,
+                                    roofline_attribution,
+                                    split_cost_by_ms)
+
+    assert "phase_hist_split_fused_ms" in PHASE_MS_KEYS
+    fields = {"phase_hist_split_fused_ms": 40.0,
+              "phase_partition_ms": 9.7,
+              "phase_other_ms": 50.0,
+              "phase_hist_ms": None,          # fused run: no staged rows
+              "not_a_phase_ms": 3.0}
+    pms = phase_ms_from_fields(fields)
+    assert pms == {"hist_split_fused": 40.0, "partition": 9.7,
+                   "other": 50.0}
+    cost = split_cost_by_ms(1e12, 1e9, pms)
+    assert set(cost) == set(pms)
+    rl = roofline_attribution(pms, cost, 1e12, peak_bytes_per_s=1e11)
+    assert "hist_split_fused" in rl and rl["hist_split_fused"]["ms"] == 40.0

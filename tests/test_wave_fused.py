@@ -1,0 +1,389 @@
+"""Fused wave-round megakernel (ops/wave_fused.py) — bit-parity and
+gating tests.
+
+The parity contract (ISSUE 13): ``hist_method=fused`` grows trees
+BIT-IDENTICAL to the staged ``hist_method=pallas`` path (interpret mode
+on CPU — the same arithmetic, fused vs staged scheduling) across the
+golden matrix: binary / multiclass / DART / categorical+NaN (where the
+fused gate falls back, so parity is the fallback working) / monotone+L1.
+Model text equality is the strongest pin — structure, thresholds, leaf
+values and metadata all byte-compare.
+
+The int8sr tests pin the quantized lane: the fused kernel consumes the
+SAME ``sr_quantize_g3`` rounding stream as the staged pass, so quantized
+fused trees are bit-identical to quantized staged trees AND
+bit-reproducible run-to-run given the seed; the eligibility gate (root
+and <=4-slot ramp buckets never quantize; ``gpu_use_dp`` disables int8sr
+with the staged path's warning) is shared, not re-implemented.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import lightgbmv1_tpu.models.grower_wave as gw
+from lightgbmv1_tpu.basic import _objective_string
+from lightgbmv1_tpu.config import Config
+from lightgbmv1_tpu.io.dataset import BinnedDataset
+from lightgbmv1_tpu.io.model_text import model_to_string
+from lightgbmv1_tpu.models.gbdt import create_boosting
+
+_INTERP = jax.default_backend() != "tpu"
+
+
+def _binary_problem(n=1400, f=8, seed=0, with_nan=False):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, f)
+    logit = (1.5 * X[:, 0] - X[:, 1] + 0.8 * X[:, 2] * X[:, 3]
+             + 0.5 * np.sin(X[:, 4]))
+    y = (logit + rng.randn(n) * 0.4 > 0).astype(np.float64)
+    if with_nan:
+        X[rng.rand(n, f) < 0.08] = np.nan
+    return X, y
+
+
+def _train_text(over, X, y, iters=3, **ds_kw):
+    cfg = Config.from_dict({
+        "objective": "binary", "num_leaves": 31, "min_data_in_leaf": 5,
+        "verbosity": -1, "tree_growth": "leafwise",
+        "leafwise_wave_size": 8, **over})
+    ds = BinnedDataset.from_numpy(X, label=y, config=cfg, **ds_kw)
+    gb = create_boosting(cfg, ds)
+    for _ in range(iters):
+        gb.train_one_iter(check_stop=False)
+    trees = gb.materialize_host_trees()
+    return model_to_string(
+        trees, objective_string=_objective_string(cfg), num_class=1,
+        num_tree_per_iteration=cfg.num_tree_per_iteration,
+        feature_names=list(ds.feature_names),
+        feature_infos=ds.feature_infos())
+
+
+def _parity(over=None, problem=None, iters=3, **ds_kw):
+    X, y = problem if problem is not None else _binary_problem()
+    over = over or {}
+    staged = _train_text({**over, "hist_method": "pallas"}, X, y,
+                         iters=iters, **ds_kw)
+    fused = _train_text({**over, "hist_method": "fused"}, X, y,
+                        iters=iters, **ds_kw)
+    assert staged == fused, "fused trees diverged from the staged path"
+    return fused
+
+
+def _warnings(fn):
+    """Run ``fn`` capturing log lines; returns the captured list."""
+    from lightgbmv1_tpu.utils import log
+
+    lines = []
+    log.register_callback(lines.append)
+    try:
+        fn()
+    finally:
+        log.register_callback(None)
+    return lines
+
+
+# ---------------------------------------------------------------------------
+# Golden-matrix bit parity (interpret mode on CPU)
+# ---------------------------------------------------------------------------
+
+
+def test_fused_parity_binary():
+    _parity()
+
+
+@pytest.mark.slow    # tier-1 budget (ISSUE 13 discipline): the full suite,
+                     # bench measure_fused and every dryrun_multichip
+                     # capture (fused_parity_ok) still run this
+def test_fused_parity_multiclass():
+    rng = np.random.RandomState(3)
+    n, f, k = 1200, 6, 3
+    X = rng.randn(n, f)
+    y = (np.abs(X[:, 0]) + X[:, 1] > 1).astype(np.float64) \
+        + (X[:, 2] > 0.3).astype(np.float64)
+    X2, y2 = X, np.clip(y, 0, k - 1)
+    cfg_over = {"objective": "multiclass", "num_class": k,
+                "metric": "multi_logloss"}
+
+    def text(hm):
+        cfg = Config.from_dict({
+            "objective": "multiclass", "num_class": k, "num_leaves": 15,
+            "min_data_in_leaf": 5, "verbosity": -1,
+            "tree_growth": "leafwise", "leafwise_wave_size": 4,
+            "hist_method": hm, **cfg_over})
+        ds = BinnedDataset.from_numpy(X2, label=y2, config=cfg)
+        gb = create_boosting(cfg, ds)
+        for _ in range(2):
+            gb.train_one_iter(check_stop=False)
+        return model_to_string(
+            gb.materialize_host_trees(),
+            objective_string=_objective_string(cfg), num_class=k,
+            num_tree_per_iteration=k,
+            feature_names=list(ds.feature_names),
+            feature_infos=ds.feature_infos())
+
+    assert text("pallas") == text("fused")
+
+
+@pytest.mark.slow    # tier-1 budget (ISSUE 13 discipline): the full suite,
+                     # bench measure_fused and every dryrun_multichip
+                     # capture (fused_parity_ok) still run this
+def test_fused_parity_dart():
+    _parity({"boosting": "dart", "drop_rate": 0.3, "drop_seed": 5},
+            iters=4)
+
+
+def test_fused_parity_monotone_l1():
+    # monotone constraints ride the kernel's constraint inputs; L1 rides
+    # the gain chain (threshold_l1) — both inside the fused scan
+    _parity({"monotone_constraints": [1, -1, 0, 0, 0, 0, 0, 0],
+             "lambda_l1": 0.5, "lambda_l2": 0.1})
+
+
+@pytest.mark.slow    # tier-1 budget (ISSUE 13 discipline): the full suite,
+                     # bench measure_fused and every dryrun_multichip
+                     # capture (fused_parity_ok) still run this
+def test_fused_parity_monotone_intermediate():
+    # intermediate mode recomputes constraints per round OUTSIDE the
+    # kernel and feeds them in as inputs — same values, same trees
+    _parity({"monotone_constraints": [1, -1, 0, 0, 0, 0, 0, 0],
+             "monotone_constraints_method": "intermediate"})
+
+
+def test_fused_parity_nan_missing():
+    _parity(problem=_binary_problem(with_nan=True))
+
+
+@pytest.mark.slow    # tier-1 budget (ISSUE 13 discipline): the full suite,
+                     # bench measure_fused and every dryrun_multichip
+                     # capture (fused_parity_ok) still run this
+def test_fused_categorical_falls_back_with_reason():
+    """Categorical datasets run the staged path (the sorted-scan argsort
+    has no kernel lowering) — parity holds trivially AND the fallback
+    logs its taxonomy reason."""
+    rng = np.random.RandomState(4)
+    n = 1200
+    Xc = rng.randn(n, 4)
+    Xc[:, 0] = rng.randint(0, 8, n)
+    y = ((Xc[:, 0] % 3 == 1).astype(np.float64)
+         + (Xc[:, 1] > 0)).clip(0, 1)
+    lines = _warnings(lambda: _parity({"verbosity": 0}, problem=(Xc, y),
+                                      iters=2, categorical_features=[0]))
+    assert any("categorical" in ln and "fused" in ln for ln in lines), lines
+
+
+@pytest.mark.slow    # tier-1 budget (ISSUE 13 discipline): the full suite,
+                     # bench measure_fused and every dryrun_multichip
+                     # capture (fused_parity_ok) still run this
+def test_fused_extra_trees_falls_back():
+    lines = _warnings(
+        lambda: _parity({"extra_trees": True, "extra_seed": 9,
+                         "verbosity": 0}, iters=2))
+    assert any("extra_trees" in ln for ln in lines), lines
+
+
+@pytest.mark.slow    # tier-1 budget (ISSUE 13 discipline): the full suite,
+                     # bench measure_fused and every dryrun_multichip
+                     # capture (fused_parity_ok) still run this
+def test_fused_parity_serialized_body():
+    # async_wave_pipeline=false: no pending carry, the parent gather is
+    # the plain (non-forwarded) table read feeding the kernel
+    _parity({"async_wave_pipeline": False}, iters=2)
+
+
+@pytest.mark.slow    # tier-1 budget (ISSUE 13 discipline): the full suite,
+                     # bench measure_fused and every dryrun_multichip
+                     # capture (fused_parity_ok) still run this
+def test_fused_parity_legacy_store():
+    # the legacy per-field store commits h_left/h_right separately —
+    # the fused table update must feed it the same stacks
+    _parity({"fused_bookkeeping": False}, iters=2)
+
+
+def test_fused_pool_free_parity(monkeypatch):
+    """Wide-F configs skip the per-leaf histogram state: the fused
+    kernel then accumulates all 2S children from scratch in VMEM and
+    emits ONLY the packed SplitInfo (no histogram output at all)."""
+    monkeypatch.setattr(gw, "_SUB_STATE_CAP_BYTES", 0)
+    _parity()
+
+
+@pytest.mark.slow    # tier-1 budget (ISSUE 13 discipline): the full suite,
+                     # bench measure_fused and every dryrun_multichip
+                     # capture (fused_parity_ok) still run this
+def test_fused_slot_buckets_parity(monkeypatch):
+    """The sliced ramp buckets (4/16/K) each trace their own fused
+    kernel variant; parity must hold across the whole ladder."""
+    monkeypatch.setattr(gw, "_BUCKET_MIN_N", 1)
+    _parity({"num_leaves": 63, "leafwise_wave_size": 24})
+
+
+# ---------------------------------------------------------------------------
+# int8sr: shared quantization stream, shared eligibility gate
+# ---------------------------------------------------------------------------
+
+
+def _int8sr_over():
+    return {"num_leaves": 64, "leafwise_wave_size": 32,
+            "hist_dtype_deep": "int8sr"}
+
+
+@pytest.mark.slow    # tier-1 budget (ISSUE 13 discipline): the full suite,
+                     # bench measure_fused and every dryrun_multichip
+                     # capture (fused_parity_ok) still run this
+def test_fused_int8sr_parity_and_reproducible(monkeypatch):
+    monkeypatch.setattr(gw, "_BUCKET_MIN_N", 1)
+    X, y = _binary_problem(n=1600)
+    t1 = _train_text({**_int8sr_over(), "hist_method": "fused"}, X, y,
+                     iters=2)
+    t2 = _train_text({**_int8sr_over(), "hist_method": "fused"}, X, y,
+                     iters=2)
+    assert t1 == t2, "int8sr fused trees not bit-reproducible"
+    staged = _train_text({**_int8sr_over(), "hist_method": "pallas"}, X, y,
+                         iters=2)
+    assert t1 == staged, "int8sr fused diverged from staged int8sr"
+
+
+@pytest.mark.slow    # tier-1 budget (ISSUE 13 discipline): the full suite,
+                     # bench measure_fused and every dryrun_multichip
+                     # capture (fused_parity_ok) still run this
+def test_fused_int8sr_gate_root_and_small_ramps_never_quantize(
+        monkeypatch):
+    """The fused path must route through the SAME quant gate as the
+    staged one: sr_quantize_g3 is only ever traced for the eligible
+    buckets (the sustained K bucket and the 16-slot ramp of a K>16
+    wave) — never for the root pass or the <=4-slot ramps."""
+    import lightgbmv1_tpu.ops.quantize as qz
+
+    monkeypatch.setattr(gw, "_BUCKET_MIN_N", 1)
+    calls = []
+    orig = qz.sr_quantize_g3
+
+    def probe(g3, label, nslots, key, axis_name=None):
+        calls.append(int(nslots))
+        return orig(g3, label, nslots, key, axis_name=axis_name)
+
+    monkeypatch.setattr(qz, "sr_quantize_g3", probe)
+    X, y = _binary_problem(n=1600)
+    _train_text({**_int8sr_over(), "hist_method": "fused"}, X, y, iters=1)
+    assert calls, "int8sr buckets never engaged"
+    K = 32
+    # sub mode quantizes the smaller-child slots: eligible buckets are
+    # S == K (sustained) and S == 16 (the big-wave ramp harvest)
+    assert set(calls) <= {16, K, 2 * 16, 2 * K}, calls
+    assert all(c > 4 for c in calls), f"root/small ramp quantized: {calls}"
+
+
+@pytest.mark.slow    # tier-1 budget (ISSUE 13 discipline): the full suite,
+                     # bench measure_fused and every dryrun_multichip
+                     # capture (fused_parity_ok) still run this
+def test_fused_int8sr_disabled_by_gpu_use_dp(monkeypatch):
+    monkeypatch.setattr(gw, "_BUCKET_MIN_N", 1)
+    X, y = _binary_problem(n=1200)
+    lines = _warnings(lambda: _train_text(
+        {**_int8sr_over(), "hist_method": "fused", "gpu_use_dp": True,
+         "verbosity": 0}, X, y, iters=1))
+    assert any("int8sr conflicts with gpu_use_dp" in ln
+               for ln in lines), lines
+
+
+# ---------------------------------------------------------------------------
+# Kernel-level unit parity (no grower in the loop)
+# ---------------------------------------------------------------------------
+
+
+def _unit_meta(F, B):
+    from lightgbmv1_tpu.ops.split import FeatureMeta
+
+    return FeatureMeta(
+        num_bins=jnp.full(F, B, jnp.int32),
+        missing_type=jnp.zeros(F, jnp.int32),
+        nan_bin=jnp.full(F, -1, jnp.int32),
+        zero_bin=jnp.zeros(F, jnp.int32),
+        is_categorical=jnp.zeros(F, bool),
+        usable=jnp.ones(F, bool),
+        monotone_type=jnp.zeros(F, jnp.int32),
+    )
+
+
+def test_fused_round_matches_staged_split(rng):
+    from lightgbmv1_tpu.ops import wave_fused as wf
+    from lightgbmv1_tpu.ops.hist_pallas import hist_leaves_pallas
+    from lightgbmv1_tpu.ops.split import (NO_CONSTRAINT, SplitParams,
+                                          find_best_split)
+
+    F, B, N, S = 5, 16, 777, 3
+    C = 2 * S
+    meta = _unit_meta(F, B)
+    params = SplitParams(min_data_in_leaf=5.0)
+    binned = jnp.asarray(rng.randint(0, B, (F, N)).astype(np.uint8))
+    g3 = jnp.asarray(np.stack(
+        [rng.randn(N), np.abs(rng.randn(N)) + 0.1, np.ones(N)],
+        axis=1).astype(np.float32))
+    label = jnp.asarray(rng.randint(0, C + 1, N).astype(np.int32))
+    h = hist_leaves_pallas(binned, g3, label, C + 1, B,
+                           precision="bf16x2", interpret=_INTERP)[:C]
+    csums = h.sum(axis=(1, 2))
+    mask = jnp.ones((C, F), bool)
+    nc = jnp.asarray(NO_CONSTRAINT, jnp.float32)
+    ref = jax.vmap(lambda hh, ps: find_best_split(
+        hh, ps, meta, mask[0], params, nc, 1, 0.0, 0.0, None, None)
+    )(h, csums)
+    fn = wf.make_fused_round(meta=meta, params=params, num_bins=B,
+                             precision="bf16x2", deep_precision="bf16",
+                             interpret=_INTERP)
+    packed, hsm, _ = fn(binned, g3, label, S, mask=mask, csums=csums,
+                        constr=jnp.tile(nc, (C, 1)),
+                        depth=jnp.ones(C, jnp.int32),
+                        pout=jnp.zeros(C, jnp.float32))
+    assert hsm is None                      # pool-free: no hist output
+    got = wf.unpack_children(packed, B)
+    for name in ("gain", "feature", "threshold_bin", "default_left",
+                 "left_sum", "right_sum"):
+        np.testing.assert_array_equal(np.asarray(getattr(ref, name)),
+                                      np.asarray(getattr(got, name)),
+                                      err_msg=name)
+
+
+def test_pack_unpack_roundtrip(rng):
+    from lightgbmv1_tpu.ops import wave_fused as wf
+    from lightgbmv1_tpu.ops.split import SplitResult
+
+    C, B = 6, 64
+    W = -(-B // 32)
+    res = SplitResult(
+        gain=jnp.asarray(rng.randn(C).astype(np.float32)),
+        feature=jnp.asarray(rng.randint(0, 9, C).astype(np.int32)),
+        threshold_bin=jnp.asarray(rng.randint(0, B, C).astype(np.int32)),
+        default_left=jnp.asarray(rng.rand(C) < 0.5),
+        left_sum=jnp.asarray(rng.randn(C, 3).astype(np.float32)),
+        right_sum=jnp.asarray(rng.randn(C, 3).astype(np.float32)),
+        is_cat=jnp.zeros(C, bool),
+        cat_bitset=jnp.zeros((C, W), jnp.uint32),
+    )
+    back = wf.unpack_children(wf.pack_children(res), B)
+    for name in res._fields:
+        np.testing.assert_array_equal(np.asarray(getattr(res, name)),
+                                      np.asarray(getattr(back, name)),
+                                      err_msg=name)
+
+
+# ---------------------------------------------------------------------------
+# Feature-parallel: fused kernel per feature slice + SplitInfo election
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow    # tier-1 budget: dryrun_multichip asserts this per
+                     # driver capture (fused_parity_ok)
+def test_fused_feature_parallel_parity():
+    X, y = _binary_problem(n=1200, f=6)
+    serial = _train_text({"hist_method": "fused"}, X, y, iters=2)
+    fp = _train_text({"hist_method": "fused", "tree_learner": "feature",
+                      "num_shards": 2}, X, y, iters=2)
+    assert serial == fp, "feature-parallel fused diverged from serial"
+
+
+def test_config_rejects_unknown_hist_method():
+    with pytest.raises(ValueError, match="hist_method"):
+        Config.from_dict({"objective": "binary", "hist_method": "warp"})

@@ -90,6 +90,11 @@ WATCHED: Tuple[Tuple[str, str, float], ...] = (
     # work of ROADMAP item 2 lands against a baseline
     ("compile_ms_total", "down", 0.50),
     ("hbm_peak_bytes", "down", 0.10),
+    # fused wave-round megakernel (ISSUE 13): the merged hist+split
+    # round priced over the replayed schedule gets the standard 10%
+    # clock bar; fused_ok / fused_parity_ok are booleans the guard
+    # sweep flags automatically
+    ("hist_split_fused_ms_per_iter", "down", 0.10),
 )
 
 _PARITY_RE = re.compile(r"dryrun_multichip PARITY (\{.*\})")

@@ -42,13 +42,18 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 import bench_trend  # noqa: E402
 import tier1_budget  # noqa: E402
 
-# the full post-ISSUE-12 driver guard set: ``--require-guards default``
+# the full post-ISSUE-13 driver guard set: ``--require-guards default``
 # expands to this, so the driver command line stops rotting as guards
 # are added (a new *_ok lands here in the same PR that records it);
 # obs_device_ok is the device-truth telemetry guard (compile counters,
-# serving zero-retrace, HBM/ledger reconciliation — bench.py measure_obs)
+# serving zero-retrace, HBM/ledger reconciliation — bench.py
+# measure_obs); fused_ok is the fused wave-round megakernel guard
+# (bit parity with the staged path AND, on device, the merged
+# hist+split round at or under the staged phases — bench.py
+# measure_fused / measure_fused_round_ms)
 REQUIRED_GUARDS = ("obs_ok", "slo_ok", "forensics_ok", "chaos_ok",
-                   "fleet_ok", "chaos_fleet_ok", "obs_device_ok")
+                   "fleet_ok", "chaos_fleet_ok", "obs_device_ok",
+                   "fused_ok")
 
 
 def check_required_guards(records_dir: str, guards, out=print) -> bool:

@@ -510,6 +510,39 @@ def measure_other_breakdown(*, N, F, B, L, K, rounds_per_iter,
     return bd
 
 
+# Canonical per-iteration phase fields (BENCH record keys).  The single
+# source of truth for "what counts as a phase" — bench.py's phase
+# profile and the roofline join both build their {phase: ms} dicts from
+# this list, so a NEW phase (the fused wave-round kernel's single merged
+# hist+split row, ISSUE 13) lands as its own labeled row everywhere
+# instead of silently pooling into phase_other.  Order is render order.
+PHASE_MS_KEYS = (
+    "phase_hist_ms",
+    "phase_partition_ms",
+    "phase_valid_route_ms",
+    "phase_split_ms",
+    # hist_method=fused: histogram + smaller-child subtraction + split
+    # scan are ONE kernel — one merged phase, mutually exclusive with
+    # the staged hist/split rows for the run that produced it
+    "phase_hist_split_fused_ms",
+    "phase_other_ms",
+)
+
+
+def phase_ms_from_fields(fields):
+    """``{phase: ms}`` from a BENCH record's phase fields, stripping the
+    ``phase_``/``_ms`` wrapping — every positive canonical phase,
+    including the fused merged row.  Consumers (bench.py's trace phase
+    profile and the roofline join) go through here so the phase list
+    cannot drift per call site."""
+    out = {}
+    for k in PHASE_MS_KEYS:
+        v = (fields or {}).get(k)
+        if isinstance(v, (int, float)) and v > 0:
+            out[k[len("phase_"):-len("_ms")]] = v
+    return out
+
+
 def split_cost_by_ms(total_flops, total_bytes, phase_ms):
     """Attribute ONE compiled executable's cost analysis (flops, bytes
     accessed — obs/xla.py compile telemetry of the fused/scanned train
