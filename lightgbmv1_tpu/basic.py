@@ -408,6 +408,20 @@ class Dataset:
         return sub
 
 
+def _reference_capture_supported() -> bool:
+    """Model-reference capture (obs/model.py) reads the raw score cache
+    host-side; under multi-process training that array spans
+    non-addressable devices and a single-rank read ABORTS inside the
+    runtime rather than raising — so capture is a single-process
+    feature until the multi-host collective capture lands."""
+    try:
+        import jax
+
+        return jax.process_count() <= 1
+    except Exception:  # noqa: BLE001 — no backend = no device arrays
+        return True
+
+
 class Booster:
     """Gradient boosting model handle (reference basic.py:1930)."""
 
@@ -430,6 +444,11 @@ class Booster:
         self.train_set = train_set
         self._name_valid_sets: List[str] = []
         self._pred_objective = None
+        # model-quality observability (ISSUE 14): the engine loop
+        # appends metric curves here ({"dataset:metric": [values]});
+        # capture_model_reference() caches its result
+        self._metric_history: Dict[str, List[float]] = {}
+        self._model_reference = None
 
         if train_set is not None:
             if not isinstance(train_set, Dataset):
@@ -988,7 +1007,41 @@ class Booster:
         return self
 
     # ------------------------------------------------------------------
-    def save_checkpoint(self, path, write_file: bool = True) -> "Booster":
+    def capture_model_reference(self, score_bins: Optional[int] = None):
+        """Training-time reference capture (ISSUE 14, obs/model.py):
+        one pass over the already-binned training matrix (streamed per
+        block on the out-of-core path) records per-feature
+        bin-occupancy histograms over the ensemble's own BinMapper
+        bins, NaN rates, and the raw training-score distribution.
+        Returns the :class:`~lightgbmv1_tpu.obs.model.ModelReference`
+        the serving side re-bins sampled requests against (and caches
+        it on the Booster for checkpoint/publish plumbing)."""
+        if self._gbdt is None:
+            log_fatal("capture_model_reference() requires a training "
+                      "Booster")
+        from .obs.model import capture_reference
+
+        if score_bins is None:
+            score_bins = self.config.drift_score_bins
+        self._model_reference = capture_reference(
+            self._gbdt.train_set,
+            np.asarray(self._gbdt.raw_train_scores()),
+            score_bins=score_bins)
+        return self._model_reference
+
+    def quality_snapshot(self, top_k: int = 8) -> Dict:
+        """Trainer quality telemetry (obs/model.py): per-iteration
+        split-gain / leaf / depth aggregates, gain+split feature
+        importance and the recorded train/valid metric curves —
+        computed after the fact from host trees, never perturbing the
+        training loop."""
+        from .obs.model import quality_snapshot
+
+        return quality_snapshot(self, top_k=top_k)
+
+    # ------------------------------------------------------------------
+    def save_checkpoint(self, path, write_file: bool = True,
+                        with_reference: bool = True) -> "Booster":
         """Write a crash-consistent full-trainer-state bundle
         (io/checkpoint.py): model text + score caches + RNG/bagging/DART
         state + iteration counter, atomically.  A training run resumed
@@ -1007,11 +1060,28 @@ class Booster:
         manifest, arrays = self._gbdt.capture_state()
         manifest["num_trees_total"] = self.num_trees()
         if write_file:
+            ref_bytes = b""
+            if with_reference and _reference_capture_supported():
+                # the bundle carries the training reference (ISSUE 14)
+                # so a resumed/served model keeps its drift baseline;
+                # capture is host-side only (no collective), which is
+                # why it runs on the WRITING rank alone — and why it is
+                # SKIPPED under multi-process training: reading the
+                # cross-process score cache from one rank aborts inside
+                # the runtime (not a catchable Python error), and a
+                # collective capture belongs to the multi-host item
+                try:
+                    ref_bytes = self.capture_model_reference().to_bytes()
+                except Exception as e:  # noqa: BLE001 — e.g. sparse
+                    # bundle-only datasets keep no per-feature matrix
+                    log_warning(f"checkpoint: reference capture skipped "
+                                f"({type(e).__name__}: {e})")
             write_checkpoint(str(path), manifest, arrays,
                              model_text=self.model_to_string(),
                              base_model_text=(self._loaded_str
                                               if self._loaded is not None
-                                              else "") or "")
+                                              else "") or "",
+                             reference_bytes=ref_bytes)
         return self
 
     def resume_from_checkpoint(self, path_or_bundle) -> "Booster":

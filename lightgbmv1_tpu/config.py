@@ -566,6 +566,33 @@ class Config:
     # whole-request deadline across retries/hedges; exhaustion returns
     # 504 (RequestTimeout), never 500; 0 = no deadline
     router_deadline_ms: float = 0.0
+    # -- model & data drift observability (ISSUE 14; obs/drift.py) -----
+    # serving-side train/serve skew detection: > 0 arms a bounded
+    # sampling ring of this many rows on the serve path (HARD-OFF
+    # default 0 — the disarmed serving path is one integer compare).
+    # Sampled request rows re-bin through the published version's own
+    # bin mappers (the training reference obs/model.py captures) and
+    # GET /drift reports per-feature PSI, unseen-bin/out-of-range/NaN
+    # counters and prediction-score drift; features crossing
+    # drift_psi_threshold publish drift.alert events and the top
+    # drift_top_k features get Prometheus gauges (capped cardinality)
+    drift_sample_rows: int = 0
+    drift_per_batch_rows: int = 64    # rows copied from one device batch
+    drift_min_rows: int = 256         # sampled rows before PSI is judged
+    drift_psi_threshold: float = 0.25  # conventional "major shift" bar
+    drift_top_k: int = 8              # per-feature gauges / top list cap
+    # equal-mass PSI buckets per feature: PSI over the raw max_bin-wide
+    # training bins has a ~bins/window noise floor; the conventional
+    # 10-20-bucket practice keeps clean traffic under the alert bar
+    drift_psi_groups: int = 16
+    # sample every Nth device batch: the row copy is tens of us, drift
+    # is a minutes-scale phenomenon — striding amortizes the armed
+    # serving cost 1/N (the <= 2% contract headroom on small batches)
+    drift_sample_stride: int = 4
+    # training-score reference histogram resolution (obs/model.py
+    # capture_reference; also the serving-side score-drift comparison)
+    drift_score_bins: int = 16
+
     # -- elastic training recovery (parallel/elastic.py) ---------------
     # worker lease staleness bound: a peer whose lease file goes stale
     # past this is declared dead and survivors abort for re-bootstrap
@@ -764,6 +791,22 @@ class Config:
                 or self.router_deadline_ms < 0:
             raise ValueError("router_retry_max / router_hedge_ms / "
                              "router_deadline_ms must be >= 0")
+        if self.drift_sample_rows < 0:
+            raise ValueError("drift_sample_rows must be >= 0 (0 = off)")
+        if self.drift_per_batch_rows < 1:
+            raise ValueError("drift_per_batch_rows must be >= 1")
+        if self.drift_min_rows < 1:
+            raise ValueError("drift_min_rows must be >= 1")
+        if self.drift_psi_threshold <= 0:
+            raise ValueError("drift_psi_threshold must be > 0")
+        if self.drift_top_k < 1:
+            raise ValueError("drift_top_k must be >= 1")
+        if self.drift_score_bins < 2:
+            raise ValueError("drift_score_bins must be >= 2")
+        if self.drift_psi_groups < 2:
+            raise ValueError("drift_psi_groups must be >= 2")
+        if self.drift_sample_stride < 1:
+            raise ValueError("drift_sample_stride must be >= 1")
         if self.elastic_lease_timeout_s <= 0:
             raise ValueError("elastic_lease_timeout_s must be > 0 "
                              "(the peer-loss detection window)")

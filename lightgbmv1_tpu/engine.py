@@ -134,6 +134,14 @@ def train(
                     [(train_data_name,) + r[1:] for r in booster.eval_train(feval)]
                 )
             evaluation_result_list.extend(booster.eval_valid(feval))
+            # model-quality telemetry (ISSUE 14): the metric curves are
+            # already computed for the callbacks — record them on the
+            # booster so obs/model.quality_snapshot (and perf_report's
+            # "Model quality" section) can render train/valid curves
+            # without re-evaluating
+            for ds_name, metric, value, _ in evaluation_result_list:
+                booster._metric_history.setdefault(
+                    f"{ds_name}:{metric}", []).append(float(value))
         try:
             for cb in cbs_after:
                 cb(callback_mod.CallbackEnv(

@@ -90,13 +90,17 @@ def _obs_ckpt_hist(name: str, help_text: str):
 
 def write_checkpoint(path: str, manifest: Dict[str, Any],
                      arrays: Dict[str, np.ndarray], model_text: str,
-                     base_model_text: str = "") -> None:
-    """Serialize and atomically write one bundle."""
+                     base_model_text: str = "",
+                     reference_bytes: bytes = b"") -> None:
+    """Serialize and atomically write one bundle.  ``reference_bytes``
+    (obs/model.ModelReference.to_bytes — the training bin-occupancy /
+    score-distribution reference, ISSUE 14) rides as an optional
+    digest-verified member ``reference.bin``."""
     from ..obs import trace
 
     t0_ns = trace.now_ns()
     _write_checkpoint_impl(path, manifest, arrays, model_text,
-                           base_model_text)
+                           base_model_text, reference_bytes)
     ms = (trace.now_ns() - t0_ns) / 1e6
     _obs_ckpt_hist("checkpoint_save_ms",
                    "Wall time of one checkpoint-bundle write").observe(ms)
@@ -107,7 +111,8 @@ def write_checkpoint(path: str, manifest: Dict[str, Any],
 
 def _write_checkpoint_impl(path: str, manifest: Dict[str, Any],
                            arrays: Dict[str, np.ndarray], model_text: str,
-                           base_model_text: str = "") -> None:
+                           base_model_text: str = "",
+                           reference_bytes: bytes = b"") -> None:
     buf = io.BytesIO()
     np.savez(buf, **arrays)
     arrays_bytes = buf.getvalue()
@@ -123,6 +128,8 @@ def _write_checkpoint_impl(path: str, manifest: Dict[str, Any],
     }
     if base_bytes:
         manifest["digests"]["base_model.txt"] = _digest(base_bytes)
+    if reference_bytes:
+        manifest["digests"]["reference.bin"] = _digest(reference_bytes)
     out = io.BytesIO()
     # ZIP_STORED: the payload is already compact npz; the checkpoint write
     # sits on the training path, so cheap beats small
@@ -132,6 +139,8 @@ def _write_checkpoint_impl(path: str, manifest: Dict[str, Any],
         if base_bytes:
             zf.writestr("base_model.txt", base_bytes)
         zf.writestr("arrays.npz", arrays_bytes)
+        if reference_bytes:
+            zf.writestr("reference.bin", reference_bytes)
     fileio.atomic_write_bytes(path, out.getvalue(), site=path)
 
 
@@ -233,7 +242,11 @@ def _load_checkpoint_impl(path: str) -> Dict[str, Any]:
             if a.dtype.kind == "f" and not np.isfinite(a).all():
                 raise CheckpointError(f"{path}: non-finite values in {k}")
     return {"manifest": manifest, "arrays": arrays,
-            "model_text": model_text, "base_model_text": base_text}
+            "model_text": model_text, "base_model_text": base_text,
+            # training reference (obs/model.py; digest already verified
+            # via the manifest sweep above) — empty for pre-ISSUE-14
+            # bundles, which load unchanged
+            "reference_bytes": members.get("reference.bin", b"")}
 
 
 def validate_checkpoint(path: str) -> Dict[str, Any]:

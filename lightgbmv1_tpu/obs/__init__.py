@@ -42,6 +42,21 @@ The device-truth half (ISSUE 12) closes the host/chip gap:
   anchored device capture) obs/agg.py merges next to the host spans;
   ``tools/capture.py`` is the one-command driver-capture orchestrator.
 
+The model-quality half (ISSUE 14) watches the MODEL, not the system:
+
+* :mod:`~lightgbmv1_tpu.obs.model` — training-time reference capture
+  (per-feature bin-occupancy over the ensemble's own BinMapper bins,
+  NaN rates, score distribution; digest-verified bytes carried in
+  checkpoint bundles and ModelVersion meta) + after-the-fact trainer
+  quality telemetry (split-gain distribution, leaf/depth stats, metric
+  curves, gain/split importance).
+* :mod:`~lightgbmv1_tpu.obs.drift` — serving-side train/serve skew
+  detection: a bounded sampling ring on the serve path (hard-off by
+  default) re-bins request rows through the version's own mappers;
+  per-feature PSI + unseen-bin/NaN counters and score drift at
+  ``GET /drift``, capped-cardinality Prometheus gauges (top-K), and
+  ``drift.alert`` events.
+
 Contract: tracing is OFF by default and its off-path must cost nothing
 measurable (one module-level flag check, no allocation); armed tracing
 must stay within 2% of train wall (the BENCH ``obs_ok`` guard measures
@@ -49,9 +64,9 @@ both).  Metrics are always on — counter bumps are nanoseconds against
 millisecond iterations and requests.
 """
 
-from . import agg, dump, events, metrics, trace, xla
+from . import agg, drift, dump, events, metrics, model, trace, xla
 from .metrics import Registry, default_registry
 from .trace import span
 
-__all__ = ["agg", "dump", "events", "metrics", "trace", "xla", "Registry",
-           "default_registry", "span"]
+__all__ = ["agg", "drift", "dump", "events", "metrics", "model", "trace",
+           "xla", "Registry", "default_registry", "span"]

@@ -41,6 +41,16 @@ from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 DEFAULT_MS_BUCKETS = (0.5, 1, 2, 5, 10, 20, 50, 100, 200, 500,
                       1000, 2000, 5000, 10000)
 
+# Per-metric label-cardinality cap (ISSUE 14; the multi-tenant /
+# per-feature stress ROADMAP item 4 flagged): once a labeled metric
+# holds this many distinct children, NEW label combinations collapse
+# into one shared overflow child instead of growing the exposition
+# without bound.  Every collapsed write is counted in
+# ``obs_label_overflow_total{metric=...}`` — the overflow is explicit,
+# never silent.  Override per metric with ``label_cardinality=``.
+DEFAULT_LABEL_CARDINALITY = 256
+OVERFLOW_LABEL = "_overflow"
+
 
 def escape_label_value(v: str) -> str:
     """Prometheus text-format label escaping: backslash, quote, newline."""
@@ -190,13 +200,15 @@ class _Metric:
     def __init__(self, name: str, help_text: str, kind: str,
                  label_names: Sequence[str] = (),
                  buckets: Sequence[float] = (),
-                 sample_window: int = 0):
+                 sample_window: int = 0,
+                 label_cardinality: int = DEFAULT_LABEL_CARDINALITY):
         self.name = name
         self.help = help_text
         self.kind = kind
         self.label_names = tuple(label_names)
         self.bucket_bounds = tuple(sorted(float(b) for b in buckets))
         self.sample_window = int(sample_window)
+        self.label_cardinality = max(int(label_cardinality), 1)
         self.lock = threading.Lock()
         self._registry: Optional["Registry"] = None
         self._children: Dict[Tuple[str, ...], _Child] = {}
@@ -229,11 +241,34 @@ class _Metric:
                 f"{self.name}: labels() got {sorted(kv)}, declared "
                 f"{sorted(self.label_names)}")
         key = tuple(str(kv[n]) for n in self.label_names)
+        overflowed = False
         with self.lock:
             child = self._children.get(key)
             if child is None:
-                child = self._children[key] = _Child(self, key)
-            return child
+                if len(self._children) >= self.label_cardinality:
+                    # cardinality cap: a NEW label combination beyond
+                    # the cap collapses into one shared overflow child
+                    # — the exposition stays bounded no matter how many
+                    # tenants/features/versions write here
+                    overflowed = True
+                    key = (OVERFLOW_LABEL,) * len(self.label_names)
+                    child = self._children.get(key)
+                if child is None:
+                    child = self._children[key] = _Child(self, key)
+        if overflowed:
+            self._on_label_overflow()
+        return child
+
+    def _on_label_overflow(self) -> None:
+        """Count one collapsed write (outside this metric's lock — the
+        overflow counter is its own metric on the owning registry)."""
+        reg = self._registry
+        if reg is not None and self.name != "obs_label_overflow_total":
+            reg.counter(
+                "obs_label_overflow_total",
+                "Writes collapsed into the overflow child by the "
+                "label-cardinality cap",
+                label_names=("metric",)).labels(metric=self.name).inc()
 
     # bare-metric convenience (unlabeled): forward to the () child
     def _solo(self) -> _Child:
@@ -285,7 +320,9 @@ class Registry:
 
     def _register(self, name: str, help_text: str, kind: str,
                   label_names: Sequence[str], buckets: Sequence[float] = (),
-                  sample_window: int = 0) -> _Metric:
+                  sample_window: int = 0,
+                  label_cardinality: int = DEFAULT_LABEL_CARDINALITY
+                  ) -> _Metric:
         with self._lock:
             m = self._metrics.get(name)
             if m is not None:
@@ -296,25 +333,33 @@ class Registry:
                         f"{m.label_names}")
                 return m
             m = _Metric(name, help_text, kind, label_names, buckets,
-                        sample_window)
+                        sample_window, label_cardinality)
             m._registry = self
             self._metrics[name] = m
             return m
 
     def counter(self, name: str, help_text: str = "",
-                label_names: Sequence[str] = ()) -> _Metric:
-        return self._register(name, help_text, "counter", label_names)
+                label_names: Sequence[str] = (),
+                label_cardinality: int = DEFAULT_LABEL_CARDINALITY
+                ) -> _Metric:
+        return self._register(name, help_text, "counter", label_names,
+                              label_cardinality=label_cardinality)
 
     def gauge(self, name: str, help_text: str = "",
-              label_names: Sequence[str] = ()) -> _Metric:
-        return self._register(name, help_text, "gauge", label_names)
+              label_names: Sequence[str] = (),
+              label_cardinality: int = DEFAULT_LABEL_CARDINALITY
+              ) -> _Metric:
+        return self._register(name, help_text, "gauge", label_names,
+                              label_cardinality=label_cardinality)
 
     def histogram(self, name: str, help_text: str = "",
                   label_names: Sequence[str] = (),
                   buckets: Sequence[float] = DEFAULT_MS_BUCKETS,
-                  sample_window: int = 0) -> _Metric:
+                  sample_window: int = 0,
+                  label_cardinality: int = DEFAULT_LABEL_CARDINALITY
+                  ) -> _Metric:
         return self._register(name, help_text, "histogram", label_names,
-                              buckets, sample_window)
+                              buckets, sample_window, label_cardinality)
 
     def get(self, name: str) -> Optional[_Metric]:
         with self._lock:

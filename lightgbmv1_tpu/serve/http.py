@@ -26,6 +26,10 @@ Endpoints:
   (obs/metrics.py): the JSON ServeMetrics snapshot by default (the
   pre-obs contract), Prometheus text exposition when the request has
   ``Accept: text/plain`` or ``?format=prometheus``.
+* ``GET /drift``     train/serve skew evaluation (obs/drift.py): the
+  active version's per-feature PSI vs its training reference, unseen-
+  bin/NaN counters and prediction-score drift; ``armed: false`` (with a
+  reason) when drift sampling is off or no reference was published.
 * ``GET /healthz``   liveness, not process-up: 200 with
   ``{"ok": true, "version", "dispatcher_alive", "published",
   "server_version", "uptime_s"}`` only when the dispatcher thread is
@@ -97,6 +101,12 @@ def _make_handler(server: Server):
                 # (serve/slo.py) — the page/warn booleans an external
                 # alerter can poll without scraping histograms
                 self._reply(200, server.slo_snapshot())
+            elif route == "/drift":
+                # train/serve skew evaluation (obs/drift.py): per-feature
+                # PSI vs the active version's training reference, skew
+                # counters and score drift — computed on READ, never on
+                # the serving path
+                self._reply(200, server.drift_snapshot())
             elif route == "/healthz":
                 health = server.health()
                 self._reply(200 if health["ok"] else 503, health)

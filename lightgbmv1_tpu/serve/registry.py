@@ -220,6 +220,25 @@ class ModelRegistry:
             mv = self._build(trees, K, F, degrade_trees)
             if meta:
                 mv.meta.update(meta)
+            # model-quality meta (ISSUE 14): every version carries its
+            # gain/split feature importance so commit() can diff the
+            # importance shift between versions, and the training
+            # reference (when provided) is digest-stamped like every
+            # other artifact
+            imp_gain = np.zeros(F, np.float64)
+            imp_split = np.zeros(F, np.int64)
+            for t in trees:
+                for i in range(t.num_leaves - 1):
+                    f = int(t.split_feature[i])
+                    if f < F:
+                        imp_gain[f] += float(t.split_gain[i])
+                        imp_split[f] += 1
+            mv.meta["importance_gain"] = [round(float(v), 6)
+                                          for v in imp_gain]
+            mv.meta["importance_split"] = [int(v) for v in imp_split]
+            ref = mv.meta.get("model_reference")
+            if ref is not None:
+                mv.meta["model_reference_digest"] = ref.digest
             mv.meta["n_warm"] = self._warm(mv, max_batch_rows)
             if probe_rows > 0:
                 self._probe_check(mv, trees, K, F, probe_rows)
@@ -241,12 +260,34 @@ class ModelRegistry:
     def commit(self, mv: ModelVersion) -> str:
         """Phase 2: atomically make a prepared version current (one
         reference swap under the lock — in-flight batches finish on the
-        version they started with)."""
+        version they started with).  The incoming version's importance
+        is diffed against the outgoing one (obs/model.importance_shift)
+        so a publish that silently re-ranks what the model pays
+        attention to is a visible number, not a mystery."""
         with self._lock:
+            prev = self._active
             if self._active is not None:
                 self._history.append(self._active)
                 del self._history[:-self._keep]
             self._active = mv
+        if prev is not None and prev.meta.get("importance_gain") \
+                and mv.meta.get("importance_gain"):
+            try:
+                from ..obs import events as obs_events
+                from ..obs.model import importance_shift
+
+                shift = importance_shift(prev.meta["importance_gain"],
+                                         mv.meta["importance_gain"])
+                mv.meta["importance_shift"] = shift
+                mv.meta["importance_shift_vs"] = prev.tag
+                obs_events.publish(
+                    "serve.importance_shift",
+                    f"{prev.tag} -> {mv.tag}: importance L1 shift "
+                    f"{shift['l1']}", tag=mv.tag, prev_tag=prev.tag,
+                    l1=shift["l1"], top_mover=shift["top_mover"],
+                    replica=self.name or "")
+            except Exception:   # noqa: BLE001 — telemetry must never
+                pass            # block a publish
         if self._metrics is not None:
             self._metrics.on_swap()
         log_info(f"serve: published {mv.tag} ({mv.n_trees} trees, "

@@ -415,6 +415,19 @@ class Router:
             {"le": le, **ex} for le, ex in self.metrics.exemplars()]
         return out
 
+    def drift_snapshot(self) -> Dict[str, Any]:
+        """GET /drift on a fleet: per-replica skew evaluations (each
+        replica samples its own traffic slice against the version's
+        reference) keyed by replica name, plus the fleet-level view —
+        armed if ANY replica is, alerting = union."""
+        per = {r.name: r.server.drift_snapshot() for r in self._replicas}
+        alerting = sorted({f for d in per.values()
+                           for f in d.get("alerting", [])})
+        return {"armed": any(d.get("armed") for d in per.values()),
+                "version": self.version(),
+                "alerting": alerting,
+                "replicas": per}
+
     def health(self) -> Dict[str, Any]:
         """Fleet-level liveness: ok while ANY replica is healthy (the
         router can still serve).  Per-replica payloads ride along so

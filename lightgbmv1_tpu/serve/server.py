@@ -97,6 +97,19 @@ class ServeConfig:
                                         # (0 = structural checks only)
     # -- SLOs (serve/slo.py): always-on burn-rate tracking ---------------
     slo: Optional[SLOConfig] = None     # None = default SLOConfig()
+    # -- train/serve skew detection (ISSUE 14; obs/drift.py) -------------
+    # HARD-OFF default: drift_sample_rows=0 keeps the serving path at
+    # one integer compare.  Armed, the dispatcher copies at most
+    # drift_per_batch_rows rows per device batch into a bounded ring;
+    # GET /drift re-bins the window through the active version's own
+    # mappers (ModelVersion.meta["model_reference"]) and judges PSI
+    drift_sample_rows: int = 0
+    drift_per_batch_rows: int = 64
+    drift_min_rows: int = 256
+    drift_psi_threshold: float = 0.25
+    drift_top_k: int = 8
+    drift_psi_groups: int = 16
+    drift_sample_stride: int = 4    # sample every Nth device batch
     predictor_kwargs: Dict[str, Any] = field(default_factory=dict)
 
     def __post_init__(self):
@@ -113,6 +126,14 @@ class ServeConfig:
         self.breaker_failures = max(int(self.breaker_failures), 0)
         self.watchdog_ms = max(float(self.watchdog_ms), 0.0)
         self.probe_rows = max(int(self.probe_rows), 0)
+        self.drift_sample_rows = max(int(self.drift_sample_rows), 0)
+        self.drift_per_batch_rows = max(int(self.drift_per_batch_rows), 1)
+        self.drift_min_rows = max(int(self.drift_min_rows), 1)
+        self.drift_psi_threshold = max(float(self.drift_psi_threshold),
+                                       1e-9)
+        self.drift_top_k = max(int(self.drift_top_k), 1)
+        self.drift_psi_groups = max(int(self.drift_psi_groups), 2)
+        self.drift_sample_stride = max(int(self.drift_sample_stride), 1)
         if self.slo is None:
             self.slo = SLOConfig()
 
@@ -179,6 +200,13 @@ class Server:
         # count feeding the circuit breaker
         self._inflight: Optional[tuple] = None
         self._consec_failures = 0
+        # train/serve skew detection (obs/drift.py): built lazily per
+        # ACTIVE version on the dispatcher thread, so publish/rollback/
+        # breaker swaps re-anchor the detector to the new version's own
+        # reference automatically; None until armed AND a version with
+        # a model_reference serves a batch
+        self._drift = None
+        self._drift_tag: Optional[str] = None
         self._dispatcher = threading.Thread(
             target=self._dispatch_loop, name="serve-dispatcher", daemon=True)
         # a forensic bundle dumped while this replica lives should carry
@@ -272,6 +300,53 @@ class Server:
         out["version"] = self.registry.current_tag()
         out["exemplars"] = [
             {"le": le, **ex} for le, ex in self.metrics.exemplars()]
+        return out
+
+    # -- train/serve skew detection (obs/drift.py) -----------------------
+    def _drift_for(self, mv: ModelVersion):
+        """The active version's DriftDetector (dispatcher thread only):
+        rebuilt when the served tag changes, shared otherwise.  A
+        version published without a ``model_reference`` disables
+        detection until the next version that carries one."""
+        if self._drift_tag == mv.tag:
+            return self._drift
+        ref = mv.meta.get("model_reference")
+        det = None
+        if ref is not None:
+            from ..obs.drift import DriftConfig, DriftDetector
+
+            cfg = self.config
+            det = DriftDetector(
+                ref,
+                DriftConfig(sample_rows=cfg.drift_sample_rows,
+                            per_batch_rows=cfg.drift_per_batch_rows,
+                            min_rows=cfg.drift_min_rows,
+                            psi_threshold=cfg.drift_psi_threshold,
+                            top_k=cfg.drift_top_k,
+                            psi_groups=cfg.drift_psi_groups,
+                            sample_stride=cfg.drift_sample_stride),
+                registry=self.metrics.registry, version_tag=mv.tag)
+        self._drift = det
+        self._drift_tag = mv.tag
+        return det
+
+    def drift_snapshot(self) -> Dict[str, Any]:
+        """The ``GET /drift`` payload: arming state + the active
+        detector's evaluation (per-feature PSI top-K, skew counters,
+        score drift) — or the reason there is nothing to judge."""
+        out: Dict[str, Any] = {
+            "armed": self.config.drift_sample_rows > 0,
+            "version": self.registry.current_tag(),
+        }
+        det = self._drift
+        if not out["armed"]:
+            out["reason"] = "drift_sample_rows=0 (sampling off)"
+        elif det is None:
+            out["reason"] = ("no model_reference published yet"
+                             if out["version"] is not None
+                             else "no model published yet")
+        else:
+            out.update(det.snapshot())
         return out
 
     def dispatcher_alive(self) -> bool:
@@ -506,6 +581,19 @@ class Server:
         finally:
             self._inflight = None
         self.metrics.on_batch(n, bp.bucket_for(n), backlog)
+        if self.config.drift_sample_rows > 0:
+            # armed skew sampling (one strided row copy per batch; the
+            # <= 2% armed-overhead contract is measured by bench.py
+            # measure_drift); disarmed cost is this one compare
+            det = self._drift_for(mv)
+            if det is not None:
+                try:
+                    det.offer(X, np.asarray(out))
+                except Exception as e:  # noqa: BLE001 — telemetry must
+                    log_warning(f"serve: drift sampling failed "
+                                f"({type(e).__name__}: {e})")  # never
+                    self._drift = None                         # fail a
+                    self._drift_tag = mv.tag                   # batch
         done = time.monotonic()
         walk_ms = (done - t_collect) * 1e3
         if trace.enabled():
@@ -616,6 +704,13 @@ def serve_config_from(config) -> ServeConfig:
         timeout_ms=config.serve_timeout_ms,
         degrade_trees=config.serve_degrade_trees,
         f64_scores=config.predict_f64_scores,
+        drift_sample_rows=config.drift_sample_rows,
+        drift_per_batch_rows=config.drift_per_batch_rows,
+        drift_min_rows=config.drift_min_rows,
+        drift_psi_threshold=config.drift_psi_threshold,
+        drift_top_k=config.drift_top_k,
+        drift_psi_groups=config.drift_psi_groups,
+        drift_sample_stride=config.drift_sample_stride,
         retry_max=config.serve_retry_max,
         retry_backoff_ms=config.serve_retry_backoff_ms,
         breaker_failures=config.serve_breaker_failures,

@@ -1,0 +1,490 @@
+"""Model & data drift observability (ISSUE 14) — obs/drift.py +
+obs/model.py + the serving wiring.
+
+Covers the drift math on constructed distributions (PSI known-value
+pins, grouping, unseen-bin/NaN-rate edge cases), the sampling-ring
+bounds, reference capture/serialization (incl. the streamed-vs-resident
+byte-equality contract and the checkpoint member), and the serve-path
+detection loop (clean traffic quiet, injected skew detected, capped
+Prometheus cardinality, drift.alert events, GET /drift).
+"""
+
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+import lightgbmv1_tpu as lgb
+from lightgbmv1_tpu.obs import events as obs_events
+from lightgbmv1_tpu.obs.drift import (DriftConfig, DriftDetector,
+                                      SamplingRing, group_bins,
+                                      grouped_counts, psi)
+from lightgbmv1_tpu.obs.model import ModelReference, ModelReferenceError
+
+
+# ---------------------------------------------------------------------------
+# PSI math on constructed distributions
+# ---------------------------------------------------------------------------
+
+
+def test_psi_known_value():
+    """Hand-computed pin: p=(0.5,0.5), q=(0.8,0.2) ->
+    0.3*ln(1.6) + (-0.3)*ln(0.4) = 0.4158883."""
+    val = psi([50, 50], [80, 20])
+    want = 0.3 * np.log(1.6) - 0.3 * np.log(0.4)
+    assert abs(val - want) < 1e-12
+    # symmetric-ish check the same way: identical distributions are 0
+    assert psi([10, 20, 30], [1, 2, 3]) == pytest.approx(0.0, abs=1e-12)
+
+
+def test_psi_counts_scale_invariant():
+    assert psi([5, 5], [8, 2]) == pytest.approx(psi([50, 50], [80, 20]),
+                                                abs=1e-12)
+
+
+def test_psi_empty_sides_and_mismatch():
+    assert psi([0, 0], [1, 2]) == 0.0      # no reference evidence
+    assert psi([1, 2], [0, 0]) == 0.0      # no serving evidence
+    with pytest.raises(ValueError):
+        psi([1, 2, 3], [1, 2])
+
+
+def test_psi_empty_bin_bounded_by_eps():
+    """A bin that is empty on one side contributes a bounded term (the
+    eps clip), never infinity."""
+    v = psi([1, 0], [0, 1], eps=1e-4)
+    assert np.isfinite(v)
+    # both terms ~ln(1e4): (1e-4-1)ln(1e-4) + (1-1e-4)ln(1e4)
+    want = (1e-4 - 1) * np.log(1e-4 / 1.0) + (1 - 1e-4) * np.log(1 / 1e-4)
+    assert v == pytest.approx(want, rel=1e-9)
+
+
+def test_group_bins_equal_mass():
+    # 8 bins of equal mass into 4 groups -> 2 bins per group
+    gid = group_bins([10] * 8, max_groups=4)
+    assert gid.tolist() == [0, 0, 1, 1, 2, 2, 3, 3]
+    # grouping is identity when bins already fit
+    assert group_bins([5, 5, 5], max_groups=16).tolist() == [0, 1, 2]
+    # degenerate all-zero reference still yields a bounded id range
+    gid0 = group_bins([0] * 30, max_groups=4)
+    assert gid0.max() <= 3
+    # heavy head: the big bin closes its group immediately and the
+    # adaptive target still spends the remaining groups on the tail
+    gid2 = group_bins([100, 1, 1, 1, 1, 1], max_groups=3)
+    assert gid2[0] == 0 and gid2[1] == 1 and gid2.max() == 2
+
+
+def test_grouped_counts_exact():
+    gid = group_bins([10] * 8, max_groups=4)
+    g = grouped_counts([1, 2, 3, 4, 5, 6, 7, 8], gid)
+    assert g.tolist() == [3, 7, 11, 15]
+
+
+def test_grouped_psi_noise_floor():
+    """The motivating property: a clean sample over MANY fine bins reads
+    spurious PSI ~bins/n; the same sample grouped to 16 equal-mass
+    buckets stays near zero."""
+    rng = np.random.RandomState(0)
+    ref = np.full(256, 400, np.int64)            # uniform reference
+    draw = np.bincount(rng.randint(0, 256, 2000), minlength=256)
+    raw = psi(ref, draw)
+    gid = group_bins(ref, 16)
+    grouped = psi(grouped_counts(ref, gid), grouped_counts(draw, gid))
+    assert raw > 0.05          # the fine-bin noise floor is real
+    assert grouped < 0.02      # and grouping removes it
+
+
+# ---------------------------------------------------------------------------
+# sampling ring
+# ---------------------------------------------------------------------------
+
+
+def test_sampling_ring_bounds():
+    ring = SamplingRing(capacity=100, num_features=3, score_dim=1)
+    X = np.arange(60.0).reshape(20, 3)
+    s = np.arange(20.0).reshape(20, 1)
+    taken = ring.offer(X, s, per_batch=8)
+    assert taken == 8
+    rows, scores = ring.sample()
+    assert rows.shape == (8, 3) and scores.shape == (8, 1)
+    # fill past capacity: the ring never exceeds it and the oldest
+    # samples are overwritten
+    for _ in range(30):
+        ring.offer(X, s, per_batch=8)
+    rows, _ = ring.sample()
+    assert rows.shape[0] == 100
+    st = ring.stats()
+    assert st["capacity"] == 100 and st["filled"] == 100
+    assert st["rows_seen"] == 31 * 20
+    assert st["rows_sampled"] == 31 * 8
+
+
+def test_sampling_ring_takes_whole_small_batch():
+    ring = SamplingRing(capacity=16, num_features=2, score_dim=2)
+    X = np.ones((3, 2))
+    s = np.zeros((3, 2))
+    assert ring.offer(X, s, per_batch=64) == 3
+    rows, sc = ring.sample()
+    assert rows.shape == (3, 2) and sc.shape == (3, 2)
+
+
+# ---------------------------------------------------------------------------
+# reference capture + serialization
+# ---------------------------------------------------------------------------
+
+
+def _small_problem(n=2000, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, 5)
+    X[:, 4] = rng.randint(0, 6, n)            # categorical
+    X[::9, 1] = np.nan                        # NaN missing
+    y = (X[:, 0] + (X[:, 4] == 2) > 0.3).astype(float)
+    return X, y
+
+
+def _train(X, y, rounds=3, **extra):
+    params = {"objective": "binary", "num_leaves": 15, "verbosity": -1,
+              "min_data_in_leaf": 10, **extra}
+    return lgb.train(params, lgb.Dataset(X, label=y,
+                                         categorical_feature=[4]),
+                     num_boost_round=rounds)
+
+
+@pytest.fixture(scope="module")
+def prob():
+    """One shared trained booster + reference + raw scores: every
+    consumer below only READS it (capture is idempotent, publish
+    copies), and a private retrain would pay ~2 s of jit compile per
+    test against the tier-1 wall budget."""
+    X, y = _small_problem()
+    bst = _train(X, y)
+    ref = bst.capture_model_reference()
+    raw = bst.predict(X, raw_score=True).reshape(-1, 1)
+    return X, y, bst, ref, raw
+
+
+def test_reference_roundtrip_and_digest(prob):
+    X, y, bst, ref, _ = prob
+    data = ref.to_bytes()
+    ref2 = ModelReference.from_bytes(data)
+    assert ref2.to_bytes() == data
+    assert ref2.digest == ref.digest
+    assert ref2.n_rows == len(y)
+    # any bit flip is rejected loudly
+    torn = bytearray(data)
+    torn[len(torn) // 2] ^= 0x40
+    with pytest.raises(ModelReferenceError):
+        ModelReference.from_bytes(bytes(torn))
+    with pytest.raises(ModelReferenceError):
+        ModelReference.from_bytes(b"not a reference")
+
+
+def test_rebin_matches_training_bins_exactly(prob):
+    """Re-binning the TRAINING rows through the reference's mappers must
+    reproduce the training bin codes bit-for-bit — the mappers ARE the
+    version's own (BinMapper.value_to_bin semantics incl. NaN routing
+    and categorical dictionaries)."""
+    X, y, bst, ref, _ = prob
+    codes, stats = ref.rebin(X)
+    binned = bst._gbdt.train_set.binned
+    for f in range(X.shape[1]):
+        np.testing.assert_array_equal(codes[:, f], binned[f],
+                                      err_msg=f"feature {f}")
+    # training rows are by definition fully seen and in range
+    assert stats["unseen"].sum() == 0
+    assert stats["clip"].sum() == 0
+    assert stats["nan"][1] == np.isnan(X[:, 1]).sum()
+
+
+def test_rebin_counters_unseen_clip_nan(prob):
+    X, y, bst, ref, _ = prob
+    Xs = X.copy()
+    Xs[:10, 4] = 77.0                  # unseen category
+    Xs[:20, 0] = 1e6                   # beyond the training range
+    Xs[:30, 2] = np.nan                # NaN on a no-NaN-at-train feature
+    _, stats = ref.rebin(Xs)
+    assert stats["unseen"][4] >= 10
+    assert stats["clip"][0] >= 20
+    assert stats["nan"][2] == 30
+    # shape mismatch is a loud error
+    with pytest.raises(ValueError):
+        ref.rebin(Xs[:, :3])
+
+
+def test_reference_nan_rate_and_score_psi(prob):
+    X, y, bst, ref, raw = prob
+    want_nan = np.isnan(X[:, 1]).mean()
+    assert ref.nan_rate[1] == pytest.approx(want_nan, abs=1e-12)
+    # scores drawn from the training distribution read ~0 drift; a
+    # constant far outside it reads large
+    assert ref.score_psi(raw) < 0.05
+    assert ref.score_psi(np.full((500, 1), 1e3)) > 1.0
+
+
+# tier-1 wall budget (tools/tier1_budget.py, the PR-6/7/10 discipline):
+# bench.py measure_drift re-asserts this byte-parity contract on every
+# capture (drift_ref_stream_parity_ok); the full suite still runs it
+@pytest.mark.slow
+def test_capture_streamed_vs_resident_byte_identical():
+    """The acceptance contract: the serialized reference of the
+    streaming trainer is BYTE-IDENTICAL to the resident trainer's at
+    the parity schedule (int64 occupancy sums + bit-equal score
+    caches)."""
+    rng = np.random.RandomState(3)
+    n = 3000
+    X = rng.randn(n, 4)
+    y = (X[:, 0] > 0).astype(float)
+    params = {"objective": "binary", "num_leaves": 15, "verbosity": -1,
+              "tree_growth": "leafwise_masked", "seed": 5, "max_bin": 63}
+    ds = lgb.Dataset(X, label=y, params=dict(params))
+    ds.construct()
+    b_res = lgb.train(dict(params), ds, num_boost_round=2,
+                      verbose_eval=False)
+    ref_res = b_res.capture_model_reference()
+    with tempfile.TemporaryDirectory() as td:
+        cache = os.path.join(td, "blocks")
+        ds.save_block_cache(cache, block_rows=512)
+        sds = lgb.Dataset(cache, params=dict(params))
+        b_str = lgb.train(dict(params), sds, num_boost_round=2,
+                          verbose_eval=False)
+        ref_str = b_str.capture_model_reference()
+    assert b_res.model_to_string() == b_str.model_to_string()
+    assert ref_res.to_bytes() == ref_str.to_bytes()
+
+
+def test_checkpoint_carries_reference(prob):
+    from lightgbmv1_tpu.io.checkpoint import load_checkpoint
+
+    X, y, bst, ref, _ = prob
+    with tempfile.TemporaryDirectory() as td:
+        path = os.path.join(td, "ck.bundle")
+        bst.save_checkpoint(path)
+        bundle = load_checkpoint(path)
+        rb = bundle["reference_bytes"]
+        assert rb
+        assert "reference.bin" in bundle["manifest"]["digests"]
+        ref = ModelReference.from_bytes(rb)
+        assert ref.to_bytes() == bst._model_reference.to_bytes()
+        # opt-out writes a reference-free bundle that still loads
+        path2 = os.path.join(td, "ck2.bundle")
+        bst.save_checkpoint(path2, with_reference=False)
+        assert load_checkpoint(path2)["reference_bytes"] == b""
+
+
+# ---------------------------------------------------------------------------
+# detector on constructed data (no server)
+# ---------------------------------------------------------------------------
+
+
+def test_detector_min_rows_gate_and_detection(prob):
+    X, y, bst, ref, raw = prob
+    cfg = DriftConfig(sample_rows=1024, min_rows=400, psi_threshold=0.25,
+                      per_batch_rows=1024, sample_stride=1)
+    det = DriftDetector(ref, cfg)
+    det.offer(X[:100], raw[:100])
+    ev = det.evaluate()
+    assert ev["evaluated"] is False and ev["psi_max"] is None
+    det.offer(X[100:1000], raw[100:1000])
+    ev = det.evaluate()
+    assert ev["evaluated"] is True
+    assert ev["psi_max"] < 0.1 and not ev["alerting"]
+    # inject: shift feature 0 by +3 sigma
+    Xs = X[:1000].copy()
+    Xs[:, 0] += 3.0
+    det2 = DriftDetector(ref, cfg)
+    det2.offer(Xs, raw[:1000])
+    ev2 = det2.evaluate()
+    assert "Column_0" in ev2["alerting"]
+    assert ev2["top"][0]["feature"] == "Column_0"
+    assert ev2["psi_max"] >= 0.25
+    assert ev2["out_of_range_total"] > 0
+
+
+def test_detector_alert_event_enter_once(prob):
+    X, y, bst, ref, raw = prob
+    Xs = X[:1000].copy()
+    Xs[:, 0] += 3.0
+    det = DriftDetector(ref, DriftConfig(sample_rows=1024, min_rows=400,
+                                         per_batch_rows=1024,
+                                         sample_stride=1),
+                        version_tag="vT")
+    det.offer(Xs, raw[:1000])
+    n0 = len([e for e in obs_events.tail(512)
+              if e.get("kind") == "drift.alert"])
+    det.evaluate()
+    n1 = len([e for e in obs_events.tail(512)
+              if e.get("kind") == "drift.alert"])
+    det.evaluate()        # still alerting: NO new event (enter-only)
+    n2 = len([e for e in obs_events.tail(512)
+              if e.get("kind") == "drift.alert"])
+    assert n1 > n0
+    assert n2 == n1
+    ev = [e for e in obs_events.tail(512)
+          if e.get("kind") == "drift.alert"][-1]
+    assert ev["fields"]["version"] == "vT"
+
+
+def test_detector_capped_prometheus_cardinality():
+    """Only the top-K drifting features hold a nonzero gauge — the
+    exposition stays bounded however many features drift."""
+    from lightgbmv1_tpu.obs.metrics import Registry
+
+    rng = np.random.RandomState(1)
+    n = 1500
+    X = rng.randn(n, 12)
+    y = (X[:, 0] > 0).astype(float)
+    bst = lgb.train({"objective": "binary", "num_leaves": 7,
+                     "verbosity": -1}, lgb.Dataset(X, label=y),
+                    num_boost_round=2)
+    ref = bst.capture_model_reference()
+    raw = bst.predict(X, raw_score=True).reshape(-1, 1)
+    reg = Registry()
+    det = DriftDetector(ref, DriftConfig(sample_rows=1024, min_rows=200,
+                                         top_k=3, per_batch_rows=1024,
+                                         sample_stride=1),
+                        registry=reg)
+    Xs = X.copy() + 2.5          # shift EVERY feature
+    det.offer(Xs[:1000], raw[:1000])
+    ev = det.evaluate()
+    assert len(ev["top"]) == 3
+    m = reg.get("drift_feature_psi")
+    nonzero = [k for k, c in m.children() if c.value > 0]
+    assert len(nonzero) == 3
+    assert int(reg.get("drift_features_alerting").get()) \
+        == len(ev["alerting"])
+
+
+# ---------------------------------------------------------------------------
+# serving-path integration
+# ---------------------------------------------------------------------------
+
+
+def _drift_server(bst, ref, **over):
+    from lightgbmv1_tpu.serve import Server
+    from lightgbmv1_tpu.serve.server import ServeConfig
+
+    cfg = ServeConfig(max_batch_delay_ms=0.5, drift_sample_rows=2048,
+                      drift_min_rows=200, drift_sample_stride=1, **over)
+    srv = Server(config=cfg)
+    srv.publish(bst, model_reference=ref)
+    return srv
+
+
+def test_serve_drift_clean_then_skew(prob):
+    X, y, bst, ref, _ = prob
+    srv = _drift_server(bst, ref)
+    try:
+        for i in range(0, 1200, 100):
+            srv.submit(X[i:i + 100])
+        snap = srv.drift_snapshot()
+        assert snap["armed"] and snap["evaluated"]
+        assert snap["psi_max"] < 0.25 and not snap["alerting"]
+        Xs = X.copy()
+        Xs[:, 0] += 3.0
+        for i in range(0, 1200, 100):
+            srv.submit(Xs[i:i + 100])
+        snap2 = srv.drift_snapshot()
+        assert "Column_0" in snap2["alerting"]
+        assert snap2["version"] == srv.version()
+        prom = srv.metrics.registry.prometheus_text()
+        assert "drift_psi_max" in prom and "drift_feature_psi" in prom
+    finally:
+        srv.close()
+
+
+def test_serve_drift_disarmed_is_off(prob):
+    X, y, bst, ref, _ = prob
+    from lightgbmv1_tpu.serve import Server
+    from lightgbmv1_tpu.serve.server import ServeConfig
+
+    srv = Server(config=ServeConfig(max_batch_delay_ms=0.5))
+    try:
+        srv.publish(bst, model_reference=ref)
+        srv.submit(X[:64])
+        snap = srv.drift_snapshot()
+        assert snap["armed"] is False and "reason" in snap
+        assert srv._drift is None          # never built
+        assert "drift_psi_max" not in srv.metrics.registry.prometheus_text()
+    finally:
+        srv.close()
+
+
+def test_serve_drift_no_reference_published(prob):
+    X, y, bst, ref, _ = prob
+    from lightgbmv1_tpu.serve import Server
+    from lightgbmv1_tpu.serve.server import ServeConfig
+
+    srv = Server(config=ServeConfig(max_batch_delay_ms=0.5,
+                                    drift_sample_rows=512))
+    try:
+        srv.publish(bst)                   # no model_reference in meta
+        srv.submit(X[:64])
+        snap = srv.drift_snapshot()
+        assert snap["armed"] is True
+        assert "no model_reference" in snap.get("reason", "")
+    finally:
+        srv.close()
+
+
+def test_serve_drift_follows_version_swap(prob):
+    """The detector re-anchors to the new version's OWN reference on
+    publish — samples and judgement never mix versions."""
+    X, y, bst, ref, _ = prob
+    srv = _drift_server(bst, ref)
+    try:
+        for i in range(0, 600, 100):
+            srv.submit(X[i:i + 100])
+        tag1 = srv.version()
+        assert srv.drift_snapshot()["version"] == tag1
+        bst2 = _train(X, y, rounds=2, num_leaves=7)
+        ref2 = bst2.capture_model_reference()
+        srv.publish(bst2, model_reference=ref2)
+        srv.submit(X[:100])
+        snap = srv.drift_snapshot()
+        assert snap["version"] != tag1
+        # the fresh detector's ring restarted: only the post-swap rows
+        assert snap["ring"]["rows_seen"] == 100
+    finally:
+        srv.close()
+
+
+def test_drift_ok_wired_into_gate_and_sentinel():
+    """CI wiring (ISSUE 14 satellite): drift_ok is part of the default
+    required-guard set and the trend sentinel watches the probe's
+    detection magnitude."""
+    import sys
+
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "tools"))
+    import bench_trend
+    import ci_gate
+
+    assert "drift_ok" in ci_gate.REQUIRED_GUARDS
+    assert any(f == "drift_injected_psi" and d == "up"
+               for f, d, _ in bench_trend.WATCHED)
+
+
+def test_http_drift_endpoint(prob):
+    import json
+    import urllib.request
+
+    from lightgbmv1_tpu.serve.http import ServeHTTP
+
+    X, y, bst, ref, _ = prob
+    srv = _drift_server(bst, ref)
+    http = ServeHTTP(srv, port=0).start()
+    try:
+        for i in range(0, 600, 100):
+            srv.submit(X[i:i + 100])
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{http.port}/drift", timeout=10) as r:
+            payload = json.loads(r.read())
+        assert payload["armed"] is True
+        assert payload["version"] == srv.version()
+        assert "psi_max" in payload
+        json.dumps(payload)        # fully JSON-serializable
+    finally:
+        http.shutdown()
+        srv.close()

@@ -1,0 +1,226 @@
+"""Trainer quality telemetry + feature-importance parity (ISSUE 14).
+
+* ``feature_importance(importance_type="gain"|"split")`` parity against
+  the reference semantics: split counts / split-gain sums over the
+  internal nodes, int64 for counts, iteration slicing, and agreement
+  with the model text's own ``feature_importances`` block (the
+  independently serialized view the reference C++ writes).
+* ``quality_snapshot`` / ``publish_quality`` (obs/model.py): the
+  after-the-fact quality view — per-iteration gain/leaf/depth
+  aggregates, metric curves recorded by the engine loop, registry
+  publication.
+* ``ModelVersion`` meta: every published version carries its
+  importance; ``publish`` diffs the importance shift between versions
+  (``importance_shift`` + a ``serve.importance_shift`` event).
+"""
+
+import numpy as np
+import pytest
+
+import lightgbmv1_tpu as lgb
+from lightgbmv1_tpu.obs.model import importance_shift
+
+
+def _problem(n=2500, seed=0, f=6):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, f)
+    y = (X[:, 0] - 0.7 * X[:, 1] + 0.2 * rng.randn(n) > 0).astype(float)
+    return X, y
+
+
+def _train(X, y, rounds=4, **extra):
+    params = {"objective": "binary", "num_leaves": 15, "verbosity": -1,
+              **extra}
+    return lgb.train(params, lgb.Dataset(X, label=y),
+                     num_boost_round=rounds)
+
+
+# ---------------------------------------------------------------------------
+# importance parity
+# ---------------------------------------------------------------------------
+
+
+def test_importance_parity_against_trees():
+    """Reference semantics (gbdt.cpp FeatureImportance): 'split' counts
+    every internal node per feature (int), 'gain' sums split_gain
+    (float64) — recomputed here independently from the host trees."""
+    X, y = _problem()
+    bst = _train(X, y)
+    F = bst.num_feature()
+    want_split = np.zeros(F, np.int64)
+    want_gain = np.zeros(F, np.float64)
+    for t in bst._all_trees():
+        for i in range(t.num_leaves - 1):
+            want_split[t.split_feature[i]] += 1
+            want_gain[t.split_feature[i]] += t.split_gain[i]
+    got_split = bst.feature_importance("split")
+    got_gain = bst.feature_importance("gain")
+    assert got_split.dtype == np.int64
+    np.testing.assert_array_equal(got_split, want_split)
+    np.testing.assert_allclose(got_gain, want_gain, rtol=1e-12)
+    assert got_split.sum() == sum(
+        t.num_leaves - 1 for t in bst._all_trees())
+
+
+def test_importance_iteration_slicing():
+    X, y = _problem()
+    bst = _train(X, y, rounds=5)
+    full = bst.feature_importance("split")
+    first2 = bst.feature_importance("split", iteration=2)
+    want = np.zeros_like(full)
+    for t in bst._all_trees()[:2]:
+        for i in range(t.num_leaves - 1):
+            want[t.split_feature[i]] += 1
+    np.testing.assert_array_equal(first2, want)
+    assert first2.sum() <= full.sum()
+
+
+def test_importance_matches_model_text_block():
+    """The model file's ``feature_importances:`` section is the
+    reference's independently serialized view (split counts by default,
+    gains under saved_feature_importance_type=1) — ours must agree with
+    feature_importance() exactly."""
+    X, y = _problem()
+    for imp_type, params in (("split", {}),
+                             ("gain", {"saved_feature_importance_type": 1})):
+        bst = _train(X, y, **params)
+        imp = bst.feature_importance(imp_type)
+        names = bst.feature_name()
+        text = bst.model_to_string()
+        block = text.split("feature_importances:")[1].split("\n\n")[0]
+        parsed = {}
+        for line in block.strip().splitlines():
+            name, _, val = line.partition("=")
+            parsed[name] = float(val)
+        for f, name in enumerate(names):
+            want = float(imp[f])
+            if want > 0:
+                # gains serialize via %g (6 significant digits)
+                assert parsed[name] == pytest.approx(want, rel=1e-5), \
+                    (imp_type, name)
+            else:
+                assert name not in parsed
+        # descending order is part of the reference format
+        vals = list(parsed.values())
+        assert vals == sorted(vals, reverse=True)
+
+
+def test_importance_on_loaded_model_matches_trainer():
+    X, y = _problem()
+    bst = _train(X, y)
+    loaded = lgb.Booster(model_str=bst.model_to_string())
+    np.testing.assert_array_equal(loaded.feature_importance("split"),
+                                  bst.feature_importance("split"))
+    # gains round-trip through the %g model text — compare loosely
+    np.testing.assert_allclose(loaded.feature_importance("gain"),
+                               bst.feature_importance("gain"), rtol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# quality snapshot + registry publication
+# ---------------------------------------------------------------------------
+
+
+def test_quality_snapshot_fields_and_curves():
+    X, y = _problem()
+    params = {"objective": "binary", "num_leaves": 15, "verbosity": -1,
+              "metric": ["auc", "binary_logloss"]}
+    ds = lgb.Dataset(X, label=y)
+    evals = {}
+    bst = lgb.train(params, ds, num_boost_round=4, valid_sets=[ds],
+                    valid_names=["train"], evals_result=evals,
+                    verbose_eval=False)
+    qs = bst.quality_snapshot()
+    assert qs["n_trees"] == 4 and qs["n_iterations"] == 4
+    assert qs["split_gain"]["count"] == sum(
+        t.num_leaves - 1 for t in bst._all_trees())
+    assert qs["split_gain"]["p50"] <= qs["split_gain"]["p90"] \
+        <= qs["split_gain"]["max"]
+    assert len(qs["per_iteration"]) == 4
+    assert qs["per_iteration"][0]["leaves"] == \
+        bst._all_trees()[0].num_leaves
+    assert all(d["depth_max"] >= 1 for d in qs["per_iteration"])
+    # the engine loop recorded one point per iteration per metric
+    assert len(qs["metric_history"]["train:auc"]) == 4
+    assert len(qs["metric_history"]["train:binary_logloss"]) == 4
+    # curves agree with the callback-recorded evals_result
+    np.testing.assert_allclose(qs["metric_history"]["train:auc"],
+                               evals["train"]["auc"])
+    # importance views are consistent
+    assert qs["importance_top"][0]["index"] == \
+        int(np.argmax(bst.feature_importance("gain")))
+    assert qs["importance_split"] == \
+        [int(v) for v in bst.feature_importance("split")]
+
+
+def test_quality_snapshot_multiclass_iterations():
+    rng = np.random.RandomState(2)
+    X = rng.randn(1500, 5)
+    y = rng.randint(0, 3, 1500)
+    bst = lgb.train({"objective": "multiclass", "num_class": 3,
+                     "num_leaves": 7, "verbosity": -1},
+                    lgb.Dataset(X, label=y), num_boost_round=3)
+    qs = bst.quality_snapshot()
+    assert qs["n_trees"] == 9                  # 3 iters x 3 classes
+    assert qs["n_iterations"] == 3
+    assert qs["num_class"] == 3
+
+
+def test_publish_quality_lands_in_registry():
+    from lightgbmv1_tpu.obs.metrics import Registry
+    from lightgbmv1_tpu.obs.model import publish_quality
+
+    X, y = _problem()
+    ds = lgb.Dataset(X, label=y)
+    bst = lgb.train({"objective": "binary", "num_leaves": 15,
+                     "verbosity": -1, "metric": "auc"}, ds,
+                    num_boost_round=3, valid_sets=[ds],
+                    valid_names=["train"], evals_result={},
+                    verbose_eval=False)
+    reg = Registry()
+    publish_quality(bst.quality_snapshot(), registry=reg)
+    snap = reg.snapshot()
+    assert snap["train_trees_total"] == 3
+    assert snap["train_split_gain_count"] == 3     # one obs/iteration
+    assert snap["train_tree_leaves_mean"] > 1
+    assert snap['train_metric_last{dataset="train",metric="auc"}'] > 0.5
+    assert "train_split_gain" in reg.prometheus_text()
+
+
+def test_registry_meta_importance_and_shift():
+    from lightgbmv1_tpu.obs import events as obs_events
+    from lightgbmv1_tpu.serve.registry import ModelRegistry
+
+    X, y = _problem()
+    bst = _train(X, y)
+    reg = ModelRegistry()
+    reg.publish(bst)
+    mv1 = reg.current()
+    np.testing.assert_allclose(mv1.meta["importance_gain"],
+                               bst.feature_importance("gain"), rtol=1e-5)
+    assert mv1.meta["importance_split"] == \
+        [int(v) for v in bst.feature_importance("split")]
+    assert "importance_shift" not in mv1.meta      # first version
+    # second version trained on permuted columns: importance mass moves
+    bst2 = _train(np.ascontiguousarray(X[:, ::-1]), y)
+    reg.publish(bst2)
+    mv2 = reg.current()
+    shift = mv2.meta["importance_shift"]
+    assert mv2.meta["importance_shift_vs"] == mv1.tag
+    assert 0.0 < shift["l1"] <= 2.0
+    evs = [e for e in obs_events.tail(256)
+           if e.get("kind") == "serve.importance_shift"]
+    assert evs and evs[-1]["fields"]["tag"] == mv2.tag
+
+
+def test_importance_shift_math_pins():
+    assert importance_shift([1, 2, 3], [1, 2, 3])["l1"] == 0.0
+    # disjoint mass: maximal L1 distance of 2
+    s = importance_shift([1, 0], [0, 1])
+    assert s["l1"] == pytest.approx(2.0)
+    assert s["top_mover"] in (0, 1)
+    # length mismatch pads with zeros
+    s2 = importance_shift([1.0], [0.5, 0.5])
+    assert s2["l1"] == pytest.approx(1.0)
+    # empty/zero vectors are quiet, not a crash
+    assert importance_shift([0, 0], [0, 0])["l1"] == 0.0

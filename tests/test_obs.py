@@ -480,6 +480,53 @@ def test_registry_get_or_create_and_conflicts():
         a.inc(-1)                                 # counters only go up
 
 
+def test_label_cardinality_cap_with_overflow_counter():
+    """ISSUE 14 satellite (the ROADMAP item 4 label-explosion stress):
+    a labeled metric holds at most ``label_cardinality`` children; new
+    combinations beyond the cap collapse into ONE shared ``_overflow``
+    child and every collapsed write is counted in
+    ``obs_label_overflow_total{metric=...}`` — bounded exposition,
+    explicit overflow."""
+    reg = obs_metrics.Registry()
+    c = reg.counter("tenant_requests_total", "per-tenant requests",
+                    label_names=("tenant",), label_cardinality=4)
+    for i in range(10):
+        c.labels(tenant=f"t{i}").inc()
+    with c.lock:
+        n_children = len(c._children)
+    assert n_children == 5          # 4 real + 1 overflow
+    ov = c.labels(tenant="t9")      # routed to the shared overflow child
+    assert ov is c.labels(tenant="t8")
+    assert ov.get() == 6.0          # t4..t9 once each, minus... 6 writes
+    ovf = reg.get("obs_label_overflow_total")
+    assert ovf is not None
+    # every collapsed labels() call counted (6 creations + 2 lookups)
+    assert ovf.labels(metric="tenant_requests_total").get() == 8.0
+    # an EXISTING key keeps resolving to its own child past the cap
+    assert c.labels(tenant="t0").get() == 1.0
+    # the exposition stays bounded and carries the overflow series
+    text = reg.prometheus_text()
+    assert text.count('tenant_requests_total{tenant="') == 5
+    assert 'tenant="_overflow"' in text
+    assert "obs_label_overflow_total" in text
+    # snapshot() is equally bounded
+    snap = reg.snapshot()
+    assert sum(1 for k in snap
+               if k.startswith("tenant_requests_total{")) == 5
+
+
+def test_label_cardinality_default_is_generous():
+    """The default cap (256) never bites normal label usage."""
+    reg = obs_metrics.Registry()
+    g = reg.gauge("g", label_names=("k",))
+    assert g.label_cardinality == obs_metrics.DEFAULT_LABEL_CARDINALITY
+    for i in range(64):
+        g.labels(k=str(i)).set(i)
+    assert reg.get("obs_label_overflow_total") is None   # never created
+    with g.lock:
+        assert len(g._children) == 64
+
+
 def test_serve_metrics_adapter_parity_and_exposition(booster):
     """serve/metrics.py is a thin adapter over the registry: the JSON
     snapshot keeps its exact pre-obs key set, and the SAME store renders

@@ -355,6 +355,46 @@ def test_forensics_slo_section_renders_fields():
     assert "No forensics/SLO fields" in "\n".join(lines)
 
 
+def test_model_quality_section_renders_fields():
+    """The Model quality & drift section (ISSUE 14) is generated from
+    the BENCH drift_*/train_* fields (bench.py measure_drift): the
+    skew-injection probe's PSI figures, the quality telemetry summary
+    and the drift_ok guard all grep to record fields."""
+    import perf_report
+
+    rec = {
+        "drift_ok": True, "drift_injected_psi": 1.2709,
+        "drift_clean_psi_max": 0.0118, "drift_clean_false_alarms": 0,
+        "drift_overhead_frac": 0.0096,
+        "drift_ref_stream_parity_ok": True,
+        "train_split_gain_p50": 50.62, "train_split_gain_p90": 388.41,
+        "train_tree_leaves_mean": 31.0, "train_tree_depth_mean": 6.4,
+        "train_top_gain_features": ["Column_0", "Column_1"],
+    }
+    lines = []
+    perf_report.model_quality_section(lines.append, rec)
+    txt = "\n".join(lines)
+    assert "## Model quality & drift" in txt
+    for needle in ("1.2709", "0.0118", "0.0096", "drift_ok=True",
+                   "50.62", "388.41", "31", "6.4",
+                   "Column_0, Column_1", "skew-injection",
+                   "byte-identical", "`drift_sample_rows`",
+                   "`drift_psi_threshold`", "`GET /drift`"):
+        assert needle in txt, needle
+    # a record with no drift capture renders the placeholder
+    lines = []
+    perf_report.model_quality_section(lines.append, {})
+    assert "No model-quality fields" in "\n".join(lines)
+
+
+def test_perf_md_carries_model_quality_section():
+    """PERF.md (regenerated from the newest record) always carries the
+    Model quality section — placeholder or rendered."""
+    with open(os.path.join(REPO, "PERF.md")) as fh:
+        txt = fh.read()
+    assert "## Model quality & drift" in txt
+
+
 def test_fleet_section_renders_fields():
     """The Fleet section (ISSUE 11) is generated from the BENCH fleet_*
     / router_* fields (bench.py measure_fleet): the loadgen-under-kill

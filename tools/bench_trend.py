@@ -95,6 +95,13 @@ WATCHED: Tuple[Tuple[str, str, float], ...] = (
     # clock bar; fused_ok / fused_parity_ok are booleans the guard
     # sweep flags automatically
     ("hist_split_fused_ms_per_iter", "down", 0.10),
+    # model-quality & drift (ISSUE 14): the skew-injection probe's
+    # detection magnitude is deterministic (same shift, same shape) —
+    # a capture where the injected PSI collapses means the detector
+    # lost power.  drift_overhead_frac is deliberately NOT watched
+    # (sub-noise-floor fraction; the drift_ok guard already enforces
+    # the <= 2% contract), like the other methodology-coupled fields.
+    ("drift_injected_psi", "up", 0.25),
 )
 
 _PARITY_RE = re.compile(r"dryrun_multichip PARITY (\{.*\})")

@@ -42,7 +42,7 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 import bench_trend  # noqa: E402
 import tier1_budget  # noqa: E402
 
-# the full post-ISSUE-13 driver guard set: ``--require-guards default``
+# the full post-ISSUE-14 driver guard set: ``--require-guards default``
 # expands to this, so the driver command line stops rotting as guards
 # are added (a new *_ok lands here in the same PR that records it);
 # obs_device_ok is the device-truth telemetry guard (compile counters,
@@ -50,10 +50,13 @@ import tier1_budget  # noqa: E402
 # measure_obs); fused_ok is the fused wave-round megakernel guard
 # (bit parity with the staged path AND, on device, the merged
 # hist+split round at or under the staged phases — bench.py
-# measure_fused / measure_fused_round_ms)
+# measure_fused / measure_fused_round_ms); drift_ok is the
+# model-quality guard (skew-injection probe detected + zero clean
+# false alarms + streamed-vs-resident reference byte parity + armed
+# sampling within the <= 2% serving contract — bench.py measure_drift)
 REQUIRED_GUARDS = ("obs_ok", "slo_ok", "forensics_ok", "chaos_ok",
                    "fleet_ok", "chaos_fleet_ok", "obs_device_ok",
-                   "fused_ok")
+                   "fused_ok", "drift_ok")
 
 
 def check_required_guards(records_dir: str, guards, out=print) -> bool:

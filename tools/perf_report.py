@@ -515,6 +515,58 @@ def forensics_slo_section(w, rec):
     w("")
 
 
+def model_quality_section(w, rec):
+    """Model quality & drift (ISSUE 14 — bench.py measure_drift +
+    obs/model.py + obs/drift.py): the trainer quality telemetry summary
+    and the serving-side skew-injection probe (clean traffic quiet,
+    injected shift detected, streamed-vs-resident reference byte
+    parity, armed-sampling overhead vs the <= 2% contract).
+    Placeholder until the first capture that carries the fields."""
+    w("## Model quality & drift (reference capture + skew detection, "
+      "obs/model.py + obs/drift.py)")
+    w("")
+    if rec.get("drift_ok") is None:
+        w("No model-quality fields in this record yet — the next driver "
+          "capture runs bench.py's measure_drift (deterministic "
+          "skew-injection probe against a drift-armed server, the "
+          "streamed-vs-resident reference byte-parity check, the armed "
+          "sampling overhead A/B, and the trainer quality telemetry "
+          "summary) and this section renders the injected/clean PSI, "
+          "the split-gain and tree-shape aggregates, and the `drift_ok` "
+          "guard.")
+        w("")
+        return
+    w("| injected PSI | clean PSI max | clean false alarms | "
+      "overhead frac | stream ref parity |")
+    w("|---|---|---|---|---|")
+    w(f"| {get(rec, 'drift_injected_psi', 4)} | "
+      f"{get(rec, 'drift_clean_psi_max', 4)} | "
+      f"{get(rec, 'drift_clean_false_alarms', 0)} | "
+      f"{get(rec, 'drift_overhead_frac', 4)} | "
+      f"{rec.get('drift_ref_stream_parity_ok')} |")
+    w("")
+    top = rec.get("train_top_gain_features") or []
+    w(f"Trainer quality telemetry: split gain p50 "
+      f"{get(rec, 'train_split_gain_p50')} / p90 "
+      f"{get(rec, 'train_split_gain_p90')}, mean "
+      f"{get(rec, 'train_tree_leaves_mean')} leaves / depth "
+      f"{get(rec, 'train_tree_depth_mean')} per tree"
+      + (f"; top gain features: {', '.join(top)}" if top else "")
+      + ".")
+    w("")
+    w(f"Guard `drift_ok={rec.get('drift_ok')}`: the +3-sigma "
+      "skew-injection probe is DETECTED (injected feature alerts, "
+      "ranks top-1, publishes a `drift.alert` event) AND clean traffic "
+      "raises zero false alarms AND the serialized training reference "
+      "is byte-identical between the resident and streaming trainers "
+      "AND armed sampling stays within the <= 2% serving contract "
+      f"(`drift_overhead_frac={get(rec, 'drift_overhead_frac', 4)}`).  "
+      "Knobs: `drift_sample_rows` (hard-off default 0), "
+      "`drift_psi_threshold`, `drift_top_k`, `drift_sample_stride` "
+      "(BASELINE.md); `GET /drift` serves the evaluation.")
+    w("")
+
+
 def fleet_section(w, rec):
     """Fault-tolerant fleet (ISSUE 11 — bench.py measure_fleet): the
     replica-kill-under-loadgen drill (zero client-visible errors,
@@ -857,6 +909,8 @@ def generate(rec, name, prev=None, prev_name=None):
     device_truth_section(w, rec)
 
     forensics_slo_section(w, rec)
+
+    model_quality_section(w, rec)
 
     fleet_section(w, rec)
 
