@@ -91,6 +91,7 @@ the exact-fp32 histogram path (tests/test_phase_attrib.py).
 
 from __future__ import annotations
 
+import contextlib
 from typing import Callable, NamedTuple, Optional
 
 import jax
@@ -98,12 +99,12 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
-from ..io.binning import MISSING_NAN, MISSING_ZERO
 from ..ops.split import (
     NO_CONSTRAINT,
     FeatureMeta,
     SplitParams,
     find_best_split,
+    go_left_rule,
     leaf_output,
     smooth_output,
 )
@@ -722,7 +723,16 @@ def make_wave_grower(
     buckets — the fused path quantizes through the same
     ``sr_quantize_g3`` stream, so the (iteration, round) determinism
     contract and the root/ramp never-quantize rule are shared, not
-    re-implemented.  Trees are bit-identical to the staged path on the
+    re-implemented.  A ROUTING-CAPABLE ``fused_round_fn``
+    (``supports_route`` + the ``route_rows`` valid-set router, ISSUE
+    15) additionally folds the round's PARTITION into the kernel: the
+    staged (S, N) decision pass is skipped, the kernel returns the
+    updated per-row leaf ids from the same sweep that accumulates the
+    histograms, the O(L) top-k and the dispatch run under one
+    ``lgbm.fused_round`` label, and the valid sets (in-round or the
+    pipelined drain) ride the kernel's decision stage instead of the
+    staged gather chain — the round reads the binned rows ONCE.
+    Trees are bit-identical to the staged path on the
     same histogram arithmetic (tests/test_wave_fused.py pins this in
     interpret mode).
     ``async_wave_pipeline`` (default on) software-pipelines the round
@@ -756,6 +766,16 @@ def make_wave_grower(
     store = (_PackedStore if fused_bookkeeping else _FieldStore)(
         L, L1, W, use_mc, use_cat)
     use_fused = fused_round_fn is not None
+    # single-pass wave round (ISSUE 15): a routing-capable fused_round_fn
+    # (ops/wave_fused.make_fused_round — supports_route + the route_rows
+    # valid-set router) folds the (S, N) partition into the kernel: the
+    # binned rows are swept ONCE per round, the kernel emits the updated
+    # leaf ids, and the valid sets ride the same decision stage.  The
+    # feature-parallel trainer wrapper deliberately lacks the capability
+    # (its shard sees only a feature slice), so it keeps the staged
+    # partition below.
+    use_fused_route = use_fused and getattr(fused_round_fn,
+                                            "supports_route", False)
     if use_fused:
         from ..ops.wave_fused import unpack_children as _unpack_children
 
@@ -888,17 +908,24 @@ def make_wave_grower(
             valid routing, evaluated over the rank-order (K,) split
             metadata (dead slots carry leaf id L and match no row).  The
             per-row update terms are int32 — exact and summation-order
-            free — so deferral is bit-identical to in-round routing."""
+            free — so deferral is bit-identical to in-round routing.
+            Under the routed fused kernel the drain rides the SAME
+            decision stage as the train rows (``route_rows`` — the
+            ISSUE 15 valid-set lane) instead of the staged gather
+            chain; ``route_tile`` shares ``go_left_rule`` with the
+            staged path, so the routing cannot diverge."""
             feats_k, thrs_k, dls_k = p["feats"], p["thrs"], p["dls"]
             leafs_k, nls_k = p["leafs"], p["nls"]
+            if use_fused_route:   # fused gate excludes categorical sets
+                return fused_round_fn.route_rows(
+                    vb, vl, feats=feats_k, thrs=thrs_k, dls=dls_k,
+                    leafs=leafs_k, nls=nls_k, num_leaves=L)
             mt_k = meta.missing_type[feats_k][:, None]
             bk = jax.vmap(lambda f: bins_of_fn(vb, f))(feats_k)
             bk = bk.astype(jnp.int32)
-            na = ((mt_k == MISSING_NAN)
-                  & (bk == meta.nan_bin[feats_k][:, None])) | (
-                (mt_k == MISSING_ZERO)
-                & (bk == meta.zero_bin[feats_k][:, None]))
-            g = jnp.where(na, dls_k[:, None], bk <= thrs_k[:, None])
+            g = go_left_rule(bk, thrs_k[:, None], dls_k[:, None], mt_k,
+                             meta.nan_bin[feats_k][:, None],
+                             meta.zero_bin[feats_k][:, None])
             if use_cat:
                 word = jnp.zeros(bk.shape, jnp.uint32)
                 for wv in range(W):
@@ -966,7 +993,17 @@ def make_wave_grower(
                 vlids_in = st.valid_lids
 
             budget = L - st.num_leaves
-            vals, leafs = _topk_by_rank(store.gains(st.store), K)  # (K,)
+            # routed fused rounds label the WHOLE round — the O(L) top-k
+            # slot ranking, the in-kernel routing + histogram + scan and
+            # the residue pick — as one `lgbm.fused_round` region, so
+            # compile/cost/roofline telemetry (and the trace phase
+            # profile's merged `phase_round_fused_ms` row) see a single
+            # labeled executable instead of a partition/top-k residue
+            fr_scope = (jax.named_scope("lgbm.fused_round") if use_fused
+                        else contextlib.nullcontext())
+            with fr_scope:
+                vals, leafs = _topk_by_rank(store.gains(st.store),
+                                            K)             # (K,)
             valid = (vals > 0) & (kiota < budget)
             if use_inter and K > 1:
                 # soundness: two leaves ADJACENT along a monotone feature
@@ -1162,15 +1199,15 @@ def make_wave_grower(
 
                 def go_left_s(matrix):
                     """(S, rows) left-decision of this round's splits —
-                    shared by the train partition and valid routing."""
+                    shared by the train partition and valid routing
+                    (``go_left_rule`` is the single decision source,
+                    shared with the fused kernel's routing stage)."""
                     mt_k = meta.missing_type[feats_s][:, None]
                     bk = jax.vmap(lambda f: bins_of_fn(matrix, f))(feats_s)
                     bk = bk.astype(jnp.int32)
-                    na = ((mt_k == MISSING_NAN)
-                          & (bk == meta.nan_bin[feats_s][:, None])) | (
-                        (mt_k == MISSING_ZERO)
-                        & (bk == meta.zero_bin[feats_s][:, None]))
-                    g = jnp.where(na, dls_s[:, None], bk <= thrs_s[:, None])
+                    g = go_left_rule(bk, thrs_s[:, None], dls_s[:, None],
+                                     mt_k, meta.nan_bin[feats_s][:, None],
+                                     meta.zero_bin[feats_s][:, None])
                     if use_cat:  # categorical bitset membership (bin-space)
                         word = jnp.zeros(bk.shape, jnp.uint32)
                         for wv in range(W):
@@ -1182,37 +1219,58 @@ def make_wave_grower(
                     return g
 
                 siota = jnp.arange(S, dtype=jnp.int32)
-                with jax.named_scope("lgbm.partition"):
-                    gl = go_left_s(binned)                    # (S, N)
-                    mine = st.leaf_id[None, :] == leafs_s[:, None]
-                    go_r = mine & (~gl)                       # disjoint rows
-                    leaf_id = st.leaf_id + jnp.sum(
-                        jnp.where(go_r, nls_s[:, None] - st.leaf_id[None, :],
-                                  0), axis=0)
+                if use_fused_route:
+                    # ---- single-pass round (ISSUE 15): NO staged
+                    # partition — the fused kernel evaluates the go-left
+                    # decisions while sweeping the rows for the
+                    # histograms and returns the updated leaf ids; valid
+                    # sets ride the same decision stage (in-round here,
+                    # via the drain above when pipelined)
+                    label = leaf_id = None
                     vl_new = []
                     if not pipeline:
-                        # pipelined rounds defer valid routing to the next
-                        # body's drain (route_pending) — off this round's
-                        # critical path, bit-identical updates
-                        for vb, vl in zip(valids, st.valid_lids):
-                            gv = go_left_s(vb)
-                            mine_v = vl[None, :] == leafs_s[:, None]
-                            go_rv = mine_v & (~gv)
-                            vl_new.append(vl + jnp.sum(
-                                jnp.where(go_rv,
-                                          nls_s[:, None] - vl[None, :], 0),
-                                axis=0))
-                    if use_sub:
-                        # label only the SMALLER child of each split (known
-                        # up front from the recorded left/right counts)
-                        in_small = gl == sml_s[:, None]
-                        label = jnp.sum(
-                            jnp.where(mine & in_small, siota[:, None] - S, 0),
-                            axis=0) + S
-                    else:
-                        slot2 = 2 * siota[:, None] + (~gl).astype(jnp.int32)
-                        label = jnp.sum(jnp.where(mine, slot2 - 2 * S, 0),
-                                        axis=0) + 2 * S
+                        vl_new = [fused_round_fn.route_rows(
+                            vb, vl, feats=feats_s, thrs=thrs_s,
+                            dls=dls_s, leafs=leafs_s, nls=nls_s,
+                            num_leaves=L)
+                            for vb, vl in zip(valids, st.valid_lids)]
+                else:
+                    with jax.named_scope("lgbm.partition"):
+                        gl = go_left_s(binned)                # (S, N)
+                        mine = st.leaf_id[None, :] == leafs_s[:, None]
+                        go_r = mine & (~gl)                   # disjoint rows
+                        leaf_id = st.leaf_id + jnp.sum(
+                            jnp.where(go_r,
+                                      nls_s[:, None] - st.leaf_id[None, :],
+                                      0), axis=0)
+                        vl_new = []
+                        if not pipeline:
+                            # pipelined rounds defer valid routing to the
+                            # next body's drain (route_pending) — off this
+                            # round's critical path, bit-identical updates
+                            for vb, vl in zip(valids, st.valid_lids):
+                                gv = go_left_s(vb)
+                                mine_v = vl[None, :] == leafs_s[:, None]
+                                go_rv = mine_v & (~gv)
+                                vl_new.append(vl + jnp.sum(
+                                    jnp.where(go_rv,
+                                              nls_s[:, None] - vl[None, :],
+                                              0),
+                                    axis=0))
+                        if use_sub:
+                            # label only the SMALLER child of each split
+                            # (known up front from the recorded counts)
+                            in_small = gl == sml_s[:, None]
+                            label = jnp.sum(
+                                jnp.where(mine & in_small,
+                                          siota[:, None] - S, 0),
+                                axis=0) + S
+                        else:
+                            slot2 = 2 * siota[:, None] \
+                                + (~gl).astype(jnp.int32)
+                            label = jnp.sum(
+                                jnp.where(mine, slot2 - 2 * S, 0),
+                                axis=0) + 2 * S
 
                 # sustained rounds (the LARGEST bucket of a big wave) may
                 # run the configured cheaper deep precision; ramp rounds
@@ -1241,7 +1299,13 @@ def make_wave_grower(
                         pr = jnp.zeros((S,) + h_parent.shape[1:],
                                        jnp.float32) \
                             .at[sidx].set(h_parent, mode="drop")
-                    packed, h_sm, hsc = fused_round_fn(
+                    route = None
+                    if use_fused_route:
+                        route = dict(leaf_id=st.leaf_id, feats=feats_s,
+                                     thrs=thrs_s, dls=dls_s,
+                                     leafs=leafs_s, nls=nls_s,
+                                     num_leaves=L)
+                    fr_out = fused_round_fn(
                         binned, g3, label, S, deep=deep,
                         quant_key=rkey if S in quant_buckets else None,
                         scaled=bool(quant_buckets),
@@ -1251,7 +1315,11 @@ def make_wave_grower(
                         depth=to_cslot(cdepth, 1),
                         pout=to_cslot(couts, 0.0),
                         sml=sml_s if use_sub else None,
-                        parent=pr)
+                        parent=pr, route=route)
+                    if use_fused_route:
+                        packed, h_sm, hsc, leaf_id = fr_out
+                    else:
+                        packed, h_sm, hsc = fr_out
                     if S < K:   # pad to the bucket-invariant width
                         packed = jnp.pad(packed,
                                          ((0, 2 * (K - S)), (0, 0)))
@@ -1284,14 +1352,17 @@ def make_wave_grower(
                         axis=0)
                 return (h, hsc, leaf_id) + tuple(vl_new)
 
-            if len(slot_buckets) > 1:
-                s_idx = jnp.zeros((), jnp.int32)
-                for S in slot_buckets[:-1]:
-                    s_idx = s_idx + (n_split > S).astype(jnp.int32)
-                outs = lax.switch(
-                    s_idx, [lambda S=S: round_pass(S) for S in slot_buckets])
-            else:
-                outs = round_pass(slot_buckets[0])
+            with (jax.named_scope("lgbm.fused_round") if use_fused
+                  else contextlib.nullcontext()):
+                if len(slot_buckets) > 1:
+                    s_idx = jnp.zeros((), jnp.int32)
+                    for S in slot_buckets[:-1]:
+                        s_idx = s_idx + (n_split > S).astype(jnp.int32)
+                    outs = lax.switch(
+                        s_idx,
+                        [lambda S=S: round_pass(S) for S in slot_buckets])
+                else:
+                    outs = round_pass(slot_buckets[0])
             if use_fused:
                 if use_sub:
                     packed, h_slot, hscale, leaf_id = outs[:4]

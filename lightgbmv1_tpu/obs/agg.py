@@ -120,6 +120,9 @@ PHASE_SCOPE_TOKENS: Dict[str, Tuple[str, ...]] = {
     "hist": ("lgbm.hist",),
     "split": ("lgbm.split",),
     "partition": ("lgbm.partition",),
+    # hist_method=fused single-pass round (ISSUE 15): top-k + routing +
+    # histogram + scan all carry this one label (grower + kernel)
+    "round_fused": ("lgbm.fused_round",),
 }
 
 

@@ -62,6 +62,26 @@ def tie_tol(best_gain, scale):
     return TIE_RTOL * (jnp.abs(scale) + b)
 
 
+def go_left_rule(bins, thr, dl, mt, nan_bin, zero_bin):
+    """The committed numerical split's go-left decision on raw bin ids —
+    bin compare plus the NaN/zero missing-direction rules (reference
+    ``NumericalBin::data + missing-type dispatch``, dense_bin.hpp:85-140).
+
+    All inputs broadcast (``bins`` is int32 bin ids, the rest per-split
+    scalars or column vectors; ``dl`` bool, ``mt``/``nan_bin``/
+    ``zero_bin`` int32).  Pure integer/bool ops — exact everywhere, so
+    the staged (S, N) partition pass (models/grower_wave.py
+    ``go_left_s``), the deferred valid-routing drain (``route_pending``)
+    and the fused megakernel's in-VMEM routing stage
+    (ops/wave_fused.py ``route_tile``) all evaluate the SAME code
+    object: the decision cannot drift between the paths.  Categorical
+    bitset membership stays with the callers that support it (the fused
+    gate excludes categorical datasets)."""
+    na = ((mt == MISSING_NAN) & (bins == nan_bin)) | (
+        (mt == MISSING_ZERO) & (bins == zero_bin))
+    return jnp.where(na, dl, bins <= thr)
+
+
 class SplitParams(NamedTuple):
     """Static-ish regularization parameters (traced scalars are fine too)."""
 

@@ -21,6 +21,22 @@ once.  This kernel keeps the round's histograms in VMEM end to end:
   half) — are composed AS THE SAME CODE OBJECTS on the VMEM values, so
   interpret-mode results are bit-identical to the staged path by
   construction, not by re-derivation,
+* the round's PARTITION rides the same pass (ISSUE 15, the single-pass
+  wave round): the feature-block-0 kernel invocation receives each
+  row's DECISION BIN (the committed split feature's bin for the row's
+  current leaf — one O(N) gather, the only extra touch of the binned
+  matrix) plus the packed per-slot split metadata, evaluates the
+  go-left decisions in VMEM with the staged partition's own
+  ``ops/split.go_left_rule`` (bin compare + the NaN/zero
+  missing-direction rules, op-for-op), writes the updated row→slot
+  label into its own output block and accumulates the child histograms
+  from it IN THE SAME SWEEP — the staged path's separate (S, N)
+  decision pass over the binned rows (``phase_partition_ms``) and its
+  HBM-resident mask intermediates disappear, and the kernel emits the
+  new per-row leaf ids as a second O(N) output.  Valid-set routing
+  rides the same decision stage (``fused_route_rows`` — a routing-only
+  grid over the valid binned matrix, same ``route_tile`` code object),
+  replacing the staged gather chain (``phase_valid_route_ms``),
 * only an O(F) per-(child, feature) residue (best gain, in-band pick,
   left sums at the pick — ``RES_COLS`` floats per feature) leaves the
   kernel; the grid iterates feature blocks and the cross-feature half of
@@ -50,9 +66,22 @@ parallel/trainer.py):
   cross-shard histogram reduce needs the explicit histogram on the wire;
   the feature-parallel learner DOES run the kernel per feature slice and
   elects through the existing ``_sync_best_split``,
+* feature-parallel partition (partition-specific) — the in-kernel
+  routing stage needs the committed split feature's GLOBAL column, but
+  each shard's kernel sees only its own feature slice; the
+  feature-parallel learner therefore keeps the staged (S, N) partition
+  and per-slice election while still fusing histogram + scan,
+* EFB / 4-bit packed decisions (partition-specific) — the go-left stage
+  compares raw uint8 bins; bundle-column and nibble decode happen in
+  ``bins_of_fn`` outside any kernel (these configs are already excluded
+  by the histogram gates above, so the partition gate never fires
+  alone),
 * Mosaic lowering failure on a device backend — auto-fallback with a
   warning, the ``predict_pallas`` precedent; the CPU backend always runs
   the kernel in interpret mode (the bit-parity lane the tests pin).
+  The lowering probe compiles the ROUTED round (partition folded in)
+  plus the valid-set router, so a backend that can fuse histograms but
+  not the routing stage still falls back cleanly.
 """
 
 from __future__ import annotations
@@ -73,6 +102,7 @@ from .split import (
     FeatureMeta,
     SplitResult,
     gain_shift,
+    go_left_rule,
     scan_direction_gains,
     scan_left_sums,
     scan_pick_feature,
@@ -81,17 +111,106 @@ from .split import (
 
 RES_COLS = 6    # fbest, gain_at_sel, sel (direction*B+thr), left g/h/c
 PACK_COLS = 10  # gain, feature, threshold, default_left, left(3), right(3)
+RMETA_COLS = 8  # leaf, new-leaf, thr, default_left, mtype, nan_bin,
+                # zero_bin, smaller-is-left — the packed per-slot split
+                # metadata the routing stage consumes (int32)
+
+
+def route_tile(dbin, oleaf, rmeta, *, nslots, sub, want_label=True):
+    """The fused decision stage on one row tile — pure jnp on VALUES, so
+    the megakernel (train rows), the routing-only valid-set kernel and
+    any host-side replay all run the SAME code object.
+
+    ``dbin`` (1, T) int32 — each row's DECISION bin: the bin of its
+    current leaf's committed split feature (rows of non-splitting
+    leaves carry an arbitrary bin; their ``mine`` mask is False).
+    ``oleaf`` (1, T) int32 — current leaf ids (pad rows carry -1).
+    ``rmeta`` (S, RMETA_COLS) int32 — per-slot split metadata; dead
+    slots carry leaf id ``num_leaves`` (matches no row).
+
+    Returns ``(new_leaf (1, T), label (1, T) or None)``: the updated
+    row→leaf routing and (``want_label``) the row→histogram-slot label
+    (smaller-child slot in subtraction mode, ``2s + right`` pool-free;
+    ``nslots`` = dead).  Mirrors the staged ``go_left_s`` partition
+    op-for-op — every update term is int32, so deferring/fusing is
+    bit-identical to the staged pass by construction."""
+    S = rmeta.shape[0]
+    leafs = rmeta[:, 0:1]
+    nls = rmeta[:, 1:2]
+    thr = rmeta[:, 2:3]
+    dl = rmeta[:, 3:4] != 0
+    mt = rmeta[:, 4:5]
+    nanb = rmeta[:, 5:6]
+    zb = rmeta[:, 6:7]
+    sml = rmeta[:, 7:8] != 0
+    mine = oleaf == leafs                                    # (S, T)
+    g = go_left_rule(dbin, thr, dl, mt, nanb, zb)            # (S, T)
+    new_leaf = oleaf + jnp.sum(
+        jnp.where(mine & (~g), nls - oleaf, 0), axis=0, keepdims=True)
+    if not want_label:
+        return new_leaf, None
+    siota = lax.broadcasted_iota(jnp.int32, (S, 1), 0)
+    if sub:
+        hit = mine & (g == sml)
+        slot = jnp.broadcast_to(siota, mine.shape)
+    else:
+        hit = mine
+        slot = 2 * siota + (~g).astype(jnp.int32)
+    label = jnp.sum(jnp.where(hit, slot - nslots, 0),
+                    axis=0, keepdims=True) + nslots
+    return new_leaf, label
+
+
+def pack_route_meta(feats, thrs, dls, leafs, nls, meta, sml=None):
+    """(S, RMETA_COLS) int32 routing metadata from rank/slot-order split
+    arrays + the feature meta — one place, so the megakernel's train
+    stage and the valid-set router cannot pack differently."""
+    feats = feats.astype(jnp.int32)
+    z = jnp.zeros_like(feats)
+    return jnp.stack([
+        leafs.astype(jnp.int32),
+        nls.astype(jnp.int32),
+        thrs.astype(jnp.int32),
+        dls.astype(jnp.int32),
+        meta.missing_type[feats].astype(jnp.int32),
+        meta.nan_bin[feats].astype(jnp.int32),
+        meta.zero_bin[feats].astype(jnp.int32),
+        (sml.astype(jnp.int32) if sml is not None else z),
+    ], axis=1)
+
+
+def decision_bins(binned, lids, feats, leafs, num_leaves):
+    """Each row's decision bin — ``binned[f(leaf(row)), row]`` via a
+    leaf→feature table and ONE per-element gather (O(N) bytes), the
+    only touch of the binned matrix the routing stage adds.  Rows of
+    non-splitting leaves read feature 0; their slot mask is False."""
+    tab = jnp.zeros(num_leaves + 1, jnp.int32) \
+        .at[leafs].set(feats.astype(jnp.int32), mode="drop")
+    f_of = tab[lids]                                        # (N,)
+    return jnp.take_along_axis(binned, f_of[None, :], axis=0)[0] \
+        .astype(jnp.int32)
 
 
 def _fused_kernel(*refs, nrt, lpad, num_bins, fblk, precision, interpret,
                   params, use_mc, monotone_penalty, has_contri, sub,
-                  apply_scale, child_scale, nslots, nchildren):
+                  apply_scale, child_scale, nslots, nchildren,
+                  route_blk=False):
     """Grid ``(1, row_tiles)``: every tile accumulates its rows via the
     REUSED ``hist_pallas._kernel``; the last tile runs the split scan on
     the VMEM accumulator and writes the per-feature residue (plus, in
-    subtraction mode, the raw smaller-child histograms)."""
-    names = ["iota", "bins", "g3", "leaf",
-             "nb", "mt", "nanb", "zb", "usbl", "mono"]
+    subtraction mode, the raw smaller-child histograms).
+
+    ``route_blk`` (feature block 0 of a routed round): the tile FIRST
+    evaluates the committed splits' go-left decisions (``route_tile`` on
+    the decision-bin/old-leaf tiles + the packed slot metadata), writes
+    the row→slot label into its own output block — which the remaining
+    feature blocks consume as their ``leaf`` input — and the new per-row
+    leaf ids, then accumulates this block's histogram FROM the label it
+    just produced: partition and histogram share one sweep of the rows.
+    """
+    names = ["iota", "bins", "g3"]
+    names += (["dbin", "oleaf", "rmeta"] if route_blk else ["leaf"])
+    names += ["nb", "mt", "nanb", "zb", "usbl", "mono"]
     if has_contri:
         names.append("contri")
     names += ["mask", "csums", "constr", "depth", "pout"]
@@ -104,10 +223,22 @@ def _fused_kernel(*refs, nrt, lpad, num_bins, fblk, precision, interpret,
     names.append("res")
     if sub:
         names.append("hsmall")
+    if route_blk:
+        names += ["lab", "nleaf"]
     names.append("acc")
     r = dict(zip(names, refs))
 
-    _hist_tile(r["iota"], r["bins"], r["g3"], r["leaf"], r["acc"],
+    if route_blk:
+        new_leaf, label = route_tile(
+            r["dbin"][...], r["oleaf"][...], r["rmeta"][...],
+            nslots=nslots, sub=sub)
+        r["lab"][...] = label
+        r["nleaf"][...] = new_leaf
+        leaf_ref = r["lab"]
+    else:
+        leaf_ref = r["leaf"]
+
+    _hist_tile(r["iota"], r["bins"], r["g3"], leaf_ref, r["acc"],
                lpad=lpad, num_bins=num_bins, fblk=fblk,
                precision=precision, interpret=interpret)
 
@@ -182,14 +313,19 @@ def fused_wave_scan(binned, g3, label, *, nslots, nchildren, num_bins,
                     precision, interpret, meta, params, use_mc,
                     monotone_penalty, mask, csums, constr, depth, pout,
                     cscale=None, sscale=None, sml=None, parent=None,
-                    apply_scale=False, row_tile=0):
+                    apply_scale=False, row_tile=0, route=None):
     """One fused wave round over all feature blocks.
 
     ``nslots`` counts the ACCUMULATED slots (smaller children in
     subtraction mode, all 2S children pool-free); slot ``nslots`` is the
     sacrificial dead-row slot, as in ``hist_wave``.  ``parent`` non-None
-    selects the subtraction-composed mode.  Returns ``(residue
-    (C, F, RES_COLS), hsmall (nslots, F, B, 3) or None)``.
+    selects the subtraction-composed mode.  ``route`` non-None (dict
+    ``dbin (N,) / oleaf (N,) / rmeta (S, RMETA_COLS)``) folds the
+    partition in: ``label`` is ignored (pass None) — feature block 0
+    evaluates the go-left decisions in VMEM, emits the label the other
+    blocks consume and the updated per-row leaf ids.  Returns
+    ``(residue (C, F, RES_COLS), hsmall (nslots, F, B, 3) or None,
+    new_leaf (N,) or None)``.
     """
     sub = parent is not None
     C = nchildren
@@ -212,8 +348,19 @@ def fused_wave_scan(binned, g3, label, *, nslots, nchildren, num_bins,
     binned_rm = jnp.pad(binned, ((0, f_pad - F), (0, n_pad - N)),
                         constant_values=255).T          # (n_pad, f_pad)
     g3t = jnp.pad(g3.astype(jnp.float32), ((0, n_pad - N), (0, 0))).T
-    leaf_p = jnp.pad(label.astype(jnp.int32), (0, n_pad - N),
-                     constant_values=lpad)[None, :]
+    if route is not None:
+        # pad rows: leaf -1 matches no slot -> the routing stage labels
+        # them with the dead slot (zero g3 anyway) and passes the -1
+        # leaf through (sliced off below)
+        dbin_p = jnp.pad(route["dbin"].astype(jnp.int32),
+                         (0, n_pad - N))[None, :]
+        oleaf_p = jnp.pad(route["oleaf"].astype(jnp.int32),
+                          (0, n_pad - N), constant_values=-1)[None, :]
+        rmeta = route["rmeta"].astype(jnp.int32)
+        leaf_p = None
+    else:
+        leaf_p = jnp.pad(label.astype(jnp.int32), (0, n_pad - N),
+                         constant_values=lpad)[None, :]
     iota_bins = (jnp.arange(B * fblk, dtype=jnp.int32)
                  // fblk).astype(jnp.float32)[None, :]
 
@@ -252,17 +399,30 @@ def fused_wave_scan(binned, g3, label, *, nslots, nchildren, num_bins,
         return pl.BlockSpec(shape, lambda fb, rt, _n=nd: (0,) * _n)
 
     res_blocks, hs_blocks = [], []
+    new_leaf = None
     for fb in range(nfb):
+        route_blk = route is not None and fb == 0
         sl = slice(fb * fblk, (fb + 1) * fblk)
-        ins = [iota_bins, binned_rm[:, sl], g3t, leaf_p,
-               nb_p[:, sl], mt_p[:, sl], nanb_p[:, sl], zb_p[:, sl],
-               us_p[:, sl], mono_p[:, sl]]
+        ins = [iota_bins, binned_rm[:, sl], g3t]
         specs = [
             pl.BlockSpec((1, fblk * B), lambda fb_, rt: (0, 0)),
             pl.BlockSpec((T, fblk), lambda fb_, rt: (rt, 0)),
             pl.BlockSpec((3, T), lambda fb_, rt: (0, rt)),
-            pl.BlockSpec((1, T), lambda fb_, rt: (0, rt)),
-        ] + [full_spec((1, fblk))] * 6
+        ]
+        if route_blk:
+            # block 0 routes: decision bins + old leaf ids per row tile,
+            # packed slot metadata resident; the label it emits becomes
+            # the remaining blocks' ``leaf`` input below
+            ins += [dbin_p, oleaf_p, rmeta]
+            specs += [pl.BlockSpec((1, T), lambda fb_, rt: (0, rt)),
+                      pl.BlockSpec((1, T), lambda fb_, rt: (0, rt)),
+                      full_spec(rmeta.shape)]
+        else:
+            ins.append(leaf_p)
+            specs.append(pl.BlockSpec((1, T), lambda fb_, rt: (0, rt)))
+        ins += [nb_p[:, sl], mt_p[:, sl], nanb_p[:, sl], zb_p[:, sl],
+                us_p[:, sl], mono_p[:, sl]]
+        specs += [full_spec((1, fblk))] * 6
         if has_contri:
             ins.append(contri_p[:, sl])
             specs.append(full_spec((1, fblk)))
@@ -288,8 +448,13 @@ def fused_wave_scan(binned, g3, label, *, nslots, nchildren, num_bins,
             out_shape.append(
                 jax.ShapeDtypeStruct((nslots, fblk, B, 3), jnp.float32))
             out_specs.append(full_spec((nslots, fblk, B, 3)))
+        if route_blk:
+            out_shape += [jax.ShapeDtypeStruct((1, n_pad), jnp.int32),
+                          jax.ShapeDtypeStruct((1, n_pad), jnp.int32)]
+            out_specs += [pl.BlockSpec((1, T), lambda fb_, rt: (0, rt)),
+                          pl.BlockSpec((1, T), lambda fb_, rt: (0, rt))]
         out = pl.pallas_call(
-            kern,
+            functools.partial(kern, route_blk=route_blk),
             grid=(1, nrt),
             in_specs=specs,
             out_specs=out_specs,
@@ -300,13 +465,64 @@ def fused_wave_scan(binned, g3, label, *, nslots, nchildren, num_bins,
         res_blocks.append(out[0])
         if sub:
             hs_blocks.append(out[1])
+        if route_blk:
+            leaf_p = out[2 if sub else 1]         # the emitted label
+            new_leaf = out[3 if sub else 2][0, :N]
     residue = (jnp.concatenate(res_blocks, axis=1)
                if nfb > 1 else res_blocks[0])[:, :F]
     hsmall = None
     if sub:
         hsmall = (jnp.concatenate(hs_blocks, axis=1)
                   if nfb > 1 else hs_blocks[0])[:, :F]
-    return residue, hsmall
+    return residue, hsmall, new_leaf
+
+
+def _route_only_kernel(dbin_ref, oleaf_ref, rmeta_ref, out_ref):
+    """One routing-only tile: the fused decision stage (``route_tile``)
+    with no histogram behind it — the valid-set lane."""
+    new_leaf, _ = route_tile(dbin_ref[...], oleaf_ref[...],
+                             rmeta_ref[...], nslots=0, sub=False,
+                             want_label=False)
+    out_ref[...] = new_leaf
+
+
+def fused_route_rows(binned, lids, *, feats, thrs, dls, leafs, nls,
+                     num_leaves, meta, interpret, row_tile=1024):
+    """Route one row set through a round's committed splits with the
+    SAME kernel decision stage the megakernel runs on the train rows —
+    the valid-set lane of the single-pass round (ISSUE 15).
+
+    Replaces the staged gather chain (per-split bin gather + (S, N)
+    masks in HBM): one O(N) decision-bin gather feeds a routing-only
+    Pallas grid whose tiles evaluate ``route_tile`` in VMEM and emit
+    only the updated leaf ids.  Every update term is int32, so the
+    result is bit-identical to the staged ``go_left_s``/
+    ``route_pending`` routing (pinned in tests/test_wave_fused.py).
+    """
+    N = lids.shape[0]
+    if N == 0:
+        return lids
+    dbin = decision_bins(binned, lids, feats, leafs, num_leaves)
+    rmeta = pack_route_meta(feats, thrs, dls, leafs, nls, meta)
+    T = min(row_tile, max(128, -(-N // 128) * 128))
+    nrt = -(-N // T)
+    n_pad = nrt * T
+    dbin_p = jnp.pad(dbin, (0, n_pad - N))[None, :]
+    oleaf_p = jnp.pad(lids.astype(jnp.int32), (0, n_pad - N),
+                      constant_values=-1)[None, :]
+    out = pl.pallas_call(
+        _route_only_kernel,
+        grid=(nrt,),
+        in_specs=[
+            pl.BlockSpec((1, T), lambda rt: (0, rt)),
+            pl.BlockSpec((1, T), lambda rt: (0, rt)),
+            pl.BlockSpec(rmeta.shape, lambda rt: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, T), lambda rt: (0, rt)),
+        out_shape=jax.ShapeDtypeStruct((1, n_pad), jnp.int32),
+        interpret=interpret,
+    )(dbin_p, oleaf_p, rmeta)
+    return out[0, :N]
 
 
 def _pick_pack(residue_c, shift_c, parent_sum_c, meta, num_bins):
@@ -375,8 +591,24 @@ def make_fused_round(*, meta, params, num_bins, precision, deep_precision,
 
     ``fused_round(binned, g3, label, S, *, deep, quant_key, scaled,
     mask, csums, constr, depth, pout, sml, parent, meta_override,
-    feature_rebase) -> (packed (2S, PACK_COLS), hsmall or None,
-    slot_scales (nslots, 3))``
+    feature_rebase, route) -> (packed (2S, PACK_COLS), hsmall or None,
+    slot_scales (nslots, 3))`` — plus ``new_leaf (N,)`` when routed.
+
+    * ``route`` non-None (dict ``leaf_id (N,) / feats / thrs / dls /
+      leafs / nls (S,) / num_leaves``) folds the round's PARTITION into
+      the kernel (ISSUE 15): ``label`` must be None — the kernel
+      evaluates the committed splits' go-left decisions in VMEM
+      (``route_tile`` + the staged partition's own
+      ``split.go_left_rule``) while sweeping the rows for the
+      histograms, and the call returns the updated per-row leaf ids as
+      a fourth output.  The decision-bin gather (``decision_bins``,
+      O(N) bytes) is the routing stage's only extra touch of the binned
+      matrix — the round reads the binned rows ONCE.  The builder marks
+      the returned callable ``supports_route=True`` and hangs the
+      valid-set router on it as ``route_rows`` (same decision stage
+      over a valid binned matrix); the feature-parallel trainer wrapper
+      deliberately has neither (its shard sees only a feature slice —
+      the partition-specific fallback of the module taxonomy).
 
     * ``deep`` — sustained-bucket round: the kernel accumulates at
       ``deep_precision`` (the staged deep-dtype policy, so precision per
@@ -403,21 +635,36 @@ def make_fused_round(*, meta, params, num_bins, precision, deep_precision,
     def fused_round(binned, g3, label, S, *, deep=False, quant_key=None,
                     scaled=False, mask=None, csums=None, constr=None,
                     depth=None, pout=None, sml=None, parent=None,
-                    meta_override=None):
+                    meta_override=None, route=None):
         sub = parent is not None
         C = 2 * S
         nslots = S if sub else C
         m = meta_override if meta_override is not None else meta
         if quant_key is not None:
-            q3, scales = sr_quantize_g3(g3, label, nslots, quant_key,
-                                        axis_name=axis_name)
+            # routed rounds have no precomputed label; sr_quantize_g3's
+            # global-scale implementation ignores it (per-pass scales),
+            # so the rounding stream — and int8sr bit-reproducibility —
+            # is identical to the staged pass either way
+            q3, scales = sr_quantize_g3(
+                g3, route["leaf_id"] if route is not None else label,
+                nslots, quant_key, axis_name=axis_name)
             g3u, prec = q3, "int8sr"
         else:
             scales = jnp.ones((nslots, 3), jnp.float32)
             g3u = g3
             prec = deep_precision if deep else precision
+        route_in = None
+        if route is not None:
+            route_in = dict(
+                dbin=decision_bins(binned, route["leaf_id"],
+                                   route["feats"], route["leafs"],
+                                   route["num_leaves"]),
+                oleaf=route["leaf_id"],
+                rmeta=pack_route_meta(route["feats"], route["thrs"],
+                                      route["dls"], route["leafs"],
+                                      route["nls"], m, sml=sml))
         with jax.named_scope("lgbm.fused_round"):
-            residue, hsmall = fused_wave_scan(
+            residue, hsmall, new_leaf = fused_wave_scan(
                 binned, g3u, label, nslots=nslots, nchildren=C,
                 num_bins=num_bins, precision=prec, interpret=interpret,
                 meta=m, params=params, use_mc=use_mc,
@@ -425,14 +672,20 @@ def make_fused_round(*, meta, params, num_bins, precision, deep_precision,
                 csums=csums, constr=constr, depth=depth, pout=pout,
                 cscale=(scales if (scaled and not sub) else None),
                 sscale=(scales if (scaled and sub) else None),
-                sml=sml, parent=parent, apply_scale=(scaled and sub))
+                sml=sml, parent=parent, apply_scale=(scaled and sub),
+                route=route_in)
             shift = jax.vmap(
                 lambda ps, po: gain_shift(ps, po, params))(csums, pout)
             packed = jax.vmap(
                 lambda rc, sh, ps: _pick_pack(rc, sh, ps, m, num_bins)
             )(residue, shift, csums)
+        if route is not None:
+            return packed, hsmall, scales, new_leaf
         return packed, hsmall, scales
 
+    fused_round.supports_route = True
+    fused_round.route_rows = functools.partial(
+        fused_route_rows, meta=meta, interpret=interpret)
     return fused_round
 
 
@@ -492,9 +745,9 @@ def backend_lowers_fused() -> bool:
                               num_bins=B, precision="bf16x2",
                               deep_precision="bf16")
         rng = np.random.RandomState(0)
-        args = (jnp.asarray(rng.randint(0, B, (F, N)).astype(np.uint8)),
-                jnp.asarray(rng.randn(N, 3).astype(np.float32)),
-                jnp.asarray(rng.randint(0, 2 * S + 1, N).astype(np.int32)))
+        binned_t = jnp.asarray(rng.randint(0, B, (F, N)).astype(np.uint8))
+        g3_t = jnp.asarray(rng.randn(N, 3).astype(np.float32))
+        lids_t = jnp.asarray(rng.randint(0, 2 * S, N).astype(np.int32))
         kw = dict(mask=jnp.ones((2 * S, F), bool),
                   csums=jnp.abs(jnp.asarray(
                       rng.randn(2 * S, 3).astype(np.float32))),
@@ -502,7 +755,21 @@ def backend_lowers_fused() -> bool:
                                   (2 * S, 1)),
                   depth=jnp.ones(2 * S, jnp.int32),
                   pout=jnp.zeros(2 * S, jnp.float32))
-        jax.jit(lambda *a: fn(*a, S, **kw)).lower(*args).compile()
+        # probe the ROUTED round (ISSUE 15: partition folded in) — the
+        # superset the serial trainer dispatches — plus the valid-set
+        # router; a backend that lowers histograms but not the routing
+        # stage must fall back whole, never half
+        rkw = dict(feats=jnp.arange(S, dtype=jnp.int32),
+                   thrs=jnp.full(S, B // 2, jnp.int32),
+                   dls=jnp.zeros(S, bool),
+                   leafs=jnp.arange(S, dtype=jnp.int32),
+                   nls=jnp.arange(S, dtype=jnp.int32) + S,
+                   num_leaves=2 * S)
+        jax.jit(lambda b, g, l: fn(
+            b, g, None, S, **kw, route=dict(leaf_id=l, **rkw))
+        ).lower(binned_t, g3_t, lids_t).compile()
+        jax.jit(lambda b, l: fn.route_rows(b, l, **rkw)) \
+            .lower(binned_t, lids_t).compile()
         _BACKEND_LOWERS[backend] = True
     except Exception as e:  # noqa: BLE001 — any lowering failure falls back
         log_warning(

@@ -566,7 +566,9 @@ def build_trainer(
         else:
             fused_builder = wave_fused.make_fused_round
             log_info("hist_method=fused: wave rounds run the fused "
-                     "histogram+split kernel (ops/wave_fused.py"
+                     "histogram+split kernel with partition, valid "
+                     "routing and top-k folded into the same dispatch "
+                     "(ops/wave_fused.py, single-pass wave round"
                      + (", interpret mode"
                         if jax.default_backend() == "cpu" else "") + ")")
 
@@ -1093,6 +1095,18 @@ def build_trainer(
         if fused_builder is not None and use_wave and not levelwise:
             from ..ops.wave_fused import pack_children, unpack_children
 
+            # partition-specific fallback (the ISSUE 15 taxonomy leg):
+            # the in-kernel routing stage decides with the committed
+            # split feature's GLOBAL column, but each shard's kernel
+            # sees only its own feature slice — so the feature-parallel
+            # learner keeps the staged (S, N) partition + valid routing
+            # (the wrapper below deliberately lacks supports_route)
+            # while still fusing histogram + scan per slice
+            log_info("hist_method=fused: feature-parallel keeps the "
+                     "staged partition (in-kernel routing needs the "
+                     "split feature's global column; each shard holds a "
+                     "feature slice) — histogram+split stay fused per "
+                     "slice through the SplitInfo election")
             base_fused = fused_builder(
                 meta=meta_p, params=params, num_bins=B,
                 precision=precision, deep_precision=deep_precision,
@@ -1117,8 +1131,13 @@ def build_trainer(
             def fused_fp(binned, g3, label, S, *, deep=False,
                          quant_key=None, scaled=False, mask=None,
                          csums=None, constr=None, depth=None, pout=None,
-                         sml=None, parent=None, meta_override=None):
+                         sml=None, parent=None, meta_override=None,
+                         route=None):
                 del meta_override
+                assert route is None, (
+                    "feature-parallel fused rounds keep the staged "
+                    "partition (no supports_route); the grower must not "
+                    "request in-kernel routing here")
                 lo = lax.axis_index("feature") * F_loc
                 block = lax.dynamic_slice(binned, (lo, 0), (F_loc, N))
                 mask_loc = lax.dynamic_slice(

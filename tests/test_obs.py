@@ -864,3 +864,22 @@ def test_ci_gate_combines_trend_and_tier1(tmp_path, capsys):
                          "--t1-log", str(tmp_path / "nope.log"),
                          "--skip-t1"]) == 0
     capsys.readouterr()
+
+
+def test_obs_overhead_guard_drift_block_treatment():
+    """ISSUE 15 satellite: the tracer A/B guard passes at <= 2% relative
+    OR <= 20 ms absolute (the PR 14 session measured 0.0201 vs the bare
+    0.02 bar in one of three otherwise-identical CPU runs — ~20 ms of
+    scheduler noise on a ~1 s wall, not tracer cost).  The formula is a
+    pure bench.py helper so this pin holds it still."""
+    sys.path.insert(0, REPO)
+    from bench import obs_overhead_guard_ok
+
+    assert obs_overhead_guard_ok(0.0, 0.0)
+    assert obs_overhead_guard_ok(0.02, 500.0)        # at the relative bar
+    assert obs_overhead_guard_ok(0.0201, 15.0)       # the PR 14 flake
+    assert obs_overhead_guard_ok(0.05, 19.9)         # fast wall, tiny abs
+    assert not obs_overhead_guard_ok(0.0201, 21.0)   # over BOTH bars
+    assert not obs_overhead_guard_ok(0.05, 500.0)    # a real regression
+    assert not obs_overhead_guard_ok(None, 1.0)      # absent truth fails
+    assert not obs_overhead_guard_ok(0.0201, None)

@@ -278,23 +278,41 @@ def test_fused_section_renders_fused_fields():
         "phase_valid_route_ms": 2.1, "phase_split_ms": 22.8,
         "phase_other_ms": 50.48, "phase_total_measured_ms": 151.9,
         "hist_split_fused_ms_per_iter": 41.25,
+        "partition_fused_ms_per_iter": 43.75,
         "fused_parity_ok": True, "fused_ok": True,
+        "fused_round_ok": True,
         "fused_M_row_trees_per_s": 11.5,
         "fused_staged_pallas_M_row_trees_per_s": 9.875,
         "staged_round_bytes_accessed": 500_000_000,
         "fused_round_bytes_accessed": 180_000_000,
         "fused_hbm_bytes_saved_per_round": 320_000_000,
+        "fused_round_bytes_reduction": 2.778,
         "fused_hbm_stack_bytes_analytic": 170_698_752,
+        "staged_round_binned_bytes_analytic": 346_500_000,
+        "fused_round_binned_bytes_analytic": 299_000_000,
     }
     txt = perf_report.generate(rec, "BENCH_rTEST.json")
     for needle in ("## Fused wave round", "41.25", "fused_ok=True",
                    "fused_parity_ok=True", "320000000", "hist+split fused",
-                   "ops/wave_fused.py"):
+                   "ops/wave_fused.py",
+                   # ISSUE 15: the routed single-pass round renders its
+                   # merged column + the bytes contract + the guard
+                   "43.75", "round fused", "fused_round_ok=True",
+                   "2.778", "299000000", "read once per round"):
         assert needle in txt, needle
     # absent fields: no fused section, legacy phase-table header — the
     # on-disk PERF.md (generated from an r05-era record) stays stable
     txt0 = perf_report.generate({"auc": 0.9}, "BENCH_rTEST.json")
     assert "## Fused wave round" not in txt0
+    # an ISSUE-13-era record (no partition_fused field) keeps its
+    # seven-column phase table
+    txt13 = perf_report.generate(
+        {k: v for k, v in rec.items()
+         if k not in ("partition_fused_ms_per_iter",)},
+        "BENCH_rTEST.json")
+    assert "| hist+split fused |\n" in txt13 or \
+        "| hist+split fused |" in txt13
+    assert "round fused" not in txt13
 
 
 def test_observability_section_renders_obs_fields():

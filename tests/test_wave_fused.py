@@ -220,6 +220,156 @@ def test_fused_slot_buckets_parity(monkeypatch):
 
 
 # ---------------------------------------------------------------------------
+# Single-pass round (ISSUE 15): partition + valid routing fused in-kernel
+# ---------------------------------------------------------------------------
+
+
+def _valid_problem(seed=7, n=500, f=8):
+    rng = np.random.RandomState(seed)
+    Xv = rng.randn(n, f)
+    yv = (1.2 * Xv[:, 0] - Xv[:, 1] + rng.randn(n) * 0.3 > 0) \
+        .astype(np.float64)
+    return Xv, yv
+
+
+def _train_with_valid(over, X, y, Xv, yv, iters=3):
+    cfg = Config.from_dict({
+        "objective": "binary", "num_leaves": 31, "min_data_in_leaf": 5,
+        "verbosity": -1, "tree_growth": "leafwise",
+        "leafwise_wave_size": 8, "metric": "binary_logloss", **over})
+    ds = BinnedDataset.from_numpy(X, label=y, config=cfg)
+    dv = BinnedDataset.from_numpy(Xv, label=yv, config=cfg, reference=ds)
+    gb = create_boosting(cfg, ds)
+    gb.add_valid(dv, "v")
+    for _ in range(iters):
+        gb.train_one_iter(check_stop=False)
+    text = model_to_string(
+        gb.materialize_host_trees(),
+        objective_string=_objective_string(cfg), num_class=1,
+        num_tree_per_iteration=cfg.num_tree_per_iteration,
+        feature_names=list(ds.feature_names),
+        feature_infos=ds.feature_infos())
+    evals = [(name, float(v)) for (_, name, v, _) in gb.eval_valid()]
+    return text, evals
+
+
+def _valid_parity(over=None):
+    """Fused vs staged with a valid set attached: the fused run routes
+    valid rows through the kernel decision stage (route_rows) — valid
+    METRICS must be bit-equal, not just trees (ISSUE 15 satellite)."""
+    X, y = _binary_problem()
+    Xv, yv = _valid_problem()
+    over = over or {}
+    t_s, ev_s = _train_with_valid({**over, "hist_method": "pallas"},
+                                  X, y, Xv, yv)
+    t_f, ev_f = _train_with_valid({**over, "hist_method": "fused"},
+                                  X, y, Xv, yv)
+    assert t_s == t_f, "fused trees diverged with a valid set attached"
+    assert ev_s == ev_f, (
+        f"fused valid metrics diverged from staged: {ev_f} vs {ev_s}")
+
+
+@pytest.mark.slow    # tier-1 budget (ISSUE 15 discipline): the full
+                     # suite, bench measure_fused and every
+                     # dryrun_multichip capture (valid-score equality
+                     # behind partition_fused_parity_ok) still run this;
+                     # the fast routing-kernel test below keeps an
+                     # in-tier-1 pin on the decision stage itself
+def test_fused_valid_routing_parity_pipelined():
+    # the pipelined drain (route_pending) rides the fused router
+    _valid_parity()
+
+
+@pytest.mark.slow    # tier-1 budget (ISSUE 13 discipline): the full suite,
+                     # bench measure_fused and every dryrun_multichip
+                     # capture (partition_fused_parity_ok) still run this
+def test_fused_valid_routing_parity_serialized():
+    # async_wave_pipeline=false: valids route IN-ROUND through the
+    # kernel stage (the second route_rows call site)
+    _valid_parity({"async_wave_pipeline": False})
+
+
+@pytest.mark.slow    # tier-1 budget (ISSUE 13 discipline): the full suite,
+                     # bench measure_fused and every dryrun_multichip
+                     # capture (fused_parity_ok) still run this
+def test_fused_parity_bagging_feature_fraction():
+    # bagging zeroes out-of-bag gradients; per-node column sampling
+    # feeds the kernel's per-child mask inputs — both must survive the
+    # routed single-pass round bit-exactly
+    _parity({"bagging_fraction": 0.6, "bagging_freq": 1,
+             "bagging_seed": 5, "feature_fraction": 0.75,
+             "feature_fraction_bynode": 0.8,
+             "feature_fraction_seed": 7}, iters=3)
+
+
+def test_fused_routing_kernel_matches_staged_partition(rng):
+    """Kernel-level (no grower): the routed megakernel's emitted leaf
+    ids, the routing-only valid-set kernel (fused_route_rows) and the
+    staged (S, N) partition formula must agree EXACTLY — including the
+    NaN/zero missing-direction rules (shared split.go_left_rule)."""
+    from lightgbmv1_tpu.ops import wave_fused as wf
+    from lightgbmv1_tpu.ops.split import (NO_CONSTRAINT, SplitParams,
+                                          go_left_rule)
+
+    F, B, N, S, L = 5, 16, 777, 3, 12
+    meta = _unit_meta(F, B)._replace(
+        missing_type=jnp.asarray([1, 2, 0, 0, 0], jnp.int32),
+        nan_bin=jnp.asarray([B - 1, -1, -1, -1, -1], jnp.int32),
+        zero_bin=jnp.asarray([0, 3, 0, 0, 0], jnp.int32))
+    binned = jnp.asarray(rng.randint(0, B, (F, N)).astype(np.uint8))
+    g3 = jnp.asarray(np.stack(
+        [rng.randn(N), np.abs(rng.randn(N)) + 0.1, np.ones(N)],
+        axis=1).astype(np.float32))
+    lids = jnp.asarray(rng.randint(0, L, N).astype(np.int32))
+    feats = jnp.asarray(rng.randint(0, F, S).astype(np.int32))
+    thrs = jnp.asarray(rng.randint(0, B, S).astype(np.int32))
+    dls = jnp.asarray(rng.rand(S) < 0.5)
+    leafs = jnp.asarray(rng.choice(L, S, replace=False).astype(np.int32))
+    nls = jnp.asarray((np.arange(S) + L).astype(np.int32))
+
+    # staged partition (grower_wave go_left_s formula, shared rule)
+    bk = jax.vmap(lambda f: binned[f])(feats).astype(jnp.int32)
+    gl = go_left_rule(bk, thrs[:, None], dls[:, None],
+                      meta.missing_type[feats][:, None],
+                      meta.nan_bin[feats][:, None],
+                      meta.zero_bin[feats][:, None])
+    mine = lids[None, :] == leafs[:, None]
+    want = np.asarray(lids + jnp.sum(
+        jnp.where(mine & (~gl), nls[:, None] - lids[None, :], 0), axis=0))
+
+    params = SplitParams(min_data_in_leaf=5.0)
+    fn = wf.make_fused_round(meta=meta, params=params, num_bins=B,
+                             precision="bf16x2", deep_precision="bf16",
+                             interpret=_INTERP)
+    assert fn.supports_route
+    # the routing-only kernel (the valid-set lane)
+    got_v = fn.route_rows(binned, lids, feats=feats, thrs=thrs, dls=dls,
+                          leafs=leafs, nls=nls, num_leaves=L + S)
+    np.testing.assert_array_equal(np.asarray(got_v), want)
+    # the megakernel's routed train lane: emitted leaf ids + packed
+    # SplitInfo equal to the label-input (PR 13) kernel fed the staged
+    # partition's label
+    C = 2 * S
+    siota = jnp.arange(S, dtype=jnp.int32)
+    label = jnp.sum(jnp.where(
+        mine, 2 * siota[:, None] + (~gl).astype(jnp.int32) - 2 * S, 0),
+        axis=0) + 2 * S
+    csums = jnp.asarray(np.abs(rng.randn(C, 3)).astype(np.float32))
+    kw = dict(mask=jnp.ones((C, F), bool), csums=csums,
+              constr=jnp.tile(jnp.asarray(NO_CONSTRAINT, jnp.float32),
+                              (C, 1)),
+              depth=jnp.ones(C, jnp.int32),
+              pout=jnp.zeros(C, jnp.float32))
+    p_lab, _, _ = fn(binned, g3, label, S, **kw)
+    p_rt, _, _, nl = fn(binned, g3, None, S, **kw,
+                        route=dict(leaf_id=lids, feats=feats, thrs=thrs,
+                                   dls=dls, leafs=leafs, nls=nls,
+                                   num_leaves=L + S))
+    np.testing.assert_array_equal(np.asarray(nl), want)
+    np.testing.assert_array_equal(np.asarray(p_lab), np.asarray(p_rt))
+
+
+# ---------------------------------------------------------------------------
 # int8sr: shared quantization stream, shared eligibility gate
 # ---------------------------------------------------------------------------
 

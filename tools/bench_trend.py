@@ -95,6 +95,11 @@ WATCHED: Tuple[Tuple[str, str, float], ...] = (
     # clock bar; fused_ok / fused_parity_ok are booleans the guard
     # sweep flags automatically
     ("hist_split_fused_ms_per_iter", "down", 0.10),
+    # single-pass wave round (ISSUE 15): the routed round — partition +
+    # valid routing + top-k folded into the fused dispatch — gets the
+    # same 10% clock bar; fused_round_ok is the boolean guard the sweep
+    # flags automatically
+    ("partition_fused_ms_per_iter", "down", 0.10),
     # model-quality & drift (ISSUE 14): the skew-injection probe's
     # detection magnitude is deterministic (same shift, same shape) —
     # a capture where the injected PSI collapses means the detector

@@ -53,10 +53,14 @@ import tier1_budget  # noqa: E402
 # measure_fused / measure_fused_round_ms); drift_ok is the
 # model-quality guard (skew-injection probe detected + zero clean
 # false alarms + streamed-vs-resident reference byte parity + armed
-# sampling within the <= 2% serving contract — bench.py measure_drift)
+# sampling within the <= 2% serving contract — bench.py measure_drift);
+# fused_round_ok is the single-pass wave-round guard (ISSUE 15: routed
+# parity with partition + valid routing + top-k folded into the fused
+# dispatch AND the binned-matrix-read-once bytes contract — >= 1.8x
+# bytes_accessed reduction vs staged partition+hist on device)
 REQUIRED_GUARDS = ("obs_ok", "slo_ok", "forensics_ok", "chaos_ok",
                    "fleet_ok", "chaos_fleet_ok", "obs_device_ok",
-                   "fused_ok", "drift_ok")
+                   "fused_ok", "drift_ok", "fused_round_ok")
 
 
 def check_required_guards(records_dir: str, guards, out=print) -> bool:

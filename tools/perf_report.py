@@ -141,19 +141,36 @@ def fused_section(w, rec):
           f"{get(rec, 'phase_hist_ms')} + {get(rec, 'phase_split_ms')} "
           "ms/iter.")
         w("")
+    if rec.get("partition_fused_ms_per_iter") is not None:
+        w(f"Single-pass round (ISSUE 15 — partition + valid routing + "
+          f"top-k folded into the dispatch): "
+          f"**{get(rec, 'partition_fused_ms_per_iter')} ms/iter** "
+          f"(replayed schedule, staged root pass included) vs staged "
+          f"`phase_hist_ms + phase_split_ms + phase_partition_ms` = "
+          f"{get(rec, 'phase_hist_ms')} + {get(rec, 'phase_split_ms')} "
+          f"+ {get(rec, 'phase_partition_ms')} ms/iter.")
+        w("")
     if rec.get("fused_hbm_bytes_saved_per_round") is not None:
         w(f"Compiled-executable HBM accounting (cost_analysis bytes, one "
-          f"sustained-bucket round): staged "
-          f"{get(rec, 'staged_round_bytes_accessed')} vs fused "
+          f"sustained-bucket round incl. the staged partition pass): "
+          f"staged {get(rec, 'staged_round_bytes_accessed')} vs fused "
           f"{get(rec, 'fused_round_bytes_accessed')} — "
           f"**{get(rec, 'fused_hbm_bytes_saved_per_round')} bytes/round "
-          f"saved** (analytic scan-stack size "
+          f"saved** ({get(rec, 'fused_round_bytes_reduction', 3)}x; "
+          f"analytic scan-stack size "
           f"{get(rec, 'fused_hbm_stack_bytes_analytic')}): the "
-          "(F, B, 3) histogram stack stays in VMEM.")
+          "(F, B, 3) histogram stack stays in VMEM and the binned "
+          f"matrix is read once per round (analytic binned traffic "
+          f"{get(rec, 'fused_round_binned_bytes_analytic')} vs staged "
+          f"{get(rec, 'staged_round_binned_bytes_analytic')} bytes).")
         w("")
     w(f"Guard `fused_ok={rec.get('fused_ok')}`: parity AND (on device) "
-      "fused round <= staged hist+split.  The staged path stays the "
-      "default until a device capture lands this guard True "
+      "fused round <= staged hist+split.  Guard "
+      f"`fused_round_ok={rec.get('fused_round_ok')}` (ISSUE 15): routed "
+      "parity AND the binned-read-once bytes contract (>= 1.8x "
+      "cost_analysis reduction vs staged partition+hist on device).  "
+      "The staged path stays the default until a device capture lands "
+      "these guards True "
       "(BASELINE.md \"Fused wave round\" — dispatch rules, fallback "
       "taxonomy, parity contract).")
     w("")
@@ -737,7 +754,23 @@ def generate(rec, name, prev=None, prev_name=None):
     if rec.get("phase_hist_ms") is not None:
         w("## Per-phase breakdown (ms per leaf-wise iteration)")
         w("")
-        if rec.get("hist_split_fused_ms_per_iter") is not None:
+        if rec.get("partition_fused_ms_per_iter") is not None:
+            # single-pass wave round (ISSUE 15): the routed round —
+            # partition + valid routing + top-k folded into the fused
+            # dispatch — next to the merged hist+split kernel and the
+            # staged phases they replace
+            w("| hist | partition | valid-route | split | other | "
+              "measured total | hist+split fused | round fused |")
+            w("|---|---|---|---|---|---|---|---|")
+            w(f"| {get(rec, 'phase_hist_ms')} | "
+              f"{get(rec, 'phase_partition_ms')} | "
+              f"{get(rec, 'phase_valid_route_ms')} | "
+              f"{get(rec, 'phase_split_ms')} | "
+              f"{get(rec, 'phase_other_ms')} | "
+              f"{get(rec, 'phase_total_measured_ms')} | "
+              f"{get(rec, 'hist_split_fused_ms_per_iter')} | "
+              f"**{get(rec, 'partition_fused_ms_per_iter')}** |")
+        elif rec.get("hist_split_fused_ms_per_iter") is not None:
             # fused wave-round row (ISSUE 13): the merged hist+split
             # kernel next to the staged phases it replaces
             w("| hist | partition | valid-route | split | other | "

@@ -521,12 +521,21 @@ PHASE_MS_KEYS = (
     "phase_partition_ms",
     "phase_valid_route_ms",
     "phase_split_ms",
-    # hist_method=fused: histogram + smaller-child subtraction + split
-    # scan are ONE kernel — one merged phase, mutually exclusive with
-    # the staged hist/split rows for the run that produced it
-    "phase_hist_split_fused_ms",
+    # hist_method=fused (ISSUE 15, the single-pass wave round):
+    # partition + valid routing + histogram + smaller-child subtraction
+    # + split scan + top-k are ONE labeled dispatch — one merged phase,
+    # mutually exclusive with the staged hist/partition/valid_route/
+    # split rows for the run that produced it
+    "phase_round_fused_ms",
     "phase_other_ms",
 )
+
+# pre-ISSUE-15 records carried the merged fused row WITHOUT partition
+# folded in under this name; renders as the same row so old captures
+# keep their phase profile
+_LEGACY_PHASE_ALIASES = {
+    "phase_hist_split_fused_ms": "phase_round_fused_ms",
+}
 
 
 def phase_ms_from_fields(fields):
@@ -534,10 +543,15 @@ def phase_ms_from_fields(fields):
     ``phase_``/``_ms`` wrapping — every positive canonical phase,
     including the fused merged row.  Consumers (bench.py's trace phase
     profile and the roofline join) go through here so the phase list
-    cannot drift per call site."""
+    cannot drift per call site.  Legacy field names
+    (``_LEGACY_PHASE_ALIASES``) land on their canonical row."""
     out = {}
+    fields = dict(fields or {})
+    for legacy, canon in _LEGACY_PHASE_ALIASES.items():
+        if fields.get(canon) is None and fields.get(legacy) is not None:
+            fields[canon] = fields[legacy]
     for k in PHASE_MS_KEYS:
-        v = (fields or {}).get(k)
+        v = fields.get(k)
         if isinstance(v, (int, float)) and v > 0:
             out[k[len("phase_"):-len("_ms")]] = v
     return out
