@@ -2448,15 +2448,42 @@ def main():
     # fallback too.
     try:
         from lightgbmv1_tpu.models.grower_wave import auto_wave_size
-        from lightgbmv1_tpu.parallel.cluster import comm_table_per_round
+        from lightgbmv1_tpu.parallel.cluster import (comm_table_per_round,
+                                                     hier_comm_ok,
+                                                     hier_comm_table_per_round)
 
         K_comm = auto_wave_size(cfg_lw.num_leaves)
         extra["comm_bytes_per_round_d8"] = {
             mode: comm_table_per_round("data", mode, k=K_comm, F=28, B=64,
                                        ndev=8)
             for mode in ("reduce_scatter", "allreduce")}
+        # the voting learner's table rides too, so the record prices the
+        # top-2k ELECTION payload (vote_bytes) next to the selective
+        # reduce it buys — the vote vector never rides uncounted
+        extra["comm_bytes_per_round_d8"]["voting"] = comm_table_per_round(
+            "voting", "reduce_scatter", k=K_comm, F=28, B=64, ndev=8,
+            sel_k=min(2 * 20, 28))
+        # pod-scale two-level pricing (ISSUE 16) at the same shape on the
+        # 2x4 smoke pod, split by level (ICI vs DCN), with the
+        # hier_comm_ok guard: DCN histogram bytes <= flat wire / hosts,
+        # voting additionally <= its top-2k analytic bound
+        hier = {
+            ln: hier_comm_table_per_round(
+                ln, k=K_comm, F=28, B=64, ndev=8, num_hosts=2,
+                sel_k=min(2 * 20, 28) if ln == "voting" else None)
+            for ln in ("data", "voting")}
+        extra["hier_comm_bytes_per_round"] = hier
+        extra["hier_dcn_hist_bytes"] = hier["data"]["dcn"]["hist_bytes"]
+        extra["hier_comm_ok"] = (
+            hier_comm_ok(hier["data"]["dcn"]["hist_bytes"],
+                         hier["data"]["flat_hist_wire_bytes"], 2)
+            and hier_comm_ok(hier["voting"]["dcn"]["hist_bytes"],
+                             hier["voting"]["flat_hist_wire_bytes"], 2,
+                             vote_bound_bytes=hier["voting"]
+                             ["flat_hist_wire_bytes"]))
     except Exception as e:  # noqa: BLE001
         extra["comm_error"] = f"{type(e).__name__}: {e}"[:200]
+        extra["hier_comm_ok"] = False
 
     baseline = 10.5e6 * 500 / 130.094 / 1e6   # reference CPU HIGGS throughput
     print(json.dumps({

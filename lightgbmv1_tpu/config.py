@@ -412,9 +412,18 @@ class Config:
     # analog), cutting histogram comm bytes ~D-fold per round;
     # "allreduce" keeps the PR-2-era full-histogram lax.psum (every chip
     # materializes every feature's bins) — retained as the parity pin and
-    # for A/B measurement (tools/dryrun_multichip records both).
+    # for A/B measurement (tools/dryrun_multichip records both);
+    # "hierarchical" (ISSUE 16) is the topology-aware two-level path:
+    # reduce-scatter over the fast intra-host ICI axis first, then over
+    # the slow inter-host DCN axis, so only the 1/C-sliced partials ever
+    # cross the slow link (parallel/cluster.make_hier_mesh — requires a
+    # device count divisible into num_hosts equal hosts).
     data_parallel_collective: str = "reduce_scatter"
     num_shards: int = 0            # devices for data-parallel (0 = all available)
+    # host rows of the hierarchical mesh (0 = auto: the real process
+    # count in a multi-process run, 1 otherwise).  A single-process run
+    # can model a pod by setting it explicitly (the 2x4 dryrun rig).
+    num_hosts: int = 0
     # -- serving (models/predict.py batched inference engine) ----------
     # prediction engine: "auto" keeps the host routing (native C++ bulk
     # predictor above the work threshold, vectorized numpy below);
@@ -722,11 +731,13 @@ class Config:
                 f"hist_method={self.hist_method!r}: expected auto | bench "
                 "| scatter | onehot | pallas | fused")
         if self.data_parallel_collective not in (
-                "reduce_scatter", "allreduce"):
+                "reduce_scatter", "allreduce", "hierarchical"):
             raise ValueError(
                 f"data_parallel_collective="
                 f"{self.data_parallel_collective!r}: expected "
-                "reduce_scatter | allreduce")
+                "reduce_scatter | allreduce | hierarchical")
+        if self.num_hosts < 0:
+            raise ValueError("num_hosts must be >= 0 (0 = auto-detect)")
         if self.predict_method not in (
                 "auto", "native", "host", "depthwise", "pallas", "scan"):
             raise ValueError(

@@ -189,6 +189,61 @@ def load_manifest(path: str) -> dict:
     return manifest
 
 
+def validate_block_table(path: str, manifest: dict) -> List[tuple]:
+    """Validate the manifest's block table and return its row ranges.
+
+    The table must be ordered, non-empty-per-block, gap-free and
+    overlap-free, and must cover exactly ``num_rows`` — an overlap would
+    silently double-count rows in every histogram and a gap would
+    silently drop them, so both FAIL LOUDLY here (the host-shard
+    derivation below trusts these ranges to partition the dataset)."""
+    ranges = [(int(e["row_begin"]), int(e["row_begin"]) + int(e["rows"]))
+              for e in manifest["blocks"]]
+    pos = 0
+    for a, b in ranges:
+        if b <= a:
+            raise BlockCacheError(
+                f"{path}: empty or negative block at row {a}")
+        if a < pos:
+            raise BlockCacheError(
+                f"{path}: block table OVERLAPS at row {a} (previous "
+                f"block ends at {pos}); rows would be double-read")
+        if a > pos:
+            raise BlockCacheError(
+                f"{path}: block table has a GAP at rows [{pos}, {a}); "
+                "rows would be silently dropped")
+        pos = b
+    n = int(manifest["num_rows"])
+    if pos != n:
+        raise BlockCacheError(
+            f"{path}: block table covers {pos} rows, manifest says {n}")
+    return ranges
+
+
+def shard_blocks(manifest, rank: int, world: int,
+                 path: str = "<cache>") -> dict:
+    """Derive THIS rank's host shard from the manifest: a contiguous run
+    of whole blocks (block-aligned so every process still reads verified
+    whole shards), balanced by block count, ragged tail on the last
+    ranks' runs.  Deterministic in (manifest, rank, world) — every
+    process derives the same partition without communicating, and the
+    elastic path re-derives it after a mesh shrink.
+
+    Returns ``{"block_lo", "block_hi", "row_begin", "row_end"}`` (empty
+    run => row_begin == row_end when world > num_blocks)."""
+    if not (0 <= rank < world):
+        raise BlockCacheError(
+            f"{path}: shard rank {rank} out of range for world {world}")
+    ranges = validate_block_table(path, manifest)
+    nb = len(ranges)
+    lo = rank * nb // world
+    hi = (rank + 1) * nb // world
+    row_begin = ranges[lo][0] if lo < hi else int(manifest["num_rows"])
+    row_end = ranges[hi - 1][1] if lo < hi else row_begin
+    return {"block_lo": lo, "block_hi": hi,
+            "row_begin": row_begin, "row_end": row_end}
+
+
 def read_meta_arrays(path: str, manifest: dict) -> Dict[str, np.ndarray]:
     mp = os.path.join(str(path), manifest.get("meta_file", META_NAME))
     with open_file(mp, "rb") as fh:

@@ -30,7 +30,8 @@ from ..io.binning import BinMapper
 from ..io.dataset import BinnedDataset, Metadata
 from ..utils.log import log_info
 from .block_cache import (BlockCacheError, load_manifest, read_block,
-                          read_meta_arrays)
+                          read_meta_arrays, shard_blocks,
+                          validate_block_table)
 
 
 _peak_gauge = None
@@ -136,30 +137,45 @@ class InMemoryBlockSource(_BlockSource):
 
 
 class _CacheBlockSource(_BlockSource):
-    def __init__(self, path: str, manifest: dict):
+    def __init__(self, path: str, manifest: dict, shard=None):
         self._path = path
         self._manifest = manifest
-        self.num_rows = int(manifest["num_rows"])
         self.num_features = int(manifest["num_features"])
         self.block_dtype = np.dtype(manifest["dtype"])
         self.block_rows = int(manifest["block_rows"])
-        self.ranges = [(int(e["row_begin"]),
-                        int(e["row_begin"]) + int(e["rows"]))
-                       for e in manifest["blocks"]]
-        # block table sanity: contiguous, covering, ordered
-        pos = 0
-        for a, b in self.ranges:
-            if a != pos or b <= a:
-                raise BlockCacheError(
-                    f"{path}: block table is not contiguous at row {pos}")
-            pos = b
-        if pos != self.num_rows:
-            raise BlockCacheError(
-                f"{path}: block table covers {pos} rows, manifest says "
-                f"{self.num_rows}")
+        # block table sanity: contiguous, covering, ordered — an overlap
+        # or gap fails LOUDLY (it would double-read / drop rows)
+        full = validate_block_table(path, manifest)
+        if shard is None:
+            self._block0 = 0
+            self._row0 = 0
+            self.num_rows = int(manifest["num_rows"])
+            self.ranges = full
+        else:
+            # host-shard view (ISSUE 16): this process streams ONLY its
+            # own contiguous block run; ranges are re-based to shard-
+            # local row coordinates so the trainer sees a dense
+            # [0, local_rows) dataset
+            rank, world = shard
+            sh = shard_blocks(manifest, rank, world, path=path)
+            self._block0 = sh["block_lo"]
+            self._row0 = sh["row_begin"]
+            self.num_rows = sh["row_end"] - sh["row_begin"]
+            self.ranges = [(a - self._row0, b - self._row0)
+                           for a, b in full[sh["block_lo"]:sh["block_hi"]]]
+
+    @property
+    def shard_row_range(self):
+        """Global (row_begin, row_end) this source covers."""
+        return self._row0, self._row0 + self.num_rows
 
     def load_block(self, index: int) -> np.ndarray:
-        return read_block(self._path, self._manifest, index)
+        if not (0 <= index < len(self.ranges)):
+            raise BlockCacheError(
+                f"{self._path}: shard-local block index {index} out of "
+                f"range (this shard holds {len(self.ranges)} blocks)")
+        return read_block(self._path, self._manifest,
+                          self._block0 + index)
 
 
 class StreamingDataset(BinnedDataset):
@@ -172,7 +188,12 @@ class StreamingDataset(BinnedDataset):
 
     is_streaming = True
 
-    def __init__(self, path: str):
+    def __init__(self, path: str, shard=None):
+        """``shard=(rank, world)`` opens a host-shard VIEW: only this
+        process's contiguous block run is streamed, metadata is sliced to
+        the shard's global row range, and ``num_data`` becomes the local
+        row count (the multi-process loader in parallel/dist_data.py then
+        turns the view into process-sharded trainer storage)."""
         self.cache_path = str(path)
         manifest = load_manifest(self.cache_path)
         z = read_meta_arrays(self.cache_path, manifest)
@@ -193,27 +214,40 @@ class StreamingDataset(BinnedDataset):
                 "max_value": floats[j, 2],
                 "bin_2_categorical": z["cat_flat"][coff[j]:coff[j + 1]],
             }))
+        source = _CacheBlockSource(self.cache_path, manifest, shard=shard)
+        r0, r1 = source.shard_row_range
+        n_total = int(manifest["num_rows"])
         meta = Metadata()
-        if z["label"].size:
-            meta.label = z["label"].astype(np.float32)
-        if z["weight"].size:
-            meta.weight = z["weight"].astype(np.float32)
         if z["group"].size:
+            if shard is not None:
+                raise BlockCacheError(
+                    f"{path}: host-sharded streaming of ranking data is "
+                    "not supported (query-aligned sharding is not wired)")
             meta.set_group(z["group"])
+        if z["label"].size:
+            meta.label = z["label"][r0:r1].astype(np.float32)
+        if z["weight"].size:
+            meta.weight = z["weight"][r0:r1].astype(np.float32)
         if z["init_score"].size:
-            meta.init_score = z["init_score"]
+            k = max(1, z["init_score"].size // max(n_total, 1))
+            meta.init_score = (z["init_score"].reshape(n_total, k)[r0:r1]
+                               .ravel())
         super().__init__(None, mappers, meta,
                          feature_names=[str(s) for s in z["feature_names"]],
                          max_bin=int(z["max_bin"]),
-                         num_data=int(manifest["num_rows"]))
+                         num_data=r1 - r0)
         if len(mappers) != int(manifest["num_features"]):
             raise BlockCacheError(
                 f"{path}: meta shard has {len(mappers)} mappers, manifest "
                 f"says {manifest['num_features']} features")
-        self.source = _CacheBlockSource(self.cache_path, manifest)
+        self.source = source
         self.manifest = manifest
-        log_info(f"Opened block cache {path}: {self.num_data} rows, "
-                 f"{self.num_features} features, "
+        self.shard = shard
+        self.shard_row_range = (r0, r1)
+        log_info(f"Opened block cache {path}: {self.num_data} rows"
+                 + (f" (host shard {shard[0]}/{shard[1]}, global rows "
+                    f"[{r0}, {r1}))" if shard is not None else "")
+                 + f", {self.num_features} features, "
                  f"{self.source.num_blocks} blocks")
 
     # the trainer must never materialize the matrix implicitly

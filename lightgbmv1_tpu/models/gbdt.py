@@ -1018,11 +1018,24 @@ class GBDT:
             arrays["cegb_marks"] = self._host_fetch(self._cegb_used[1])
         else:
             arrays["cegb_used"] = self._host_fetch(self._cegb_used)
+        # Row-sharded (padded) layouts record the TRUE row count and the
+        # pad mask so a resumed trainer with a DIFFERENT fleet shape (pod
+        # shrink: elastic.py shrink_on_loss) can remap per-row state —
+        # the padded global count is world-dependent, the real rows are
+        # not (contiguous rank shards keep true global row order under
+        # the mask on both sides).
+        if self._row_valid is not None:
+            rv = self._host_fetch(self._row_valid) > 0.5
+            arrays["row_valid"] = rv
+            num_data_true = int(rv.sum())
+        else:
+            num_data_true = int(self.num_data)
         manifest = {
             "iteration": int(self.iter),
             "num_trees": len(self.models),
             "num_class": int(self.num_class),
             "num_data": int(self.num_data),
+            "num_data_true": num_data_true,
             "n_valid": len(self._valid_scores),
             "boosting": type(self).__name__,
             "objective": self.config.objective,
@@ -1051,8 +1064,60 @@ class GBDT:
             raise CheckpointError(
                 "restore_state() needs a fresh trainer (training already "
                 f"started: iteration {self.iter})")
+        # num_data: tolerate a PADDED-count change iff both sides are
+        # row-sharded layouts agreeing on the TRUE row count (elastic
+        # shrink repartitions the same rows over fewer hosts, so the
+        # per-rank pad — and with it the padded global count — moves);
+        # everything per-row is then remapped old-mask -> new-mask below.
+        remap = False
+        mask_old = mask_new = None
+        if int(manifest["num_data"]) != self.num_data:
+            true_want = manifest.get("num_data_true")
+            if (true_want is None or self._row_valid is None
+                    or "row_valid" not in arrays):
+                raise CheckpointError(
+                    "checkpoint/trainer mismatch on num_data: checkpoint "
+                    f"has {int(manifest['num_data'])!r}, trainer has "
+                    f"{self.num_data!r}")
+            mask_new = np.asarray(self._row_valid) > 0.5
+            mask_old = np.asarray(arrays["row_valid"]).astype(bool)
+            if int(mask_new.sum()) != int(true_want) \
+                    or int(mask_old.sum()) != int(true_want):
+                raise CheckpointError(
+                    "checkpoint/trainer mismatch on num_data_true: "
+                    f"checkpoint has {int(true_want or -1)!r} real rows, "
+                    f"trainer has {int(mask_new.sum())!r}")
+            remap = True
+
+        def _remap_rows(a: np.ndarray) -> np.ndarray:
+            """Old padded layout -> new padded layout via the two pad
+            masks (real rows keep true global order on both sides); new
+            pad rows keep the fresh trainer's value."""
+            if not remap:
+                return a
+            if a.shape[0] != mask_old.shape[0]:
+                raise CheckpointError(
+                    f"per-row checkpoint array has {a.shape[0]} rows, "
+                    f"expected {mask_old.shape[0]} (old padded layout)")
+            out = np.zeros((self.num_data,) + a.shape[1:], a.dtype)
+            out[mask_new] = a[mask_old]
+            return out
+
+        def _remap_score(a: np.ndarray) -> np.ndarray:
+            """Like :func:`_remap_rows` but new pad rows keep the fresh
+            trainer's (init) score instead of 0 — matching what a
+            from-scratch run at the new world shape would hold there."""
+            if not remap:
+                return a
+            if a.shape[0] != mask_old.shape[0]:
+                raise CheckpointError(
+                    f"train_score checkpoint has {a.shape[0]} rows, "
+                    f"expected {mask_old.shape[0]} (old padded layout)")
+            out = np.asarray(self._train_scores.score, a.dtype).copy()
+            out[mask_new] = a[mask_old]
+            return out
+
         for key, want, got in (
-                ("num_data", int(manifest["num_data"]), self.num_data),
                 ("num_class", int(manifest["num_class"]), self.num_class),
                 ("boosting", manifest["boosting"], type(self).__name__),
                 ("objective", manifest["objective"],
@@ -1077,12 +1142,14 @@ class GBDT:
         self.models = [None] * T
         self._model_shrink = [float(v) for v in manifest["model_shrink"]]
         self._model_bias = [float(v) for v in manifest["model_bias"]]
-        self._train_scores.score = jnp.asarray(arrays["train_score"])
+        self._train_scores.score = jnp.asarray(
+            _remap_score(np.asarray(arrays["train_score"])))
         for i, vs in enumerate(self._valid_scores):
             vs.score = jnp.asarray(arrays[f"valid_score_{i}"])
         if "cegb_marks" in arrays:
             self._cegb_used = (jnp.asarray(arrays["cegb_used"]),
-                               jnp.asarray(arrays["cegb_marks"]))
+                               jnp.asarray(_remap_rows(
+                                   np.asarray(arrays["cegb_marks"]))))
         else:
             self._cegb_used = jnp.asarray(arrays["cegb_used"])
         self._feat_rng.set_state(decode_rng_state(manifest["feat_rng"]))

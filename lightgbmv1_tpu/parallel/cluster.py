@@ -373,3 +373,205 @@ def comm_guard_ok(rs_hist_bytes: float, allreduce_hist_bytes: float,
     if ndev <= 1:
         return True
     return rs_hist_bytes <= allreduce_hist_bytes / (ndev * 0.9)
+
+
+# ---------------------------------------------------------------------------
+# Pod-scale topology (ISSUE 16): the flat one-axis mesh treats every link
+# as equal, but a real pod has two very different links — intra-host ICI
+# (fast) and inter-host DCN (an order of magnitude slower).  The
+# hierarchical collective reduce-scatters over the ICI axis FIRST so only
+# the F/D-sliced partials ever cross DCN, and the voting learner's top-2k
+# election additionally compresses WHAT crosses.  This block provides the
+# (host, chip) mesh and the per-level analytic pricing the trainer logs,
+# dryrun_multichip records, and tools/perf_report.py renders as the
+# "Pod-scale comms" section.
+# ---------------------------------------------------------------------------
+
+# Per-level bandwidth terms for the analytic ms estimates (GB/s per
+# device-link, order-of-magnitude constants: TPU-generation ICI links run
+# ~O(100 GB/s) while inter-host DCN NICs run ~O(10 GB/s) — the exact
+# ratio varies by platform; what the model needs is the ~10x gap that
+# makes the flat collective DCN-priced).
+ICI_GBPS = 100.0
+DCN_GBPS = 10.0
+
+
+def hier_axis_sizes(ndev: int, num_hosts: int = 0):
+    """Resolve ``(num_hosts, chips_per_host)`` for a ``ndev``-device
+    fleet.  ``num_hosts == 0`` auto-detects: the real process count in a
+    multi-process run, else 1 (a single host has no DCN level).  A fleet
+    that does not divide evenly into hosts is a config error — the
+    two-level mesh must be rectangular."""
+    import jax
+
+    H = int(num_hosts)
+    if H <= 0:
+        H = jax.process_count() if jax.process_count() > 1 else 1
+    if ndev % H != 0:
+        log_fatal(f"hierarchical mesh: {ndev} devices do not divide "
+                  f"into num_hosts={H} equal hosts")
+    return H, ndev // H
+
+
+def make_hier_mesh(num_shards: int, num_hosts: int = 0,
+                   axes=("host", "chip")):
+    """Two-axis ``(host, chip)`` mesh with process identity.  In a real
+    multi-process run ``jax.devices()`` is process-major, so reshaping to
+    ``(H, C)`` puts each process's devices on one "host" row and the
+    "chip" axis never crosses a process boundary; a single-process run
+    (the 8-virtual-device test rig) models the same topology by grouping
+    contiguous blocks of C devices into virtual hosts."""
+    import numpy as np
+    import jax
+    from jax.sharding import Mesh
+
+    devices = jax.devices()
+    n = num_shards if num_shards > 0 else len(devices)
+    if n > len(devices):
+        log_fatal(f"num_shards={n} exceeds available devices "
+                  f"({len(devices)})")
+    H, C = hier_axis_sizes(n, num_hosts)
+    if jax.process_count() > 1:
+        # each host row must be process-pure: the ICI axis may never
+        # cross a process (= host) boundary
+        procs = [d.process_index for d in devices[:n]]
+        for h in range(H):
+            row = set(procs[h * C:(h + 1) * C])
+            if len(row) > 1:
+                log_fatal(f"hierarchical mesh: host row {h} spans "
+                          f"processes {sorted(row)} — device list is not "
+                          "process-major or num_hosts mismatches the "
+                          "process count")
+    return Mesh(np.array(devices[:n]).reshape(H, C), axes)
+
+
+def wire_bytes(n_elems: int, n: int, kind: str, itemsize: int = F32) -> int:
+    """Ring SEND bytes per device of one collective over ``n`` devices —
+    the per-LEVEL convention of the hierarchical table, distinct from
+    :func:`collective_bytes`'s output-payload convention.  The
+    distinction is load-bearing: a hierarchical reduce-scatter's DCN
+    OUTPUT payload mathematically equals the flat reduce-scatter's
+    (both end holding M/D elements per device), so output payload
+    cannot express what the topology changes — the traffic on each
+    link class can.  Ring lowerings: reduce-scatter of M elements sends
+    M*(n-1)/n per device, allreduce 2*M*(n-1)/n, all-gather of a
+    per-device M-element chunk sends M*(n-1)."""
+    if n <= 1:
+        return 0
+    if kind == "reduce_scatter":
+        return (n_elems * (n - 1) // n) * itemsize
+    if kind == "allreduce":
+        return (2 * n_elems * (n - 1) // n) * itemsize
+    if kind == "all_gather":
+        return n_elems * (n - 1) * itemsize
+    raise ValueError(f"unknown collective kind: {kind}")
+
+
+def hier_comm_table_per_round(learner: str, *, k: float, F: int, B: int,
+                              ndev: int, num_hosts: int,
+                              sel_k: Optional[int] = None,
+                              int8sr: bool = False,
+                              ici_gbps: float = ICI_GBPS,
+                              dcn_gbps: float = DCN_GBPS) -> dict:
+    """Per-round comm table of the two-level hierarchical collective,
+    split by level (``ici`` / ``dcn``), in the per-level ring SEND-byte
+    convention of :func:`wire_bytes`.
+
+    Structure per round (k splits, subtraction trick — k slots cross):
+
+    * histogram — intra-host reduce-scatter of the full (k, F_pad, B, 3)
+      stack over the C-chip ICI axis, then inter-host reduce-scatter of
+      the surviving 1/C slice over the H-host DCN axis: only
+      ``M/C * (H-1)/H`` bytes ever cross the slow link, vs the flat
+      single-level ring's ``M * (D-1)/D`` (recorded as
+      ``flat_hist_wire_bytes`` — the guard denominator).
+    * votes — voting learner only: the (2k, F) election psum crosses
+      BOTH levels at full width (it is the payload that buys the
+      selective reduce, and it is priced here — satellite: the vote
+      vector must never ride uncounted).
+    * split sync — the packed-SplitInfo all-gather, chip level then host
+      level of the concatenated chip row.
+
+    The analytic ms terms price each level at its own bandwidth, and the
+    flat baseline at DCN speed (a flat ring's slowest hop is a DCN hop,
+    which is exactly why the hierarchy pays): ``hier_ms`` vs ``flat_ms``
+    is the modeled speedup the MULTICHIP record carries.
+    """
+    H, C = max(int(num_hosts), 1), ndev // max(int(num_hosts), 1)
+    spf = split_pack_floats(B)
+    n2k = int(round(2 * k))
+    if learner == "voting":
+        nsel = sel_k if sel_k is not None else F
+        nsel_pad = -(-nsel // ndev) * ndev
+        M = n2k * nsel_pad * B * HIST_CH
+        vote_elems = n2k * F
+    else:
+        F_pad = -(-F // ndev) * ndev
+        M = int(round(k)) * F_pad * B * HIST_CH
+        vote_elems = 0
+    sync_elems = n2k * spf
+    ici = {
+        "hist_bytes": wire_bytes(M, C, "reduce_scatter"),
+        "split_sync_bytes": wire_bytes(sync_elems, C, "all_gather"),
+        "vote_bytes": wire_bytes(vote_elems, C, "allreduce"),
+    }
+    dcn = {
+        "hist_bytes": wire_bytes(M // max(C, 1), H, "reduce_scatter"),
+        "split_sync_bytes": wire_bytes(sync_elems * C, H, "all_gather"),
+        "vote_bytes": wire_bytes(vote_elems, H, "allreduce"),
+    }
+    for level in (ici, dcn):
+        level["total_bytes"] = (level["hist_bytes"]
+                                + level["split_sync_bytes"]
+                                + level["vote_bytes"])
+    flat_hist = wire_bytes(M, ndev, "reduce_scatter")
+    giga = 1e9
+    ici_ms = ici["total_bytes"] / (ici_gbps * giga) * 1e3
+    dcn_ms = dcn["total_bytes"] / (dcn_gbps * giga) * 1e3
+    flat_ms = (flat_hist + wire_bytes(sync_elems, ndev, "all_gather")
+               + wire_bytes(vote_elems, ndev, "allreduce")) \
+        / (dcn_gbps * giga) * 1e3
+    return {
+        "num_hosts": H, "chips_per_host": C,
+        "hist_dtype": "int32" if int8sr else "float32",
+        "ici": ici, "dcn": dcn,
+        "flat_hist_wire_bytes": flat_hist,
+        "ici_ms": ici_ms, "dcn_ms": dcn_ms,
+        "hier_ms": ici_ms + dcn_ms, "flat_ms": flat_ms,
+    }
+
+
+def hier_comm_ok(dcn_hist_bytes: float, flat_hist_bytes: float,
+                 num_hosts: int,
+                 vote_bound_bytes: Optional[float] = None) -> bool:
+    """The pod-scale comm guard (``hier_comm_ok`` in the MULTICHIP record,
+    required by ``tools/ci_gate.py --require-guards``): the hierarchical
+    collective's DCN histogram bytes must be <= the flat reduce-scatter
+    wire bytes / num_hosts — i.e. the ICI pre-reduction must actually
+    shrink what crosses the slow link by at least the host fan-in.  The
+    voting learner additionally passes its top-2k analytic bound (the
+    elected-features slice): exceeding it means the selective reduce
+    silently widened to all features."""
+    if num_hosts <= 1:
+        return True
+    ok = dcn_hist_bytes <= flat_hist_bytes / num_hosts
+    if vote_bound_bytes is not None:
+        ok = ok and dcn_hist_bytes <= vote_bound_bytes
+    return ok
+
+
+def publish_hier_comm_metrics(learner: str, table: dict) -> None:
+    """Publish the per-level hierarchical comm table as gauges labeled
+    ``{learner, level, part}`` — the pod-scale sibling of
+    :func:`publish_comm_metrics`."""
+    from ..obs.metrics import default_registry
+
+    g = default_registry().gauge(
+        "hier_comm_bytes_per_round",
+        "Analytic per-device ring send bytes per wave round, by level",
+        label_names=("learner", "level", "part"))
+    for level in ("ici", "dcn"):
+        for part in ("hist_bytes", "split_sync_bytes", "vote_bytes",
+                     "total_bytes"):
+            g.labels(learner=learner, level=level,
+                     part=part[:-6]).set(float(table[level][part]))

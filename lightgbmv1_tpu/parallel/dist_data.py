@@ -181,6 +181,36 @@ def make_process_sharded(ds: BinnedDataset, config: Config) -> BinnedDataset:
     return out
 
 
+def load_block_cache_distributed(path: str, config: Config,
+                                 shard_to_trainer: bool = True
+                                 ) -> BinnedDataset:
+    """Host-sharded streaming load (ISSUE 16): each process opens a SHARD
+    VIEW of the block cache — only its own contiguous block run is read
+    off disk, so dataset size scales with the fleet, not the host.  Bin
+    mappers come from the cache's meta shard (already global: binning
+    happened at write time), so no cross-process bin agreement is needed;
+    the local rows then enter the trainer through the same
+    ``make_process_sharded`` contract the file loader uses."""
+    import jax
+
+    from ..data.streaming import StreamingDataset
+
+    rank, world = jax.process_index(), jax.process_count()
+    shard = (rank, world) if world > 1 else None
+    sds = StreamingDataset(path, shard=shard)
+    # materialize THIS shard only: (F, local_rows) — the O(shard) memory
+    # the host-sharded contract promises (never the global matrix)
+    local = sds.materialize()
+    log_info(f"Process {rank}/{world}: streamed {local.num_data} local "
+             f"rows from block cache {path}"
+             + (f" (global rows [{sds.shard_row_range[0]}, "
+                f"{sds.shard_row_range[1]}))" if shard else ""))
+    if shard_to_trainer and world > 1 \
+            and config.tree_learner == "data":
+        local = make_process_sharded(local, config)
+    return local
+
+
 def load_distributed(path: str, config: Config,
                      categorical_features=None,
                      shard_to_trainer: bool = True) -> BinnedDataset:
@@ -192,6 +222,12 @@ def load_distributed(path: str, config: Config,
     one place; only the shard parsing and the cross-process bin agreement
     are distributed concerns."""
     import jax
+
+    from ..data.block_cache import is_block_cache
+
+    if is_block_cache(path):
+        return load_block_cache_distributed(
+            path, config, shard_to_trainer=shard_to_trainer)
 
     rank, world = jax.process_index(), jax.process_count()
     # pre_partition=true: each process's data file already holds ONLY its

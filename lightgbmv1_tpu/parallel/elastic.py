@@ -186,8 +186,16 @@ class HeartbeatMonitor:
 
     def _loop(self) -> None:
         while not self._stop.wait(self.period_s):
-            self.board.beat()
-            dead = self.board.stale_peers()
+            try:
+                self.board.beat()
+                dead = self.board.stale_peers()
+            except OSError:
+                # leases dir torn down under us: the coordinator reaps
+                # the board after the fleet finishes, and this daemon
+                # thread may still be mid-beat — that is shutdown, not a
+                # crash (must not surface as an unhandled_thread_exception
+                # forensic bundle)
+                return
             if dead:
                 self.lost = dead
                 self._abort(dead)
@@ -242,6 +250,13 @@ class ElasticConfig:
     worker_timeout_s: float = 300.0  # hard per-generation wall bound
     grace_s: float = 0.0             # wait for survivors to self-abort
                                      # (0 = 3 lease timeouts)
+    shrink_on_loss: bool = False     # partial-fleet loss: respawn the
+                                     # SURVIVORS as a smaller world
+                                     # instead of replacing the dead
+                                     # rank (pod semantics — a lost
+                                     # host stays lost; shard ranges
+                                     # and the mesh re-derive from the
+                                     # new (rank, world))
 
     def __post_init__(self):
         self.world = max(int(self.world), 1)
@@ -269,6 +284,7 @@ class ElasticResult:
     ok: bool
     restarts: int
     generations: List[List[int]] = field(default_factory=list)
+    worlds: List[int] = field(default_factory=list)
     recovery_s: Optional[float] = None
     peer_lost_exits: int = 0
     outputs: List[str] = field(default_factory=list)
@@ -276,6 +292,7 @@ class ElasticResult:
     def to_dict(self) -> dict:
         return {"ok": self.ok, "restarts": self.restarts,
                 "generations": self.generations,
+                "worlds": self.worlds,
                 "recovery_s": self.recovery_s,
                 "peer_lost_exits": self.peer_lost_exits}
 
@@ -302,10 +319,12 @@ class ElasticCoordinator:
         os.makedirs(self.workdir, exist_ok=True)
 
     # -- spawn one generation -------------------------------------------
-    def _spawn(self, generation: int, port: int) -> List[subprocess.Popen]:
+    def _spawn(self, generation: int, port: int,
+               world: Optional[int] = None) -> List[subprocess.Popen]:
         cfg = self.config
+        world = cfg.world if world is None else int(world)
         procs = []
-        for rank in range(cfg.world):
+        for rank in range(world):
             env = dict(self.base_env)
             env["PYTHONPATH"] = (
                 os.path.dirname(os.path.dirname(
@@ -321,7 +340,7 @@ class ElasticCoordinator:
                 env.pop("LGBMV1_FAULTS", None)
             args = [sys.executable, "-m",
                     "lightgbmv1_tpu.parallel.elastic_worker",
-                    f"rank={rank}", f"world={cfg.world}", f"port={port}",
+                    f"rank={rank}", f"world={world}", f"port={port}",
                     f"leases_dir={os.path.join(self.workdir, 'leases')}",
                     f"lease_timeout_s={cfg.lease_timeout_s}",
                     f"generation={generation}"]
@@ -369,21 +388,23 @@ class ElasticCoordinator:
         cfg = self.config
         result = ElasticResult(ok=False, restarts=0)
         t_detect: Optional[float] = None
+        world = cfg.world
         for generation in range(cfg.max_restarts + 1):
             self._clear_leases()
             port = find_free_port()
             log_info(f"elastic: generation {generation} starting "
-                     f"({cfg.world} workers, coordinator :{port})")
-            procs = self._spawn(generation, port)
+                     f"({world} workers, coordinator :{port})")
+            procs = self._spawn(generation, port, world)
+            result.worlds.append(world)
             if t_detect is not None and result.recovery_s is None:
                 # recovery window closes when every respawned rank has a
                 # fresh lease — the fleet is re-bootstrapped and training
                 board = LeaseBoard(os.path.join(self.workdir, "leases"),
-                                   rank=-1, world=cfg.world,
+                                   rank=-1, world=world,
                                    timeout_s=cfg.lease_timeout_s)
                 probe_deadline = time.monotonic() + cfg.worker_timeout_s
                 while time.monotonic() < probe_deadline:
-                    if len(board.fresh_ranks()) == cfg.world:
+                    if len(board.fresh_ranks()) == world:
                         result.recovery_s = round(
                             time.monotonic() - t_detect, 3)
                         break
@@ -391,7 +412,7 @@ class ElasticCoordinator:
                         break
                     time.sleep(0.05)
             deadline = time.monotonic() + cfg.worker_timeout_s
-            rcs: List[Optional[int]] = [None] * cfg.world
+            rcs: List[Optional[int]] = [None] * world
             first_death: Optional[float] = None
             while time.monotonic() < deadline:
                 for i, p in enumerate(procs):
@@ -433,6 +454,21 @@ class ElasticCoordinator:
                 t_detect = (first_death if first_death is not None
                             else time.monotonic())
             result.restarts += 1
+            if cfg.shrink_on_loss:
+                # partial-fleet loss (ISSUE 16): ranks that died HARD
+                # (not the EXIT_PEER_LOST self-aborts — those survivors
+                # are respawnable) are lost hosts; the next generation
+                # runs the smaller world, and every worker re-derives
+                # its shard range and mesh from the new (rank, world)
+                # positive exits only: negative rcs are the coordinator's
+                # own reap of wedged-but-alive survivors, not lost hosts
+                hard_dead = sum(1 for rc in rcs
+                                if rc not in (0, EXIT_PEER_LOST) and rc > 0)
+                if 0 < hard_dead < world:
+                    world -= hard_dead
+                    log_warning(f"elastic: {hard_dead} worker(s) died "
+                                f"hard; shrinking the fleet to {world} "
+                                "survivors for the next generation")
             jitter = random.Random(1_000_003 * generation).random()
             delay = cfg.restart_backoff_s * (2 ** generation) \
                 * (1.0 + jitter)

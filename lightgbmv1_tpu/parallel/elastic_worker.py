@@ -92,9 +92,18 @@ def main(argv) -> int:
         "seed": int(kv.get("seed", 7)),
         "verbosity": -1,
     }
+    if world > 1 and kv.get("collective"):
+        # pod-scale passthrough (ISSUE 16): the hierarchical two-level
+        # collective over the real process fleet (one host row per rank).
+        # num_hosts falls back to the CURRENT world so a shrunk fleet
+        # rebuilds a valid (host, chip) mesh without coordinator help.
+        params["data_parallel_collective"] = kv["collective"]
+        params["num_hosts"] = int(kv.get("num_hosts", 0)) or world
     cfg = Config.from_dict(params)
     # shard reload: each generation re-derives exactly this rank's rows
-    # + the globally agreed bin mappers from the immutable data file
+    # + the globally agreed bin mappers from the immutable data file (or,
+    # for a block cache, this rank's manifest shard range — re-derived
+    # from the CURRENT (rank, world), so a shrunk fleet repartitions)
     binned = load_distributed(kv["data"], cfg)
 
     model_out = kv["model_out"]

@@ -73,7 +73,9 @@ from ..ops.histogram import (default_hist_method, hist_one_leaf, hist_wave,
 from ..ops.split import (FeatureMeta, SplitParams, SplitResult,
                          find_best_split, leaf_gain, tie_tol)
 from ..utils.log import log_fatal, log_info, log_warning
-from .cluster import comm_table_per_round, make_mesh, publish_comm_metrics
+from .cluster import (comm_table_per_round, hier_comm_table_per_round,
+                      make_hier_mesh, make_mesh, publish_comm_metrics,
+                      publish_hier_comm_metrics)
 
 try:  # jax >= 0.6 exposes shard_map at top level
     _shard_map = jax.shard_map
@@ -147,11 +149,14 @@ def _unpack_split(v: jnp.ndarray) -> SplitResult:
 
 
 def _sync_best_split(local: SplitResult, parent_sum, params: SplitParams,
-                     axis: str) -> SplitResult:
+                     axis) -> SplitResult:
     """Elect the global best split from per-shard locals — the reference's
     ``SyncUpGlobalBestSplit`` Allreduce-max over serialized SplitInfo
     (parallel_tree_learner.h:190-213), shared by the feature-parallel,
-    reduce-scatter data-parallel and sharded voting learners.
+    reduce-scatter data-parallel and sharded voting learners.  ``axis``
+    may be a tuple of mesh axes (the hierarchical ``("host", "chip")``
+    mesh): the all_gather then spans both levels, major axis first, so
+    the election sees every shard in device-linear order.
 
     The winner must be DEVICE-COUNT-INVARIANT: gains carry f32
     reduction-order noise, so candidates within ``tie_tol`` of the best
@@ -643,30 +648,56 @@ def build_trainer(
         # from O(F·B) to O(2k·B).
         from ..ops.split import per_feature_best_gain
 
-        mesh = _make_mesh(config.num_shards, "data")
+        collective = config.data_parallel_collective
+        hier = collective == "hierarchical"
+        if hier:
+            # two-level (host, chip) mesh (ISSUE 16): the vote psum and
+            # the selective reduce run level-by-level so only the
+            # 1/C-sliced partials cross the slow DCN axis
+            mesh = make_hier_mesh(config.num_shards, config.num_hosts)
+            NH, NC = (int(s) for s in mesh.devices.shape)
+            row_axes = ("host", "chip")
+        else:
+            mesh = _make_mesh(config.num_shards, "data")
+            NH = NC = 0
+            row_axes = "data"
         ndev = mesh.devices.size
         N_pad = ((N + ndev - 1) // ndev) * ndev
         binned_p = np.zeros((binned_np.shape[0], N_pad),
                             dtype=binned_np.dtype)
         binned_p[:, :N] = binned_np
         binned_dev = jax.device_put(
-            jnp.asarray(binned_p), NamedSharding(mesh, P(None, "data"))
+            jnp.asarray(binned_p), NamedSharding(mesh, P(None, row_axes))
         )
         top_k = max(1, min(config.top_k, F))
         sel_k = min(2 * top_k, F)
-        use_rs = (config.data_parallel_collective == "reduce_scatter"
-                  and ndev > 1)
+        use_hier = hier and ndev > 1
+        use_rs = (collective == "reduce_scatter" and ndev > 1) or use_hier
         sel_pad = -(-sel_k // ndev) * ndev
         sel_loc = sel_pad // ndev
         log_info(f"Voting-parallel training over {ndev} devices "
                  f"(top_k={top_k}, {sel_k} features reduced per split, "
-                 f"{config.data_parallel_collective} selective reduce)")
+                 f"{collective} selective reduce)")
         _comm_tbl = comm_table_per_round(
-            "voting", config.data_parallel_collective, k=wave_size,
-            F=F, B=B, ndev=ndev, sel_k=sel_k, int8sr=use_int8sr)
+            "voting", "reduce_scatter" if hier else collective,
+            k=wave_size, F=F, B=B, ndev=ndev, sel_k=sel_k,
+            int8sr=use_int8sr)
         log_info("comm/round (analytic, K=%d wave): %s"
                  % (wave_size, _comm_tbl))
+        # the top-2k ELECTION payload itself — the (2K, F) vote psum that
+        # buys the selective reduce — is priced next to the histograms it
+        # compresses (vote_bytes), never riding uncounted
+        log_info("voting election payload (GlobalVoting vote psum): "
+                 "%d B/round analytic, recorded as vote_bytes"
+                 % _comm_tbl.get("vote_bytes", 0))
         publish_comm_metrics("voting", _comm_tbl)
+        if hier:
+            _hier_tbl = hier_comm_table_per_round(
+                "voting", k=wave_size, F=F, B=B, ndev=ndev, num_hosts=NH,
+                sel_k=sel_k, int8sr=use_int8sr)
+            log_info("hier comm/round (per-level ring wire, K=%d wave): %s"
+                     % (wave_size, _hier_tbl))
+            publish_hier_comm_metrics("voting", _hier_tbl)
 
         def hist_fn(binned, g3, leaf_id, target):
             # local histogram only — the reduce happens per-split in split_fn
@@ -674,14 +705,15 @@ def build_trainer(
             return local_hist(binned, g3, leaf_id, target)
 
         def sums_fn(g3):
-            return lax.psum(g3.sum(axis=0), "data")
+            return lax.psum(g3.sum(axis=0), row_axes)
 
         def voting_wave_quant(binned, g3, label, nslots, key):
             # global (pmax'd) scales: the selective reduce in split_fn can
             # then sum the RAW integer histograms across shards (the
-            # int8sr integer-domain contract the data learner follows)
+            # int8sr integer-domain contract the data learner follows);
+            # under the hierarchical mesh the pmax spans both levels
             return local_wave_quant(binned, g3, label, nslots, key,
-                                    axis_name="data")
+                                    axis_name=row_axes)
 
         def split_fn(local_hist, parent, mask, key, uid, constraint, depth,
                      parent_output, cegb_pen=None, hist_scale=None):
@@ -705,7 +737,7 @@ def build_trainer(
             _, local_top = lax.top_k(gains, top_k)
             votes = jnp.zeros(F, jnp.float32).at[local_top].add(
                 jnp.where(jnp.isfinite(gains[local_top]), 1.0, 0.0))
-            votes = lax.psum(votes, "data")               # GlobalVoting
+            votes = lax.psum(votes, row_axes)             # GlobalVoting
             # tie-break deterministically by feature index
             order_score = votes * (F + 1) - jnp.arange(F, dtype=jnp.float32)
             _, selected = lax.top_k(order_score, sel_k)   # (sel_k,)
@@ -725,10 +757,21 @@ def build_trainer(
                 # reduces+keeps sel_k/D of the voted features, searches
                 # them, and only SplitInfo crosses chips
                 wire = jnp.pad(wire, ((0, sel_pad - sel_k), (0, 0), (0, 0)))
-                sl = lax.psum_scatter(wire, "data", scatter_dimension=0,
-                                      tiled=True)         # (sel_loc, B, 3)
+                if use_hier:
+                    # two-level selective reduce: full (sel_pad, B, 3)
+                    # wire rides the fast ICI ring only; the slow DCN hop
+                    # carries the 1/C chip slice of the ELECTED features
+                    sl = lax.psum_scatter(wire, "chip", scatter_dimension=0,
+                                          tiled=True)      # (sel_pad/C,...)
+                    sl = lax.psum_scatter(sl, "host", scatter_dimension=0,
+                                          tiled=True)      # (sel_loc, B, 3)
+                    lo = (lax.axis_index("chip") * (sel_pad // NC)
+                          + lax.axis_index("host") * sel_loc)
+                else:
+                    sl = lax.psum_scatter(wire, "data", scatter_dimension=0,
+                                          tiled=True)      # (sel_loc, B, 3)
+                    lo = lax.axis_index("data") * sel_loc
                 sl = sl.astype(jnp.float32)
-                lo = lax.axis_index("data") * sel_loc
                 sel_p = jnp.pad(selected, (0, sel_pad - sel_k),
                                 constant_values=F)        # F = drop slot
                 mine = lax.dynamic_slice(sel_p, (lo,), (sel_loc,))
@@ -740,8 +783,8 @@ def build_trainer(
                                         config.monotone_penalty,
                                         parent_output, rk, cegb_pen,
                                         hist_scale=hist_scale)
-                return _sync_best_split(local, parent, params, "data")
-            hist_sel = lax.psum(wire, "data").astype(jnp.float32)
+                return _sync_best_split(local, parent, params, row_axes)
+            hist_sel = lax.psum(wire, row_axes).astype(jnp.float32)
             full = jnp.zeros((F, B, 3), jnp.float32).at[selected].set(hist_sel)
             sel_mask = jnp.zeros(F, bool).at[selected].set(True)
             return find_best_split(full, parent, meta, mask & sel_mask,
@@ -771,11 +814,11 @@ def build_trainer(
         sharded = shard_map(
             grow,
             mesh=mesh,
-            in_specs=(P(None, "data"), P("data", None), P(), P(), P()),
+            in_specs=(P(None, row_axes), P(row_axes, None), P(), P(), P()),
             out_specs=(
                 jax.tree_util.tree_map(lambda _: P(), TreeArrays(
                     *([0] * len(TreeArrays._fields)))),
-                P("data"),
+                P(row_axes),
                 P(),
             ),
             check_vma=False,
@@ -792,9 +835,31 @@ def build_trainer(
             binned_dev, N
 
     if learner == "data":
-        mesh = _make_mesh(config.num_shards, "data")
+        collective = config.data_parallel_collective
+        if forced is not None and collective in ("reduce_scatter",
+                                                 "hierarchical"):
+            # forced splits read left/right sums straight off the leaf
+            # histogram (models/grower.forced_split_stats) — a shard-
+            # resident slice cannot serve a forced feature outside the
+            # shard, so the full-histogram path carries them
+            log_warning("forcedsplits_filename requires full histograms "
+                        "on every shard; data_parallel_collective falls "
+                        "back to allreduce")
+            collective = "allreduce"
+        hier = collective == "hierarchical"
+        if hier:
+            # two-level (host, chip) mesh (ISSUE 16): histograms
+            # reduce-scatter over the fast ICI axis first, and only the
+            # 1/C-sliced partials cross the slow DCN axis
+            mesh = make_hier_mesh(config.num_shards, config.num_hosts)
+            NH, NC = (int(s) for s in mesh.devices.shape)
+            row_axes = ("host", "chip")
+        else:
+            mesh = _make_mesh(config.num_shards, "data")
+            NH = NC = 0
+            row_axes = "data"
         ndev = mesh.devices.size
-        sharding = NamedSharding(mesh, P(None, "data"))
+        sharding = NamedSharding(mesh, P(None, row_axes))
         if row_sharded:
             # process-local shards -> one global sharded array; no process
             # ever materializes the full matrix (the reference's per-rank
@@ -815,17 +880,8 @@ def build_trainer(
                     lambda idx: jnp.asarray(binned_p[idx]))
             else:
                 binned_dev = jax.device_put(jnp.asarray(binned_p), sharding)
-        collective = config.data_parallel_collective
-        if forced is not None and collective == "reduce_scatter":
-            # forced splits read left/right sums straight off the leaf
-            # histogram (models/grower.forced_split_stats) — a shard-
-            # resident slice cannot serve a forced feature outside the
-            # shard, so the full-histogram path carries them
-            log_warning("forcedsplits_filename requires full histograms "
-                        "on every shard; data_parallel_collective falls "
-                        "back to allreduce")
-            collective = "allreduce"
-        use_rs = collective == "reduce_scatter" and ndev > 1
+        use_hier = hier and ndev > 1
+        use_rs = (collective == "reduce_scatter" and ndev > 1) or use_hier
         # the HISTOGRAM column axis being sharded: bundle columns under
         # EFB, original features otherwise (4-bit packed histograms are
         # already unpacked to F columns by the pallas kernel)
@@ -837,12 +893,19 @@ def build_trainer(
                  f"{jax.process_count()} processes, {collective} collective"
                  + (", process-sharded storage" if row_sharded else "")
                  + ")")
-        _comm_tbl = comm_table_per_round("data", collective, k=wave_size,
-                                         F=FH, B=Bh, ndev=ndev,
-                                         int8sr=use_int8sr)
+        _comm_tbl = comm_table_per_round(
+            "data", "reduce_scatter" if hier else collective, k=wave_size,
+            F=FH, B=Bh, ndev=ndev, int8sr=use_int8sr)
         log_info("comm/round (analytic, K=%d wave): %s"
                  % (wave_size, _comm_tbl))
         publish_comm_metrics("data", _comm_tbl)
+        if hier:
+            _hier_tbl = hier_comm_table_per_round(
+                "data", k=wave_size, F=FH, B=Bh, ndev=ndev, num_hosts=NH,
+                int8sr=use_int8sr)
+            log_info("hier comm/round (per-level ring wire, K=%d wave): %s"
+                     % (wave_size, _hier_tbl))
+            publish_hier_comm_metrics("data", _hier_tbl)
 
         def _scatter_keep(h, int_domain=False):
             """The reference's ReduceScatter of histogram blocks
@@ -861,13 +924,32 @@ def build_trainer(
                          + [(0, FH_pad - FH), (0, 0), (0, 0)])
             if int_domain:
                 hp = hp.astype(jnp.int32)
-            sl = lax.psum_scatter(hp, "data", scatter_dimension=nb,
-                                  tiled=True)
-            lo = lax.axis_index("data") * FH_loc
+            if use_hier:
+                # level 1 (ICI): the full FH_pad block rides the fast
+                # intra-host ring; level 2 (DCN): only the FH_pad/C chip
+                # slice crosses hosts — 1/C of the flat wire volume
+                sl = lax.psum_scatter(hp, "chip", scatter_dimension=nb,
+                                      tiled=True)
+                sl = lax.psum_scatter(sl, "host", scatter_dimension=nb,
+                                      tiled=True)
+            else:
+                sl = lax.psum_scatter(hp, "data", scatter_dimension=nb,
+                                      tiled=True)
+            lo = _shard_lo()
             full = jnp.zeros(hp.shape, jnp.float32)
             full = lax.dynamic_update_slice(
                 full, sl.astype(jnp.float32), (0,) * nb + (lo, 0, 0))
             return full[..., :FH, :, :] if FH_pad > FH else full
+
+        def _shard_lo():
+            """First histogram column this device owns after the
+            reduce-scatter.  Hierarchical keep-slices are chip-major
+            (the second scatter subdivides the chip slice by host), so
+            the offset composes both axis indices."""
+            if use_hier:
+                return (lax.axis_index("chip") * (FH_pad // NC)
+                        + lax.axis_index("host") * FH_loc)
+            return lax.axis_index("data") * FH_loc
 
         if bundle is not None:
             _shard_col = bundle.bundle_of            # (F,) hist column
@@ -881,7 +963,7 @@ def build_trainer(
             OWN features, as the reference data-parallel learner does
             after its ReduceScatter (data_parallel_tree_learner.cpp:
             175-199)."""
-            lo = lax.axis_index("data") * FH_loc
+            lo = _shard_lo()
             in_shard = (_shard_col >= lo) & (_shard_col < lo + FH_loc)
             if bundle is not None:
                 from ..io.bundle import expand_bundle_hist
@@ -895,7 +977,7 @@ def build_trainer(
                                     params, constraint, depth,
                                     config.monotone_penalty, parent_output,
                                     rk, cegb_pen, hist_scale=hist_scale)
-            return _sync_best_split(local, parent, params, "data")
+            return _sync_best_split(local, parent, params, row_axes)
 
         # integer histograms cannot cross expand_bundle_hist (its zero-bin
         # fix mixes real-unit parent sums in), so EFB keeps the grower's
@@ -904,17 +986,17 @@ def build_trainer(
 
         def hist_fn(binned, g3, leaf_id, target):
             h = local_hist(binned, g3, leaf_id, target)
-            return _scatter_keep(h) if use_rs else lax.psum(h, "data")
+            return _scatter_keep(h) if use_rs else lax.psum(h, row_axes)
 
         def sums_fn(g3):
-            return lax.psum(g3.sum(axis=0), "data")
+            return lax.psum(g3.sum(axis=0), row_axes)
 
         split_dp = _split_sharded if use_rs else split_local
 
         if levelwise:
             def frontier_fn(binned, g3, leaf_id, L_level):
                 h = local_frontier(binned, g3, leaf_id, L_level)
-                return _scatter_keep(h) if use_rs else lax.psum(h, "data")
+                return _scatter_keep(h) if use_rs else lax.psum(h, row_axes)
 
             grow = make_levelwise_grower(
                 hist_frontier_fn=frontier_fn, sums_fn=sums_fn,
@@ -926,7 +1008,7 @@ def build_trainer(
             # schedule's distributed dividend
             def wave_fn(binned, g3, label, nslots, deep=False):
                 h = local_wave(binned, g3, label, nslots, deep)
-                return _scatter_keep(h) if use_rs else lax.psum(h, "data")
+                return _scatter_keep(h) if use_rs else lax.psum(h, row_axes)
 
             if use_rs:
                 def wave_quant_fn(binned, g3, label, nslots, key):
@@ -934,9 +1016,11 @@ def build_trainer(
                     # integer system: the collective reduces raw int32
                     # and the single dequantize multiply happens at the
                     # consumer (subtraction pass / split scan hist_scale)
-                    # — the quantized pipeline's cross-chip contract
+                    # — the quantized pipeline's cross-chip contract.
+                    # Hierarchical runs pmax the scale across BOTH levels
+                    # and cross int32 on both hops (exact, order-free).
                     h, sc = local_wave_quant(binned, g3, label, nslots,
-                                             key, axis_name="data")
+                                             key, axis_name=row_axes)
                     return _scatter_keep(h, int_domain=True), sc
             else:
                 def wave_quant_fn(binned, g3, label, nslots, key):
@@ -947,7 +1031,7 @@ def build_trainer(
                     # dequantized f32 and the grower sees identity scales
                     h, sc = local_wave_quant(binned, g3, label, nslots,
                                              key)
-                    h = lax.psum(h * sc[:, None, None, :], "data")
+                    h = lax.psum(h * sc[:, None, None, :], row_axes)
                     return h, jnp.ones_like(sc)
 
             grow = make_wave_grower(hist_wave_fn=wave_fn, sums_fn=sums_fn,
@@ -965,11 +1049,11 @@ def build_trainer(
         sharded = shard_map(
             grow,
             mesh=mesh,
-            in_specs=(P(None, "data"), P("data", None), P(), P(), P()),
+            in_specs=(P(None, row_axes), P(row_axes, None), P(), P(), P()),
             out_specs=(
                 jax.tree_util.tree_map(lambda _: P(), TreeArrays(
                     *([0] * len(TreeArrays._fields)))),
-                P("data"),
+                P(row_axes),
                 P(),
             ),
             check_vma=False,

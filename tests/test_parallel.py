@@ -391,3 +391,129 @@ def test_int8sr_voting_selective_reduce_integer_domain(monkeypatch):
     for v, d in zip(v_sig, d_sig):
         assert v[:3] == d[:3]
         np.testing.assert_allclose(v[3], d[3], rtol=1e-3, atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# Hierarchical ICI/DCN two-level collective (pod-scale) — ISSUE 16
+# ---------------------------------------------------------------------------
+
+
+def test_hier_mesh_shapes_and_validation():
+    """The (host, chip) mesh is rectangular (a fleet that does not divide
+    into hosts is a config error, not a silent reshape) and degenerates
+    to a single host row when num_hosts is unset in a one-process run."""
+    from lightgbmv1_tpu.parallel.cluster import (hier_axis_sizes,
+                                                 make_hier_mesh)
+    from lightgbmv1_tpu.utils.log import LightGBMError
+
+    assert hier_axis_sizes(8, 2) == (2, 4)
+    assert hier_axis_sizes(8, 4) == (4, 2)
+    assert hier_axis_sizes(8, 0) == (1, 8)   # single-process auto
+    mesh = make_hier_mesh(8, 2)
+    assert mesh.axis_names == ("host", "chip")
+    assert mesh.devices.shape == (2, 4)
+    with pytest.raises(LightGBMError, match="divide"):
+        hier_axis_sizes(8, 3)
+
+
+# tier-1 wall budget: the 4-shard arm keeps the two-level bit-identity
+# contract in tier-1; the full 2x4 arm is slow-marked (the 8-device
+# hierarchical parity bar is also hard-asserted by dryrun_multichip on
+# every driver capture: data_hierarchical/voting_hierarchical records)
+@pytest.mark.parametrize("shards,hosts", [
+    (4, 2), pytest.param(8, 2, marks=pytest.mark.slow)])
+def test_hierarchical_vs_flat_vs_serial_bit_identical(shards, hosts):
+    """The two-level collective reduces over ("chip", "host") in a
+    different order than the flat ring, but the tie_tol band makes the
+    chosen trees invariant: hierarchical == flat reduce-scatter == serial
+    structure, with hierarchical pinned bit-identical to flat."""
+    X, y = make_binary_problem(1100, f=7)
+    serial = _train({"objective": "binary"}, X, y, 3)
+    rs = _train({"objective": "binary", "tree_learner": "data",
+                 "num_shards": shards}, X, y, 3)
+    hier = _train({"objective": "binary", "tree_learner": "data",
+                   "num_shards": shards, "num_hosts": hosts,
+                   "data_parallel_collective": "hierarchical"}, X, y, 3)
+    s_sig, r_sig, h_sig = (_tree_signature(g) for g in (serial, rs, hier))
+    for s, r, h in zip(s_sig, r_sig, h_sig):
+        assert s[:3] == r[:3] == h[:3]
+        np.testing.assert_allclose(s[3], h[3], rtol=1e-3, atol=1e-5)
+    np.testing.assert_allclose(rs.raw_train_scores(),
+                               hier.raw_train_scores(), rtol=1e-6,
+                               atol=1e-7)
+
+
+@pytest.mark.slow    # tier-1 budget (ISSUE 16): dryrun_multichip's hier
+# battery covers the padded-feature owner arithmetic on every capture
+def test_hierarchical_feature_count_not_divisible():
+    """F % D != 0 at the two-level collective: the padded feature axis is
+    sliced chip-major then host-major; the owner-offset arithmetic
+    (chip * FH_pad/C + host * FH_loc) must land every real feature on
+    exactly one owner and the padding-only slices stay -inf."""
+    X, y = make_binary_problem(900, f=11)    # 11 % 8 != 0, 11 % 4 != 0
+    serial = _train({"objective": "binary"}, X, y, 3)
+    hier = _train({"objective": "binary", "tree_learner": "data",
+                   "num_hosts": 2,
+                   "data_parallel_collective": "hierarchical"}, X, y, 3)
+    assert [s[:3] for s in _tree_signature(serial)] == \
+        [h[:3] for h in _tree_signature(hier)]
+    np.testing.assert_allclose(
+        serial.raw_train_scores(), hier.raw_train_scores(), rtol=1e-3,
+        atol=1e-5)
+
+
+@pytest.mark.slow    # tier-1 budget (ISSUE 16): voting_hierarchical
+# node_agreement 1.0 is asserted per-capture in dryrun_multichip
+def test_hierarchical_voting_matches_flat_voting():
+    """The voting learner's selective reduce under the two-level
+    collective: top-2k election, chip-level psum_scatter, host-level
+    psum_scatter, owner offset over the elected set — must reproduce the
+    flat voting learner's trees exactly (same election, same system)."""
+    X, y = make_binary_problem(900, f=8)
+    flat = _train({"objective": "binary", "tree_learner": "voting",
+                   "top_k": 3, "num_leaves": 15}, X, y, 2)
+    hier = _train({"objective": "binary", "tree_learner": "voting",
+                   "top_k": 3, "num_leaves": 15, "num_hosts": 2,
+                   "data_parallel_collective": "hierarchical"}, X, y, 2)
+    f_sig, h_sig = _tree_signature(flat), _tree_signature(hier)
+    for f, h in zip(f_sig, h_sig):
+        assert f[:3] == h[:3]
+        np.testing.assert_allclose(f[3], h[3], rtol=1e-6, atol=1e-7)
+
+
+def test_hierarchical_int8sr_collective_moves_int32(monkeypatch):
+    """The integer-domain pipeline survives the two-level lowering: the
+    quantized rounds' reduce-scatter ops carry i32 across BOTH levels —
+    replica groups of the chip size AND of the host size appear."""
+    import re
+
+    import lightgbmv1_tpu.models.grower_wave as gw
+    from lightgbmv1_tpu.io.dataset import BinnedDataset
+    from lightgbmv1_tpu.models.gbdt import create_boosting
+
+    monkeypatch.setattr(gw, "_BUCKET_MIN_N", 1)
+    X, y = make_binary_problem(800, f=6)
+    cfg = Config.from_dict({
+        "objective": "binary", "verbosity": -1, "min_data_in_leaf": 5,
+        "tree_learner": "data", "num_leaves": 64,
+        "leafwise_wave_size": 32, "hist_dtype_deep": "int8sr",
+        "data_parallel_collective": "hierarchical", "num_hosts": 2})
+    ds = BinnedDataset.from_numpy(X, label=y, config=cfg)
+    gb = create_boosting(cfg, ds)
+    txt = gb._grow.lower(
+        gb._grow_binned, jnp.zeros((800, 3), jnp.float32),
+        jnp.ones(6, bool), jax.random.PRNGKey(0),
+        jnp.zeros(6, bool)).as_text()
+    dtypes, group_sizes = set(), set()
+    for m in re.finditer('"stablehlo.reduce_scatter"', txt):
+        window = txt[m.start():m.start() + 1600]
+        dtypes.update(re.findall(r"tensor<[0-9x]*([a-z][0-9]+)>",
+                                 window[:400]))
+        g = re.search(r"replica_groups\s*=\s*dense<[^>]*>\s*:"
+                      r"\s*tensor<(\d+)x(\d+)xi64>", window)
+        if g:
+            group_sizes.add(int(g.group(2)))
+    assert "i32" in dtypes, dtypes
+    # both levels lower to real collectives: 4-chip groups and 2-host
+    # groups (a single flat 8-group would mean the hierarchy collapsed)
+    assert {2, 4} <= group_sizes, group_sizes

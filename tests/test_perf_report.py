@@ -521,3 +521,96 @@ def test_comm_section_renders_in_perf_md():
     rs = comm_table_per_round("data", "reduce_scatter", k=16, F=16, B=64,
                               ndev=8)
     assert str(rs["hist_bytes"]) in txt
+
+
+# ---------------------------------------------------------------------------
+# Pod-scale comms (ISSUE 16): the hierarchical table, its guard, and the
+# PERF.md section — bytes pinned at the dryrun smoke shape
+# ---------------------------------------------------------------------------
+
+
+def test_hier_comm_table_bytes_pinned():
+    """Byte-pin the two-level analytic table at the smoke shape
+    (K=16, F=16, B=64, D=8 as 2x4): ICI reduce-scatter sends
+    M*(C-1)/C, only the 1/C slice crosses DCN, and the guard trips if
+    the DCN bytes stop beating the flat wire by the host fan-in."""
+    sys.path.insert(0, REPO)
+    from lightgbmv1_tpu.parallel.cluster import (hier_comm_ok,
+                                                 hier_comm_table_per_round,
+                                                 wire_bytes)
+
+    K, F, B, D, H = 16, 16, 64, 8, 2
+    t = hier_comm_table_per_round("data", k=K, F=F, B=B, ndev=D,
+                                  num_hosts=H)
+    M = K * F * B * 3                           # (k, F, B, 3) f32 stack
+    assert t["num_hosts"] == 2 and t["chips_per_host"] == 4
+    assert t["ici"]["hist_bytes"] == M * 3 // 4 * 4 == 147456
+    assert t["dcn"]["hist_bytes"] == (M // 4) // 2 * 4 == 24576
+    assert t["flat_hist_wire_bytes"] == M * 7 // 8 * 4 == 172032
+    # the round-count-free invariant the measured-vs-analytic probe
+    # pins: ICI/DCN wire ratio = C(C-1)H / (H-1) = 6 at 2x4
+    assert t["ici"]["hist_bytes"] / t["dcn"]["hist_bytes"] == 6.0
+    assert t["hier_ms"] < t["flat_ms"]          # the hierarchy pays
+    # wire_bytes conventions the table is built from
+    assert wire_bytes(100, 4, "reduce_scatter") == 75 * 4
+    assert wire_bytes(100, 4, "allreduce") == 150 * 4
+    assert wire_bytes(100, 4, "all_gather") == 300 * 4
+    assert wire_bytes(100, 1, "reduce_scatter") == 0
+    # guard: DCN bytes must beat flat wire / H; degenerate H=1 passes
+    assert hier_comm_ok(t["dcn"]["hist_bytes"],
+                        t["flat_hist_wire_bytes"], H)
+    assert not hier_comm_ok(t["flat_hist_wire_bytes"],
+                            t["flat_hist_wire_bytes"], H)
+    assert hier_comm_ok(10**9, 1, 1)
+    # voting: the top-2k election payload is priced at BOTH levels and
+    # the vote bound catches a selective reduce that silently widened
+    v = hier_comm_table_per_round("voting", k=K, F=F, B=B, ndev=D,
+                                  num_hosts=H, sel_k=F)
+    assert v["ici"]["vote_bytes"] > 0 and v["dcn"]["vote_bytes"] > 0
+    assert not hier_comm_ok(v["dcn"]["hist_bytes"],
+                            v["flat_hist_wire_bytes"], H,
+                            vote_bound_bytes=v["dcn"]["hist_bytes"] - 1)
+
+
+def test_pod_comm_section_renders(tmp_path):
+    """The Pod-scale comms section: analytic table always renders (and
+    greps to hier_comm_table_per_round at the smoke shape), the
+    measured guards render when the MULTICHIP record carries them, and
+    an empty record yields the placeholder — the section never dies."""
+    import perf_report
+
+    mc = {
+        "n_devices": 8,
+        "hier_comm_bytes_per_round": {
+            "data": {"ici": {"hist_bytes": 82944},
+                     "dcn": {"hist_bytes": 13824, "total_bytes": 17568},
+                     "flat_hist_wire_bytes": 96768}},
+        "hier_comm_ok": True,
+        "hier_wire_measured": {"ici_bytes": 156672, "dcn_bytes": 26112,
+                               "ici_dcn_ratio": 6.0},
+        "hier_wire_analytic_ici_dcn_ratio": 6.0,
+        "hier_measured_vs_analytic_ok": True,
+    }
+    lines = []
+    perf_report.pod_comm_section(lines.append, "MULTICHIP_rXX.json", mc)
+    txt = "\n".join(lines)
+    assert "## Pod-scale comms" in txt
+    for needle in ("147456", "24576", "172032",      # analytic pins
+                   "13824", "96768",                 # measured fields
+                   "hier_comm_ok=True",
+                   "hier_measured_vs_analytic_ok=True"):
+        assert needle in txt, needle
+    lines = []
+    perf_report.pod_comm_section(lines.append, None, None)
+    txt = "\n".join(lines)
+    assert "## Pod-scale comms" in txt
+    assert "No MULTICHIP capture with hierarchical fields" in txt
+
+
+def test_pod_comm_section_renders_in_perf_md():
+    """PERF.md (generated output) carries the Pod-scale comms section
+    with the smoke-shape analytic figures."""
+    with open(os.path.join(REPO, "PERF.md")) as fh:
+        txt = fh.read()
+    assert "## Pod-scale comms" in txt
+    assert "147456" in txt and "24576" in txt

@@ -209,6 +209,105 @@ def test_save_binary_torn_cache_fails_loudly(tmp_path, damage):
         BinnedDataset.load_binary(p)
 
 
+# ---------------------------------------------------------------------------
+# host-sharded streaming (pod-scale, ISSUE 16): each process streams only
+# its manifest shard range, derived deterministically from (rank, world)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("block_rows,world", [(77, 4), (64, 3), (100, 2)])
+def test_host_shard_partition_reconstructs(tmp_path, block_rows, world):
+    """The world's shards are a contiguous, disjoint, block-aligned
+    partition: concatenating every rank's materialized shard reproduces
+    the full dataset bit-exactly — binned rows, labels, weights."""
+    from lightgbmv1_tpu.data.block_cache import shard_blocks
+
+    ds = make_binned(n=307)
+    path = str(tmp_path / "cache")
+    manifest = write_block_cache(ds, path, block_rows=block_rows)
+
+    parts, labels, row_end = [], [], 0
+    for rank in range(world):
+        s = shard_blocks(manifest, rank, world, path)
+        assert s["row_begin"] == row_end        # contiguous, no overlap
+        row_end = s["row_end"]
+        sds = StreamingDataset(path, shard=(rank, world))
+        assert sds.shard_row_range == (s["row_begin"], s["row_end"])
+        assert sds.num_data == s["row_end"] - s["row_begin"]
+        local = sds.materialize()
+        parts.append(np.asarray(local.binned))
+        labels.append(np.asarray(sds.metadata.label))
+    assert row_end == ds.num_data               # full coverage
+    np.testing.assert_array_equal(np.concatenate(parts, axis=1), ds.binned)
+    np.testing.assert_array_equal(np.concatenate(labels),
+                                  ds.metadata.label)
+
+
+def test_host_shard_ragged_tail_and_empty_shard(tmp_path):
+    """world > num_blocks: the balanced block split leaves some ranks an
+    EMPTY run (row_begin == row_end) — a legal degenerate shard, and the
+    ragged tail block lands whole on exactly one rank."""
+    from lightgbmv1_tpu.data.block_cache import shard_blocks
+
+    ds = make_binned(n=250)
+    path = str(tmp_path / "cache")
+    manifest = write_block_cache(ds, path, block_rows=100)  # 3 blocks
+    world = 5                                               # > blocks
+    sizes = []
+    for rank in range(world):
+        s = shard_blocks(manifest, rank, world, path)
+        sds = StreamingDataset(path, shard=(rank, world))
+        assert sds.num_data == s["row_end"] - s["row_begin"]
+        sizes.append(sds.num_data)
+    assert sum(sizes) == ds.num_data
+    assert 0 in sizes                # some rank got the empty shard
+    assert 50 in sizes               # the ragged 250 % 100 tail, whole
+    with pytest.raises(BlockCacheError, match="out of range"):
+        shard_blocks(manifest, world, world, path)
+
+
+@pytest.mark.parametrize("damage", ["overlap", "gap", "short"])
+def test_host_shard_manifest_overlap_gap_fail_loudly(tmp_path, damage):
+    """A manifest whose block table overlaps (rows double-read), gaps
+    (rows silently dropped) or under-covers num_rows must fail LOUDLY at
+    shard derivation — the partition trusts these ranges."""
+    import json
+
+    ds = make_binned(n=300)
+    path = str(tmp_path / "cache")
+    write_block_cache(ds, path, block_rows=100)
+    mp = os.path.join(path, "manifest.json")
+    m = json.load(open(mp))
+    if damage == "overlap":
+        m["blocks"][1]["row_begin"] = 50
+        needle = "OVERLAPS"
+    elif damage == "gap":
+        m["blocks"][1]["row_begin"] = 150
+        needle = "GAP"
+    else:
+        m["blocks"] = m["blocks"][:2]
+        needle = "covers"
+    from lightgbmv1_tpu.data.block_cache import shard_blocks
+
+    with pytest.raises(BlockCacheError, match=needle):
+        shard_blocks(m, 0, 2, path)
+
+
+def test_host_shard_ranking_data_refused(tmp_path):
+    """Query groups span shard boundaries; host-sharded streaming of
+    ranking data must refuse instead of silently splitting a group."""
+    rng = np.random.RandomState(3)
+    X = rng.randn(200, 4)
+    y = rng.randint(0, 3, 200).astype(float)
+    ds = lgb.Dataset(X, label=y, group=[50, 50, 100],
+                     params={"verbosity": -1}).construct()._binned
+    path = str(tmp_path / "cache")
+    write_block_cache(ds, path, block_rows=64)
+    StreamingDataset(path)          # unsharded streaming still fine
+    with pytest.raises(BlockCacheError, match="ranking"):
+        StreamingDataset(path, shard=(0, 2))
+
+
 def test_save_binary_newer_version_refused(tmp_path):
     import io as _io
 

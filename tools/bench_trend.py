@@ -107,6 +107,13 @@ WATCHED: Tuple[Tuple[str, str, float], ...] = (
     # (sub-noise-floor fraction; the drift_ok guard already enforces
     # the <= 2% contract), like the other methodology-coupled fields.
     ("drift_injected_psi", "up", 0.25),
+    # pod-scale two-level collective (ISSUE 16): the DCN (slow inter-
+    # host link) histogram wire bytes per round, flat-scalar mirror of
+    # hier_comm_bytes_per_round["data"]["dcn"]["hist_bytes"], at the
+    # standard 10% bar — a regression here means the slow link started
+    # carrying more than the 1/C chip slice; hier_comm_ok is the
+    # boolean guard the sweep flags automatically
+    ("hier_dcn_hist_bytes", "down", 0.10),
 )
 
 _PARITY_RE = re.compile(r"dryrun_multichip PARITY (\{.*\})")

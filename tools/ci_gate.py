@@ -57,10 +57,15 @@ import tier1_budget  # noqa: E402
 # fused_round_ok is the single-pass wave-round guard (ISSUE 15: routed
 # parity with partition + valid routing + top-k folded into the fused
 # dispatch AND the binned-matrix-read-once bytes contract — >= 1.8x
-# bytes_accessed reduction vs staged partition+hist on device)
+# bytes_accessed reduction vs staged partition+hist on device);
+# hier_comm_ok is the pod-scale two-level collective guard (ISSUE 16:
+# DCN histogram bytes <= flat reduce-scatter wire / num_hosts, and the
+# voting learner's DCN payload <= its top-2k analytic bound —
+# parallel/cluster.py hier_comm_table_per_round)
 REQUIRED_GUARDS = ("obs_ok", "slo_ok", "forensics_ok", "chaos_ok",
                    "fleet_ok", "chaos_fleet_ok", "obs_device_ok",
-                   "fused_ok", "drift_ok", "fused_round_ok")
+                   "fused_ok", "drift_ok", "fused_round_ok",
+                   "hier_comm_ok")
 
 
 def check_required_guards(records_dir: str, guards, out=print) -> bool:

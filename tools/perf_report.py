@@ -118,6 +118,93 @@ def comm_section(w, mc_name, mc):
     w("")
 
 
+def pod_comm_section(w, mc_name, mc):
+    """Pod-scale comms (ISSUE 16): the hierarchical ICI/DCN collective's
+    per-level analytic wire table at the dryrun smoke shape (single
+    source of truth: parallel/cluster.py hier_comm_table_per_round — the
+    same function the trainer logs at build time and dryrun_multichip
+    records), plus the measured-record guards when a MULTICHIP capture
+    carries them.  Placeholder until then — the section never dies."""
+    try:
+        if ROOT not in sys.path:
+            sys.path.insert(0, ROOT)
+        from lightgbmv1_tpu.parallel.cluster import hier_comm_table_per_round
+    except Exception as e:  # noqa: BLE001 — report generation must not die
+        w(f"(hier comm table unavailable: {type(e).__name__})")
+        w("")
+        return
+    H = 2
+    w("## Pod-scale comms (hierarchical ICI/DCN collective, per wave "
+      "round)")
+    w("")
+    w(f"Per-level ring SEND bytes per device per K={SMOKE['K']}-split "
+      f"round at the dryrun smoke shape (D={SMOKE['ndev']} as H={H} "
+      f"hosts x C={SMOKE['ndev'] // H} chips, F={SMOKE['F']}, "
+      f"B={SMOKE['B']}; parallel/cluster.py hier_comm_table_per_round). "
+      "`data_parallel_collective=hierarchical` reduce-scatters over the "
+      "fast intra-host ICI axis FIRST, so only the F/D-sliced partials "
+      "ever cross the slow inter-host DCN link; the voting learner's "
+      "top-2k election additionally compresses WHAT crosses:")
+    w("")
+    w("| learner / level | histogram | split sync | votes | total |")
+    w("|---|---|---|---|---|")
+    tables = {}
+    for learner in ("data", "voting"):
+        t = hier_comm_table_per_round(
+            learner, k=SMOKE["K"], F=SMOKE["F"], B=SMOKE["B"],
+            ndev=SMOKE["ndev"], num_hosts=H,
+            sel_k=(min(2 * SMOKE["top_k"], SMOKE["F"])
+                   if learner == "voting" else None))
+        tables[learner] = t
+        for level in ("ici", "dcn"):
+            lv = t[level]
+            w(f"| {learner} / {level} | {lv['hist_bytes']} | "
+              f"{lv['split_sync_bytes']} | {lv['vote_bytes']} | "
+              f"{lv['total_bytes']} |")
+        w(f"| {learner} / flat ring (all-DCN baseline) | "
+          f"{t['flat_hist_wire_bytes']} | — | — | — |")
+    w("")
+    dt = tables["data"]
+    w(f"Modeled round latency at the ICI/DCN bandwidth gap "
+      f"(cluster.ICI_GBPS/DCN_GBPS): hierarchical "
+      f"{fmt(dt['hier_ms'], 5)} ms vs flat {fmt(dt['flat_ms'], 5)} ms "
+      f"for the data learner — the flat ring's slowest hop is a DCN "
+      "hop, which is exactly why the hierarchy pays.")
+    w("")
+    if mc and mc.get("hier_comm_bytes_per_round"):
+        w(f"Measured-record table (`{mc_name}`, "
+          f"D={mc.get('n_devices')}, mean-k rounds):")
+        w("")
+        w("| learner | ICI hist | DCN hist | DCN total | flat wire |")
+        w("|---|---|---|---|---|")
+        for name, t in mc["hier_comm_bytes_per_round"].items():
+            w(f"| {name} | {(t.get('ici') or {}).get('hist_bytes')} | "
+              f"{(t.get('dcn') or {}).get('hist_bytes')} | "
+              f"{(t.get('dcn') or {}).get('total_bytes')} | "
+              f"{t.get('flat_hist_wire_bytes')} |")
+        w("")
+        wire = mc.get("hier_wire_measured") or {}
+        w(f"Guards: `hier_comm_ok={mc.get('hier_comm_ok')}` (DCN "
+          "histogram bytes <= flat reduce-scatter wire / num_hosts, the "
+          "voting learner additionally within its top-2k analytic bound "
+          "— cluster.hier_comm_ok, required by tools/ci_gate.py "
+          "--require-guards) and `hier_measured_vs_analytic_ok="
+          f"{mc.get('hier_measured_vs_analytic_ok')}` (the lowered "
+          "StableHLO's reduce-scatter ops, split by replica-group size: "
+          f"measured ICI/DCN wire ratio {get(wire, 'ici_dcn_ratio', 2)} "
+          "vs analytic "
+          f"{get(mc, 'hier_wire_analytic_ici_dcn_ratio', 2)}, within "
+          "5%).")
+    else:
+        w("No MULTICHIP capture with hierarchical fields yet — the next "
+          "driver run of tools/dryrun_multichip trains the "
+          "data_hierarchical/voting_hierarchical parity set on the 2x4 "
+          "virtual mesh and records the per-level table, the "
+          "`hier_comm_ok` guard and the measured-vs-analytic wire "
+          "ratio into the MULTICHIP record.")
+    w("")
+
+
 def fused_section(w, rec):
     """Fused wave-round megakernel (ISSUE 13 — ops/wave_fused.py,
     bench.py measure_fused / measure_fused_round_ms): parity, the merged
@@ -949,6 +1036,8 @@ def generate(rec, name, prev=None, prev_name=None):
 
     mc_name, mc = load_multichip()
     comm_section(w, mc_name, mc)
+
+    pod_comm_section(w, mc_name, mc)
 
     trend_section(w)
 
