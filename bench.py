@@ -524,6 +524,124 @@ def measure_fused(ds, N, backend, n_iters):
     return fields
 
 
+def measure_fused_waveloop(ds, N, backend, n_iters):
+    """Persistent multi-round wave loop A/B (ISSUE 17 —
+    ``wave_loop_rounds`` on the ``hist_method=fused`` path), every
+    backend:
+
+    * **parity** — the looped run's trees must byte-compare to the
+      single-round fused run's model text (which measure_fused pins
+      against staged): the R-rounds-per-launch kernel replays the same
+      round boundary, so this is the whole-loop bit contract.
+    * **launch accounting** — the VMEM plan (recorded verbatim: why this
+      shape looped or fell back) and the analytic launch/state-traffic
+      deltas: each R-round segment saves R-1 kernel launches and R-1
+      round-trips of the resident state (frontier table + leaf ids +
+      hist pool — ``2 * state_bytes`` per avoided boundary).
+    * **measured bytes** — the compiled ``grow.fused_loop`` vs
+      ``grow.fused_round`` executables' own cost_analysis bytes
+      (obs/xla compile telemetry), the measured form of "state never
+      spills", recorded beside the analytic figure.
+    * **``phase_wave_loop_ms``** — the looped run's per-iteration round
+      dispatch ms by the differential method: the single-round run's
+      per-iter wall minus the looped run's per-iter wall is the
+      boundary saving; applied to the single-round wall it prices the
+      loop dispatch as a phase row (bench_trend watches it at the 10%
+      bar on device captures).
+
+    ``fused_loop_ok`` is joined in main(): parity everywhere AND, on
+    device, loop per-iter <= single-round per-iter.
+    """
+    import jax
+
+    from lightgbmv1_tpu.basic import _objective_string
+    from lightgbmv1_tpu.config import Config
+    from lightgbmv1_tpu.io.model_text import model_to_string
+    from lightgbmv1_tpu.models.gbdt import create_boosting
+    from lightgbmv1_tpu.models.grower_wave import (_SUB_STATE_CAP_BYTES,
+                                                   auto_wave_size,
+                                                   slot_buckets_for)
+    from lightgbmv1_tpu.obs import xla as obs_xla
+    from lightgbmv1_tpu.ops.wave_fused import plan_wave_loop
+
+    fields = {}
+    R_REQ = 4
+    base = {
+        "objective": "binary", "num_leaves": 255, "max_bin": 63,
+        "learning_rate": 0.1, "min_data_in_leaf": 20, "verbosity": -1,
+        "tree_growth": "leafwise", "hist_method": "fused",
+    }
+
+    def run(over):
+        cfg = Config.from_dict({**base, **over})
+        gb = create_boosting(cfg, ds)
+        gb.train_iters(n_iters)
+        jax.device_get(gb._train_scores.score)
+        dt = 1e30
+        for _ in range(2):
+            t0 = time.time()
+            gb.train_iters(n_iters)
+            jax.device_get(gb._train_scores.score)
+            dt = min(dt, time.time() - t0)
+        text = model_to_string(
+            gb.materialize_host_trees(),
+            objective_string=_objective_string(cfg), num_class=1,
+            num_tree_per_iteration=1,
+            feature_names=list(ds.feature_names),
+            feature_infos=ds.feature_infos())
+        return gb, dt, text
+
+    gb_lp, lp_dt, lp_text = run({"wave_loop_rounds": R_REQ})
+    _, sr_dt, sr_text = run({})
+    fields["fused_loop_parity_ok"] = bool(lp_text == sr_text)
+    fields["wave_loop_M_row_trees_per_s"] = round(
+        N * n_iters / lp_dt / 1e6, 3)
+    fields["wave_loop_single_round_M_row_trees_per_s"] = round(
+        N * n_iters / sr_dt / 1e6, 3)
+
+    # the static plan, recorded verbatim (why this shape looped or fell
+    # back) + the analytic launch / state-traffic deltas it implies
+    F_b = int(ds.train_matrix.shape[0])
+    K_b = auto_wave_size(255)
+    L_b = 255
+    B_b = 64
+    use_sub_b = L_b * F_b * B_b * 3 * 4 <= _SUB_STATE_CAP_BYTES
+    plan = plan_wave_loop(
+        rounds=R_REQ, N=N, F=F_b, num_bins=B_b, K=K_b, L=L_b,
+        use_sub=use_sub_b, slot_buckets=slot_buckets_for(K_b, N))
+    fields["fused_loop_plan"] = {k: (list(v) if isinstance(v, tuple)
+                                     else v) for k, v in plan.items()}
+    R_eff = plan["rounds"] if plan["eligible"] else 1
+    fields["fused_loop_rounds"] = int(R_eff)
+    fields["fused_loop_launches_saved_per_segment"] = int(R_eff - 1)
+    fields["fused_loop_state_bytes_saved_per_segment_analytic"] = int(
+        (R_eff - 1) * 2 * plan["state_bytes"])
+
+    # measured executable bytes (obs/xla compile telemetry): the looped
+    # vs single-round grow executables' own cost_analysis
+    st = obs_xla.compile_stats()
+    for label, key in (("grow.fused_loop", "fused_loop_bytes_accessed"),
+                       ("grow.fused_round",
+                        "fused_round_bytes_accessed")):
+        b = (st.get(label) or {}).get("bytes_accessed")
+        fields[key] = int(b) if b is not None else None
+
+    # phase_wave_loop_ms by the differential method (device sessions:
+    # the watched phase row; the CPU interpreter's wall is
+    # unrepresentative, so the CPU record carries the raw per-iter ms
+    # pair only, like fused_ok's perf leg)
+    lp_it = lp_dt / n_iters * 1e3
+    sr_it = sr_dt / n_iters * 1e3
+    fields["wave_loop_ms_per_iter"] = round(lp_it, 3)
+    fields["wave_loop_single_round_ms_per_iter"] = round(sr_it, 3)
+    if backend != "cpu" and fields["fused_loop_rounds"] > 1:
+        # joined into phase_wave_loop_ms in main(), where the
+        # single-round dispatch ms (partition_fused_ms_per_iter) lives
+        fields["wave_loop_boundary_saving_ms_per_iter"] = round(
+            sr_it - lp_it, 3)
+    return fields
+
+
 def _fused_round_bytes(ds, N, backend, gb_fu):
     """Compiled-executable byte accounting of ONE sustained wave round,
     BOTH legs starting from the same (leaf ids + committed splits)
@@ -2058,6 +2176,17 @@ def main():
         extra["fused_error"] = f"{type(e).__name__}: {e}"[:200]
         extra["fused_parity_ok"] = False
 
+    # ---- persistent multi-round wave loop A/B (wave_loop_rounds,
+    # ISSUE 17): loop-vs-single-round parity + the VMEM plan + launch /
+    # state-traffic accounting on every backend; the perf leg of
+    # fused_loop_ok joins below.
+    try:
+        extra.update(measure_fused_waveloop(ds, N, backend,
+                                            n_iters=min(lw_trees, 3)))
+    except Exception as e:  # noqa: BLE001 — partial records beat none
+        extra["fused_loop_error"] = f"{type(e).__name__}: {e}"[:200]
+        extra["fused_loop_parity_ok"] = False
+
     if backend != "cpu" and os.environ.get("BENCH_FULL", "1") == "1":
         schedule = None
         try:
@@ -2378,6 +2507,26 @@ def main():
              or (fr_red is not None and fr_red >= 1.8
                  and extra.get("partition_fused_ms_per_iter")
                  is not None)))
+
+    # ---- fused_loop_ok (ISSUE 17): the persistent multi-round wave
+    # loop — loop-vs-single-round model-text parity everywhere AND, on
+    # device, the looped per-iteration wall at or under the single-round
+    # fused wall it replaces (the boundary saving must not be negative;
+    # a CPU capture proves parity only — the interpreter serializes the
+    # grid, so its wall is unrepresentative, like fused_ok's perf leg).
+    # The staged path stays the default until a device capture lands
+    # this guard True with the ms leg actually evaluated.
+    lp_save = extra.get("wave_loop_boundary_saving_ms_per_iter")
+    extra["fused_loop_ok"] = bool(
+        extra.get("fused_loop_parity_ok")
+        and (backend == "cpu"
+             or (lp_save is not None and lp_save >= 0)))
+    # the watched phase row: the loop dispatch priced by the
+    # differential method — the measured single-round dispatch ms minus
+    # the boundary saving the loop run demonstrated
+    pfm = extra.get("partition_fused_ms_per_iter")
+    if pfm is not None and lp_save is not None:
+        extra["phase_wave_loop_ms"] = round(max(pfm - lp_save, 0.0), 3)
 
     # Online-serving loadgen block (serve/ subsystem): runs on every
     # backend — the acceptance record for hot-swap-under-traffic and

@@ -372,6 +372,21 @@ class Config:
     # fully-serialized round body, kept as the bit-parity pin;
     # tests/test_wave_pipeline.py).
     async_wave_pipeline: bool = True
+    # persistent multi-round wave loop (ROADMAP item 1, ops/wave_fused.
+    # make_fused_wave_loop): with hist_method=fused, R>1 runs R
+    # consecutive wave rounds in ONE Pallas launch — the frontier table,
+    # histogram pool, row->leaf labels and top-k state stay resident in
+    # VMEM scratch across rounds, eliminating R-1 kernel launches plus
+    # their leaf-id/pool/split-table HBM round-trips per loop.  A static
+    # VMEM budget planner (plan_wave_loop) may refuse the loop (multi-
+    # round state over budget, monotone constraints, quantized deep
+    # rounds off the f32 lane, non-uniform row tiling across the slot
+    # ladder) — refusals fall back to single-round fused dispatch with a
+    # logged reason (the fallback taxonomy, BASELINE.md).  1 = the
+    # PR-15 single-round kernel (default; the loop is opt-in until a
+    # device capture lands the `fused_loop_ok` guard).  Trees are
+    # bit-identical at any R (tests/test_wave_fused.py parity matrix).
+    wave_loop_rounds: int = 1
     # donate the score caches (train + valid) into the fused per-iteration
     # step (jax donate_argnums): the iteration's score update runs in
     # place instead of allocating a second (N, K) buffer per cache —
@@ -424,6 +439,15 @@ class Config:
     # count in a multi-process run, 1 otherwise).  A single-process run
     # can model a pod by setting it explicitly (the 2x4 dryrun rig).
     num_hosts: int = 0
+    # modeled per-link bandwidths (GB/s) behind the hierarchical
+    # collective's comm table (parallel/cluster.hier_comm_table_per_round
+    # "modeled-ms" column): intra-host ICI and inter-host DCN.  Defaults
+    # are the v4-pod planning guesses the table shipped with; a pod
+    # capture calibrates them from measured per-round ms without a code
+    # change.  Purely observational — they never change collective
+    # selection or results.
+    hier_ici_gbps: float = 100.0
+    hier_dcn_gbps: float = 10.0
     # -- serving (models/predict.py batched inference engine) ----------
     # prediction engine: "auto" keeps the host routing (native C++ bulk
     # predictor above the work threshold, vectorized numpy below);
@@ -738,6 +762,13 @@ class Config:
                 "reduce_scatter | allreduce | hierarchical")
         if self.num_hosts < 0:
             raise ValueError("num_hosts must be >= 0 (0 = auto-detect)")
+        if self.wave_loop_rounds < 1:
+            raise ValueError("wave_loop_rounds must be >= 1 (1 = the "
+                             "single-round fused kernel)")
+        if self.hier_ici_gbps <= 0 or self.hier_dcn_gbps <= 0:
+            raise ValueError("hier_ici_gbps / hier_dcn_gbps must be > 0 "
+                             "(modeled link bandwidths of the "
+                             "hierarchical collective's comm table)")
         if self.predict_method not in (
                 "auto", "native", "host", "depthwise", "pallas", "scan"):
             raise ValueError(

@@ -103,6 +103,7 @@ from ..ops.split import (
     NO_CONSTRAINT,
     FeatureMeta,
     SplitParams,
+    child_leaf_output,
     find_best_split,
     go_left_rule,
     leaf_output,
@@ -306,6 +307,12 @@ def subtract_child_hists(h_slot, leaf_hist, leafs, order_c, sm_left,
     on the deferred scatter; None gathers from ``leaf_hist`` as before."""
     h_small = h_slot[order_c]              # slot-order -> rank-order
     if slot_scale is not None:
+        # exact multiply: every dequantization scale is a power of two
+        # (ops/quantize.sr_prequantize_g3), so the subtraction below
+        # rounds identically whether or not the compiler contracts this
+        # product into it (fma) — the bit-parity contract between this
+        # site, the fused kernel's scan, and the wave-loop commit
+        # depends on that exactness, not on fusion heuristics.
         h_small = h_small * slot_scale[order_c][:, None, None, :]
     if h_parent is None:
         h_parent = leaf_hist[leafs]
@@ -677,6 +684,7 @@ def make_wave_grower(
     sums_fn: Callable = None,
     bins_of_fn: Callable = None,
     fused_round_fn: Callable = None,
+    fused_loop_fn: Callable = None,
 ):
     """Build the jittable ``grow(binned, g3, base_mask, key)`` function.
 
@@ -735,6 +743,17 @@ def make_wave_grower(
     Trees are bit-identical to the staged path on the
     same histogram arithmetic (tests/test_wave_fused.py pins this in
     interpret mode).
+    ``fused_loop_fn`` (ops/wave_fused.make_fused_wave_loop, wired by
+    parallel/trainer.py under ``wave_loop_rounds > 1``): each while-loop
+    body becomes a SEGMENT of R consecutive rounds run by ONE persistent
+    kernel launch — frontier table, histogram pool and row→leaf labels
+    resident in VMEM between rounds — followed by a host REPLAY of the R
+    rounds' bookkeeping (store writes, valid routing, done flag) from
+    the kernel's per-round packed SplitInfo.  Engagement is static
+    (``fused_loop_fn.plan``, the VMEM budget planner) and falls back to
+    the single-round body when ineligible; trees, stores and routings
+    are bit-identical to both the single-round fused and the staged
+    paths (tests/test_wave_fused.py's loop parity matrix).
     ``async_wave_pipeline`` (default on) software-pipelines the round
     loop: the per-leaf histogram-state scatter and the valid-row routing
     of round r are DEFERRED into a pending carry and applied at the
@@ -812,12 +831,10 @@ def make_wave_grower(
         return allowed_features_for(groups, used)
 
     def clamp_out(sums, constr, parent_out):
-        out = leaf_output(sums[0], sums[1], params)
-        if params.path_smooth > 0:
-            out = smooth_output(out, sums[2], parent_out, params)
-        if not use_mc:
-            return out
-        return jnp.clip(out, constr[0], constr[1])
+        # shared with the persistent wave-loop kernel (ops/split.py) —
+        # both paths must run the same ops for the loop parity contract
+        return child_leaf_output(sums, constr, parent_out, params,
+                                 use_mc=use_mc)
 
     def grow(binned, g3, base_mask, key, cegb_used=None, valids=()):
         N = binned.shape[1]
@@ -854,12 +871,32 @@ def make_wave_grower(
         # the larger child from the per-leaf histogram state.  Skipped
         # when that state would exceed 512 MB (wide-F configs).
         use_sub = (L * int(np.prod(hist0.shape)) * 4) <= _SUB_STATE_CAP_BYTES
+        # persistent multi-round wave loop (ROADMAP item 1): engage only
+        # when the static plan says the whole frontier state fits VMEM
+        # and every staged leg the loop cannot replicate in-kernel is
+        # off.  The decision is trace-time — shapes and knobs only — so
+        # the ineligible fallback is the unchanged single-round body.
+        use_loop = False
+        loop_plan = None
+        if (fused_loop_fn is not None and use_fused_route
+                and not (use_cat or use_mc or use_inter or use_groups)
+                and feature_fraction_bynode >= 1.0):
+            loop_plan = fused_loop_fn.plan(
+                N=N, F=F, K=K, L=L, use_sub=use_sub,
+                slot_buckets=slot_buckets, quant_buckets=quant_buckets)
+            use_loop = bool(loop_plan["eligible"])
         # async wave pipelining: active whenever there is deferred work to
         # overlap — the per-leaf histogram-state scatter (use_sub) and/or
         # the valid-row routing.  With neither, the sequential body IS the
         # pipelined one (nothing to defer), so the pending carry is
-        # skipped entirely and the paths are the same trace.
-        pipeline = async_wave_pipeline and (use_sub or bool(valids))
+        # skipped entirely and the paths are the same trace.  Loop mode
+        # runs serialized (nothing defers across a kernel launch — the
+        # in-loop rounds ARE the overlap); the pipelined staged path is
+        # observably identical to the serialized one (value-forwarded
+        # design, tests/test_wave_pipeline.py), so loop-vs-pipelined
+        # parity follows transitively and is pinned under both flags.
+        pipeline = (async_wave_pipeline and (use_sub or bool(valids))
+                    and not use_loop)
         root_sum = sums_fn(g3)
         mask0 = _node_feature_mask(key, 0, base_mask, feature_fraction_bynode)
         mask0 = mask0 & allowed_features(jnp.zeros(F, bool))
@@ -991,7 +1028,6 @@ def make_wave_grower(
             else:
                 leaf_hist_in = st.leaf_hist
                 vlids_in = st.valid_lids
-
             budget = L - st.num_leaves
             # routed fused rounds label the WHOLE round — the O(L) top-k
             # slot ranking, the in-kernel routing + histogram + scan and
@@ -1508,8 +1544,122 @@ def make_wave_grower(
                 pending=new_pending,
             )
 
+        R_loop = loop_plan["rounds"] if use_loop else 0
+
+        def body_loop(st: WaveState) -> WaveState:
+            # ---- persistent multi-round segment (ROADMAP item 1) ----
+            # ONE kernel launch runs R_loop consecutive rounds with the
+            # frontier table, histogram pool and row→leaf labels resident
+            # in VMEM (ops/wave_fused.make_fused_wave_loop); the staged
+            # bookkeeping below REPLAYS the rounds from the emitted
+            # per-round packed SplitInfo — the same store.write/
+            # route_rows code path as the single-round body, so trees,
+            # stores and valid routings are bit-identical.  Rounds past
+            # an exhausted frontier are bit-exact no-ops (every scatter
+            # drops, the leaf count stays put) both in-kernel and here.
+            rows_all = store.read(st.store,
+                                  jnp.arange(L, dtype=jnp.int32))
+            ft12 = jnp.concatenate([
+                store.gains(st.store)[:, None],
+                rows_all["feats"].astype(jnp.float32)[:, None],
+                rows_all["thrs"].astype(jnp.float32)[:, None],
+                rows_all["dls"].astype(jnp.float32)[:, None],
+                rows_all["lsums"], rows_all["rsums"],
+                rows_all["pout"][:, None],
+                rows_all["pdepth"].astype(jnp.float32)[:, None]], axis=1)
+            with jax.named_scope("lgbm.fused_loop"):
+                packed_R, leaf_id_new, pool_new = fused_loop_fn(
+                    binned, g3, st.leaf_id, ft12, st.num_leaves, key,
+                    K=K, slot_buckets=slot_buckets,
+                    quant_buckets=quant_buckets, max_depth=max_depth,
+                    base_mask=base_mask,
+                    pool=(st.leaf_hist if use_sub else None))
+            store_s = st.store
+            nl_s = st.num_leaves
+            vlids_s = st.valid_lids
+            done_s = st.done
+            for rr in range(R_loop):
+                vals, leafs = _topk_by_rank(store.gains(store_s), K)
+                budget = L - nl_s
+                valid = (vals > 0) & (kiota < budget)
+                n_split = valid.sum()
+                if _ROUND_PROBE is not None:   # bench round-schedule probe
+                    jax.debug.callback(_ROUND_PROBE, n_split)
+                order = jnp.cumsum(valid.astype(jnp.int32)) - 1
+                nodes = nl_s - 1 + order
+                nls = nl_s + order
+                rd = store.read(store_s, leafs)
+                feats, thrs, dls = rd["feats"], rd["thrs"], rd["dls"]
+                iscats, bitsets = rd["iscats"], rd["bitsets"]
+                lsums, rsums = rd["lsums"], rd["rsums"]
+                order_c = jnp.clip(order, 0, K - 1)
+                cleafs = jnp.stack([leafs, nls], axis=1).reshape(2 * K)
+                csums = jnp.stack([lsums, rsums],
+                                  axis=1).reshape(2 * K, 3)
+                pout = rd["pout"]
+                out_l = jax.vmap(clamp_out)(lsums, pconstr_const, pout)
+                out_r = jax.vmap(clamp_out)(rsums, pconstr_const, pout)
+                couts = jnp.stack([out_l, out_r], axis=1).reshape(2 * K)
+                d = rd["pdepth"] + 1
+                cdepth = jnp.stack([d, d], axis=1).reshape(2 * K)
+                depth_ok = (max_depth <= 0) | (cdepth < max_depth)
+                ch_idx = jnp.stack([2 * order_c, 2 * order_c + 1],
+                                   axis=1).reshape(2 * K)
+                res = _unpack_children(packed_R[rr][ch_idx], B)
+                cgain = jnp.where(depth_ok, res.gain, -jnp.inf)
+                cvalid = jnp.stack([valid, valid], axis=1).reshape(2 * K)
+                cidx = jnp.where(cvalid, cleafs, L + 1)
+                nidx = jnp.where(valid, nodes, L1 + 1)
+                lidx = jnp.where(valid, leafs, L + 1)
+                nlidx = jnp.where(valid, nls, L + 1)
+                p = rd["parent"]
+                was_left = rd["was_left"]
+                fix_l = jnp.where(valid & (p >= 0) & was_left,
+                                  jnp.maximum(p, 0), L1 + 1)
+                fix_r = jnp.where(valid & (p >= 0) & (~was_left),
+                                  jnp.maximum(p, 0), L1 + 1)
+                psum_k = lsums + rsums
+                store_s = store.write(store_s, dict(
+                    res=res, cgain=cgain, cidx=cidx, nidx=nidx,
+                    lidx=lidx, nlidx=nlidx, fix_l=fix_l, fix_r=fix_r,
+                    leafs=leafs, nls=nls,
+                    feats=feats, thrs=thrs, dls=dls,
+                    iscats=iscats, bitsets=bitsets,
+                    mtypes=meta.missing_type[feats],
+                    vals=vals, pout=pout, psum=psum_k,
+                    lsums=lsums, rsums=rsums, csums=csums,
+                    out_l=out_l, out_r=out_r, couts=couts,
+                    cdepth=cdepth, cconstr=cconstr_const,
+                    num_leaves_new=nl_s + n_split,
+                ))
+                if valids:
+                    # per-replayed-round valid routing over the rank
+                    # arrays (dead ranks carry leaf id L, matching no
+                    # row) — the same route_rows decision stage as
+                    # route_pending's fused leg, bit-identical to the
+                    # in-round slot routing
+                    vlids_s = tuple(fused_round_fn.route_rows(
+                        vb, vl, feats=feats, thrs=thrs, dls=dls,
+                        leafs=jnp.where(valid, leafs, L), nls=nls,
+                        num_leaves=L)
+                        for vb, vl in zip(valids, vlids_s))
+                done_s = done_s | (n_split == 0)
+                nl_s = nl_s + n_split
+
+            return WaveState(
+                leaf_id=leaf_id_new,
+                valid_lids=vlids_s,
+                leaf_hist=(pool_new if use_sub else st.leaf_hist),
+                store=store_s,
+                leaf_box=st.leaf_box,
+                leaf_used=st.leaf_used,
+                num_leaves=nl_s,
+                done=done_s,
+                pending=st.pending,
+            )
+
         if L > 1:
-            st = lax.while_loop(cond, body, st)
+            st = lax.while_loop(cond, body_loop if use_loop else body, st)
         tree = store.finalize(st.store, st.num_leaves)
         vlids_out = st.valid_lids
         if pipeline and valids:

@@ -34,6 +34,12 @@ Counts stay EXACT: the count/weight channel is quantized with a
 power-of-two scale (deterministic round-to-nearest, exact for unit
 weights), preserving the repo-wide "counts are exact" guarantee that
 min_data_in_leaf gating relies on (ops/histogram.py module docstring).
+
+ALL scales are powers of two — grad/hess too, snapped down from
+amax/127 (sr_prequantize_g3).  Exact dequantization multiplies make the
+parent-subtraction arithmetic rounding-order independent, which is what
+lets the persistent wave loop's in-kernel commit stay bit-identical to
+the host grower's subtraction (see the comment at the snap site).
 """
 
 from __future__ import annotations
@@ -75,17 +81,47 @@ def sr_quantize_g3(g3: jax.Array, label: jax.Array, nslots: int,
     incommensurable.  SR unbiasedness holds for any scale, so the global
     scale (>= each local amax) changes nothing statistically.
     """
+    del label  # per-pass scales; see module docstring
+    zg, qc, scales = sr_prequantize_g3(g3, nslots, axis_name=axis_name)
+    u = jax.random.uniform(key, zg.shape, dtype=jnp.float32)  # [0, 1)
+    q = jnp.clip(jnp.floor(zg + u), -INT8_QMAX, INT8_QMAX)
+    q3 = jnp.concatenate([q, qc[:, None]], axis=1)
+    return q3, scales
+
+
+def sr_prequantize_g3(g3: jax.Array, nslots: int, axis_name=None):
+    """The key-INDEPENDENT half of :func:`sr_quantize_g3`: scaled
+    grad/hess rows ``zg = g * inv`` (N, 2), the exactly-rounded count
+    channel ``qc`` (N,), and the (nslots, 3) dequantization scales.
+
+    Factored out so the persistent wave-loop kernel
+    (ops/wave_fused.make_fused_wave_loop) can host-precompute everything
+    but the per-round uniform draw — the rounding stream stays
+    ``clip(floor(zg + U), -127, 127)`` with U drawn per (iteration,
+    round) key inside the loop, reproducing sr_quantize_g3's exact
+    per-round bits.  The ops here are the literal ones sr_quantize_g3
+    ran inline before the factoring (bit-parity contract)."""
     from jax import lax as _lax
 
-    del label  # per-pass scales; see module docstring
     g = g3[:, :2].astype(jnp.float32)
     amax = jnp.max(jnp.abs(g), axis=0)                       # (2,)
     if axis_name is not None:
         amax = _lax.pmax(amax, axis_name)
-    inv = jnp.where(amax > 0, INT8_QMAX / amax, 0.0)
-    scale = jnp.where(amax > 0, amax / INT8_QMAX, 0.0)
-    u = jax.random.uniform(key, g.shape, dtype=jnp.float32)  # [0, 1)
-    q = jnp.clip(jnp.floor(g * inv[None, :] + u), -INT8_QMAX, INT8_QMAX)
+    # grad/hess scales snap DOWN to a power of two (inv = 2^floor(log2(
+    # 127/amax)), scale = 1/inv): a power-of-two dequantization multiply
+    # is EXACT in f32, so `parent - q*scale` rounds identically whether a
+    # compiler contracts the multiply into the subtraction (fma, one
+    # rounding) or not (two roundings).  The three places that compute
+    # subtracted children from the same quantized histogram — the host
+    # grower (XLA), the fused kernel's scan, and the persistent wave
+    # loop's commit (both Pallas) — sit in different fusion contexts, and
+    # their bit-parity contract must not hang on a contraction heuristic
+    # (optimization_barrier does not stop it).  Costs at most one bit of
+    # int8 range; SR unbiasedness holds for any scale (module docstring).
+    e2 = jnp.floor(jnp.log2(INT8_QMAX / amax))
+    inv = jnp.where(amax > 0, jnp.exp2(e2), 0.0)
+    scale = jnp.where(amax > 0, jnp.exp2(-e2), 0.0)
+    zg = g * inv[None, :]
 
     # count channel: power-of-two scale, deterministic rounding => exact
     # integer counts for unit weights (inv_c = 64, the historical
@@ -100,11 +136,10 @@ def sr_quantize_g3(g3: jax.Array, label: jax.Array, nslots: int,
         1.0)
     qc = jnp.round(c * inv_c)
 
-    q3 = jnp.concatenate([q, qc[:, None]], axis=1)
     scales = jnp.concatenate(
         [jnp.broadcast_to(scale[None, :], (nslots, 2)),
          jnp.full((nslots, 1), 1.0, jnp.float32) / inv_c], axis=1)
-    return q3, scales
+    return zg, qc, scales
 
 
 def dequantize_hist(hist_q: jax.Array, scales: jax.Array) -> jax.Array:

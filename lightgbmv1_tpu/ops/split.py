@@ -213,6 +213,21 @@ def smooth_output(raw_out, count, parent_output, p: SplitParams):
     return raw_out * w / (w + 1.0) + parent_output / (w + 1.0)
 
 
+def child_leaf_output(sums, constr, parent_out, p: SplitParams,
+                      use_mc: bool = False):
+    """One frontier child's (possibly smoothed / clamped) leaf output from
+    its (g, h, c) sums — the wave grower's per-round ``clamp_out`` math,
+    factored here so the grower bookkeeping and the persistent wave-loop
+    kernel (ops/wave_fused.make_fused_wave_loop) run the SAME op sequence;
+    the loop's bit-parity contract rides on sharing this code object."""
+    out = leaf_output(sums[0], sums[1], p)
+    if p.path_smooth > 0:
+        out = smooth_output(out, sums[2], parent_out, p)
+    if not use_mc:
+        return out
+    return jnp.clip(out, constr[0], constr[1])
+
+
 def monotone_penalty_factor(depth, penalization):
     """reference: ComputeMonotoneSplitGainPenalty,
     monotone_constraints.hpp:66-76."""

@@ -391,7 +391,13 @@ def comm_guard_ok(rs_hist_bytes: float, allreduce_hist_bytes: float,
 # device-link, order-of-magnitude constants: TPU-generation ICI links run
 # ~O(100 GB/s) while inter-host DCN NICs run ~O(10 GB/s) — the exact
 # ratio varies by platform; what the model needs is the ~10x gap that
-# makes the flat collective DCN-priced).
+# makes the flat collective DCN-priced).  These are only the DEFAULTS of
+# the validated config knobs ``hier_ici_gbps`` / ``hier_dcn_gbps``
+# (config.py) — the trainer threads the config values into
+# hier_comm_table_per_round, so a pod capture calibrates the modeled-ms
+# column from measured per-round ms without a code change.  The knobs
+# are observational: byte columns (and hence the hier_comm_ok guard,
+# which compares bytes, not ms) never depend on them.
 ICI_GBPS = 100.0
 DCN_GBPS = 10.0
 

@@ -46,8 +46,9 @@ def numpy_sr_quantize(g3, key, nslots):
                                       dtype=jnp.float32))
     g = g3[:, :2]
     amax = np.abs(g).max(axis=0)
-    inv = np.where(amax > 0, np.float32(INT8_QMAX) / amax, 0.0).astype(np.float32)
-    scale = np.where(amax > 0, amax / np.float32(INT8_QMAX), 0.0).astype(np.float32)
+    e2 = np.floor(np.log2(np.float32(INT8_QMAX) / amax)).astype(np.float32)
+    inv = np.where(amax > 0, np.exp2(e2), 0.0).astype(np.float32)
+    scale = np.where(amax > 0, np.exp2(-e2), 0.0).astype(np.float32)
     q = np.clip(np.floor(g * inv[None, :] + u), -INT8_QMAX, INT8_QMAX)
     c = g3[:, 2]
     cmax = np.abs(c).max()
@@ -175,8 +176,8 @@ def test_sr_beats_round_to_nearest_bias():
     the fraction from every row (bias grows linearly in N); SR keeps the
     sum unbiased."""
     n = 4096
-    g = np.full(n, 0.30, np.float32)      # scaled value 0.30*127/0.9...
-    g[0] = 0.9                            # sets amax -> step 0.9/127
+    g = np.full(n, 0.30, np.float32)      # scaled value 0.30*128 = 38.4
+    g[0] = 0.9                            # sets amax -> pow2 step 1/128
     g3 = jnp.asarray(np.stack([g, g, np.ones_like(g)], axis=1))
     label = jnp.zeros(n, jnp.int32)
     target = float(g3[:, 0].sum())
@@ -185,8 +186,9 @@ def test_sr_beats_round_to_nearest_bias():
         q3, sc = sr_quantize_g3(g3, label, 1, jax.random.PRNGKey(seed))
         return float(jnp.sum(q3[:, 0]) * sc[0, 0]) - target
 
-    # round-to-nearest of the same scaled values
-    scale = 0.9 / 127.0
+    # round-to-nearest of the same scaled values (scale = the power-of-two
+    # snap of 0.9/127: 2^-floor(log2(127/0.9)) = 2^-7)
+    scale = 1.0 / 128.0
     rtn = float(np.round(g / scale).sum() * scale) - target
     sr_mean = np.mean([sr_err(s) for s in range(50)])
     assert abs(sr_mean) < abs(rtn) / 5, (sr_mean, rtn)

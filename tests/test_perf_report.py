@@ -6,6 +6,8 @@ import os
 import re
 import sys
 
+import pytest
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, os.path.join(REPO, "tools"))
 
@@ -290,6 +292,13 @@ def test_fused_section_renders_fused_fields():
         "fused_hbm_stack_bytes_analytic": 170_698_752,
         "staged_round_binned_bytes_analytic": 346_500_000,
         "fused_round_binned_bytes_analytic": 299_000_000,
+        "fused_loop_parity_ok": True, "fused_loop_ok": True,
+        "fused_loop_rounds": 4,
+        "fused_loop_launches_saved_per_segment": 3,
+        "fused_loop_state_bytes_saved_per_segment_analytic": 9_437_184,
+        "wave_loop_ms_per_iter": 39.5,
+        "wave_loop_single_round_ms_per_iter": 43.75,
+        "wave_loop_boundary_saving_ms_per_iter": 4.25,
     }
     txt = perf_report.generate(rec, "BENCH_rTEST.json")
     for needle in ("## Fused wave round", "41.25", "fused_ok=True",
@@ -298,7 +307,13 @@ def test_fused_section_renders_fused_fields():
                    # ISSUE 15: the routed single-pass round renders its
                    # merged column + the bytes contract + the guard
                    "43.75", "round fused", "fused_round_ok=True",
-                   "2.778", "299000000", "read once per round"):
+                   "2.778", "299000000", "read once per round",
+                   # ISSUE 17: the persistent wave loop renders parity,
+                   # the looped-vs-single ms pair, the per-segment launch
+                   # and state savings, and its guard
+                   "wave_loop_rounds=4", "fused_loop_parity_ok=True",
+                   "39.5", "3 launches", "9437184",
+                   "4.25 ms/iter", "fused_loop_ok=True"):
         assert needle in txt, needle
     # absent fields: no fused section, legacy phase-table header — the
     # on-disk PERF.md (generated from an r05-era record) stays stable
@@ -562,6 +577,20 @@ def test_hier_comm_table_bytes_pinned():
     assert not hier_comm_ok(t["flat_hist_wire_bytes"],
                             t["flat_hist_wire_bytes"], H)
     assert hier_comm_ok(10**9, 1, 1)
+    # the config-lifted bandwidth knobs (hier_ici_gbps / hier_dcn_gbps,
+    # ISSUE 17): modeled ms scales inversely, byte columns — and hence
+    # the guard — are knob-invariant
+    t2 = hier_comm_table_per_round("data", k=K, F=F, B=B, ndev=D,
+                                   num_hosts=H, ici_gbps=200.0,
+                                   dcn_gbps=20.0)
+    assert t2["ici"] == t["ici"] and t2["dcn"] == t["dcn"]
+    assert t2["flat_hist_wire_bytes"] == t["flat_hist_wire_bytes"]
+    assert t2["hier_ms"] == pytest.approx(t["hier_ms"] / 2)
+    assert t2["flat_ms"] == pytest.approx(t["flat_ms"] / 2)
+    from lightgbmv1_tpu.config import Config
+    with pytest.raises(Exception, match="hier_ici_gbps"):
+        Config.from_dict({"objective": "binary", "verbosity": -1,
+                          "hier_dcn_gbps": 0.0})
     # voting: the top-2k election payload is priced at BOTH levels and
     # the vote bound catches a selective reduce that silently widened
     v = hier_comm_table_per_round("voting", k=K, F=F, B=B, ndev=D,

@@ -439,6 +439,209 @@ def test_fused_int8sr_disabled_by_gpu_use_dp(monkeypatch):
 
 
 # ---------------------------------------------------------------------------
+# Persistent multi-round wave loop (ROADMAP item 1): R rounds per launch,
+# frontier state resident in VMEM (ops/wave_fused.make_fused_wave_loop)
+# ---------------------------------------------------------------------------
+
+
+_LOOP_ENGAGED = "persistent multi-round wave loop engaged"
+
+
+def _loop_problem():
+    # smaller than _binary_problem: the loop tests train staged + fused
+    # and tier-1 carries several of them
+    return _binary_problem(n=700, f=6, seed=11)
+
+
+def test_wave_loop_parity_r2():
+    # the loop's whole contract: R in-VMEM rounds == R staged rounds,
+    # bit-for-bit, trees byte-compared via model text
+    _parity({"wave_loop_rounds": 2}, problem=_loop_problem(), iters=2)
+
+
+def test_wave_loop_parity_r4_and_engagement_log():
+    lines = _warnings(lambda: _parity(
+        {"wave_loop_rounds": 4, "verbosity": 1},
+        problem=_loop_problem(), iters=2))
+    assert any(_LOOP_ENGAGED in ln for ln in lines), lines
+
+
+def test_wave_loop_planner_gates():
+    """plan_wave_loop is the loop's whole eligibility story — every
+    fallback leg returns its taxonomy reason (recorded verbatim in the
+    BENCH record), and rounds==1 NEVER builds a loop."""
+    from lightgbmv1_tpu.ops import wave_fused as wf
+
+    base = dict(N=4096, F=8, num_bins=32, K=32, L=64, use_sub=True,
+                slot_buckets=(4, 16, 32), quant_buckets=())
+    plan = wf.plan_wave_loop(rounds=6, **base)
+    assert plan["eligible"] and plan["rounds"] == 6, plan
+    assert plan["total_bytes"] <= plan["vmem_budget"]
+    assert wf.plan_wave_loop(rounds=1, **base)["reason"] \
+        == "wave_loop_rounds=1 (single-round dispatch)"
+    assert wf.plan_wave_loop(
+        rounds=10_000, **base)["rounds"] == wf._LOOP_MAX_ROUNDS
+    assert "MAX_LANES" in wf.plan_wave_loop(
+        rounds=6, **{**base, "F": 128})["reason"]
+    assert "monotone" in wf.plan_wave_loop(
+        rounds=6, use_mc=True, **base)["reason"]
+    assert "int8sr-in-loop" in wf.plan_wave_loop(
+        rounds=6, precision="bf16x2",
+        **{**base, "quant_buckets": (16, 32)})["reason"]
+    assert "deep-precision" in wf.plan_wave_loop(
+        rounds=6, deep_precision="bf16", **base)["reason"]
+    assert "VMEM budget" in wf.plan_wave_loop(
+        rounds=6, vmem_budget=1 << 10, **base)["reason"]
+
+
+def test_wave_loop_backend_probe_cpu():
+    # CPU is the bit-parity lane: the Mosaic probe always passes there
+    # (interpret mode), and its verdict is cached per backend
+    from lightgbmv1_tpu.ops import wave_fused as wf
+
+    assert wf.backend_lowers_fused_loop()
+    assert wf.backend_lowers_fused_loop()   # cached second hit
+
+
+def test_wave_loop_ffbynode_falls_back_with_reason():
+    # per-node column sampling draws a fresh mask every round — the loop
+    # kernel freezes round-0 state, so the trainer must refuse the loop
+    # (logged reason) and run the single-round fused dispatch: parity
+    # with the staged path is the fallback working
+    lines = _warnings(lambda: _parity(
+        {"wave_loop_rounds": 2, "feature_fraction_bynode": 0.8,
+         "feature_fraction_seed": 7, "verbosity": 0},
+        problem=_loop_problem(), iters=2))
+    assert any("feature_fraction_bynode" in ln and "single-round" in ln
+               for ln in lines), lines
+
+
+@pytest.mark.slow    # tier-1 budget (ISSUE 13 discipline): the full suite,
+                     # bench measure_fused_waveloop (fused_loop_ok) and
+                     # every dryrun_multichip capture still run this
+def test_wave_loop_parity_multiclass():
+    rng = np.random.RandomState(3)
+    n, f, k = 1200, 6, 3
+    X = rng.randn(n, f)
+    y = np.clip((np.abs(X[:, 0]) + X[:, 1] > 1).astype(np.float64)
+                + (X[:, 2] > 0.3).astype(np.float64), 0, k - 1)
+
+    def text(over):
+        cfg = Config.from_dict({
+            "objective": "multiclass", "num_class": k, "num_leaves": 15,
+            "min_data_in_leaf": 5, "verbosity": -1,
+            "tree_growth": "leafwise", "leafwise_wave_size": 4,
+            "metric": "multi_logloss", **over})
+        ds = BinnedDataset.from_numpy(X, label=y, config=cfg)
+        gb = create_boosting(cfg, ds)
+        for _ in range(2):
+            gb.train_one_iter(check_stop=False)
+        return model_to_string(
+            gb.materialize_host_trees(),
+            objective_string=_objective_string(cfg), num_class=k,
+            num_tree_per_iteration=k,
+            feature_names=list(ds.feature_names),
+            feature_infos=ds.feature_infos())
+
+    assert text({"hist_method": "pallas"}) \
+        == text({"hist_method": "fused", "wave_loop_rounds": 3})
+
+
+@pytest.mark.slow    # tier-1 budget (ISSUE 13 discipline): the full suite,
+                     # bench measure_fused_waveloop (fused_loop_ok) and
+                     # every dryrun_multichip capture still run this
+def test_wave_loop_parity_dart():
+    # DART re-weights trees BETWEEN iterations — per-iteration g3 feeds
+    # the loop unchanged, so R-round launches must not perturb it
+    _parity({"boosting": "dart", "drop_rate": 0.3, "drop_seed": 5,
+             "wave_loop_rounds": 2}, iters=4)
+
+
+@pytest.mark.slow    # tier-1 budget (ISSUE 13 discipline): the full suite,
+                     # bench measure_fused_waveloop (fused_loop_ok) and
+                     # every dryrun_multichip capture still run this
+def test_wave_loop_parity_serialized_body():
+    # async_wave_pipeline=false is also the schedule loop mode itself
+    # runs under (nothing defers across a launch) — the flag must stay
+    # a no-op for trees either way
+    _parity({"async_wave_pipeline": False, "wave_loop_rounds": 2},
+            iters=2)
+
+
+@pytest.mark.slow    # tier-1 budget (ISSUE 13 discipline): the full suite,
+                     # bench measure_fused_waveloop (fused_loop_ok) and
+                     # every dryrun_multichip capture still run this
+def test_wave_loop_int8sr_parity_and_reproducible(monkeypatch):
+    """The quantized lane THROUGH the loop: int8sr rounds draw the same
+    fold_in(key, 8_000_011 + num_leaves) stream in-kernel, accumulate
+    exact integers through the f32 path, and dequantize with the staged
+    subtraction's exact op shape — trees bit-equal to staged int8sr and
+    bit-reproducible run-to-run.  hist_dtype=f32 is the planner's
+    int8sr-in-loop requirement; the engagement line proves the matrix
+    point is not vacuously running single-round."""
+    monkeypatch.setattr(gw, "_BUCKET_MIN_N", 1)
+    X, y = _binary_problem(n=800, f=6, seed=0)
+    over = {"num_leaves": 48, "leafwise_wave_size": 32, "max_bin": 31,
+            "hist_dtype": "f32", "hist_dtype_deep": "int8sr",
+            "wave_loop_rounds": 2, "verbosity": 1}
+    lines = _warnings(lambda: _parity(over, problem=(X, y), iters=2))
+    assert any(_LOOP_ENGAGED in ln for ln in lines), lines
+    t1 = _train_text({**over, "hist_method": "fused"}, X, y, iters=2)
+    t2 = _train_text({**over, "hist_method": "fused"}, X, y, iters=2)
+    assert t1 == t2, "int8sr loop trees not bit-reproducible"
+
+
+@pytest.mark.slow    # tier-1 budget (ISSUE 13 discipline): the full suite,
+                     # bench measure_fused_waveloop (fused_loop_ok) and
+                     # every dryrun_multichip capture still run this
+def test_wave_loop_int8sr_default_dtype_falls_back(monkeypatch):
+    # int8sr under the DEFAULT bf16x2 base dtype: exact-integer f32
+    # accumulate unavailable -> the planner refuses the loop with its
+    # taxonomy reason and the single-round dispatch keeps parity
+    monkeypatch.setattr(gw, "_BUCKET_MIN_N", 1)
+    X, y = _binary_problem(n=800, f=6, seed=0)
+    lines = _warnings(lambda: _parity(
+        {"num_leaves": 48, "leafwise_wave_size": 32, "max_bin": 31,
+         "hist_dtype_deep": "int8sr", "wave_loop_rounds": 2,
+         "verbosity": 0}, problem=(X, y), iters=2))
+    assert any("int8sr-in-loop" in ln for ln in lines), lines
+
+
+@pytest.mark.slow    # tier-1 budget (ISSUE 13 discipline): the full suite,
+                     # bench measure_fused_waveloop (fused_loop_ok) and
+                     # every dryrun_multichip capture still run this
+def test_wave_loop_categorical_nan_never_engages():
+    # categorical datasets never reach the loop (the fused gate falls
+    # back BEFORE it) — parity holds through the staged path and no
+    # engagement line may appear
+    rng = np.random.RandomState(4)
+    n = 900
+    Xc = rng.randn(n, 4)
+    Xc[:, 0] = rng.randint(0, 8, n)
+    Xc[rng.rand(n, 4) < 0.05] = np.nan
+    Xc[:, 0] = np.abs(np.nan_to_num(Xc[:, 0]))
+    y = ((Xc[:, 0] % 3 == 1).astype(np.float64)
+         + (Xc[:, 1] > 0)).clip(0, 1)
+    lines = _warnings(lambda: _parity(
+        {"wave_loop_rounds": 2, "verbosity": 1}, problem=(Xc, y),
+        iters=2, categorical_features=[0]))
+    assert any("categorical" in ln for ln in lines), lines
+    assert not any(_LOOP_ENGAGED in ln for ln in lines), lines
+
+
+@pytest.mark.slow    # tier-1 budget (ISSUE 13 discipline): the full suite,
+                     # bench measure_fused_waveloop (fused_loop_ok) and
+                     # every dryrun_multichip capture still run this
+def test_wave_loop_monotone_falls_back_with_reason():
+    lines = _warnings(lambda: _parity(
+        {"wave_loop_rounds": 2, "verbosity": 0,
+         "monotone_constraints": [1, -1, 0, 0, 0, 0]},
+        problem=_loop_problem(), iters=2))
+    assert any("monotone" in ln and "single-round" in ln
+               for ln in lines), lines
+
+
+# ---------------------------------------------------------------------------
 # Kernel-level unit parity (no grower in the loop)
 # ---------------------------------------------------------------------------
 

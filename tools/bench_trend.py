@@ -100,6 +100,13 @@ WATCHED: Tuple[Tuple[str, str, float], ...] = (
     # same 10% clock bar; fused_round_ok is the boolean guard the sweep
     # flags automatically
     ("partition_fused_ms_per_iter", "down", 0.10),
+    # persistent multi-round wave loop (ISSUE 17): the looped dispatch
+    # priced by the differential method (single-round dispatch ms minus
+    # the measured boundary saving) at the standard 10% bar — a
+    # regression here means the loop stopped paying for its resident
+    # state; fused_loop_ok / fused_loop_parity_ok are booleans the
+    # guard sweep flags automatically
+    ("phase_wave_loop_ms", "down", 0.10),
     # model-quality & drift (ISSUE 14): the skew-injection probe's
     # detection magnitude is deterministic (same shift, same shape) —
     # a capture where the injected PSI collapses means the detector

@@ -61,11 +61,15 @@ import tier1_budget  # noqa: E402
 # hier_comm_ok is the pod-scale two-level collective guard (ISSUE 16:
 # DCN histogram bytes <= flat reduce-scatter wire / num_hosts, and the
 # voting learner's DCN payload <= its top-2k analytic bound —
-# parallel/cluster.py hier_comm_table_per_round)
+# parallel/cluster.py hier_comm_table_per_round); fused_loop_ok is the
+# persistent multi-round wave-loop guard (ISSUE 17: wave_loop_rounds>1
+# model-text parity with the single-round fused path everywhere AND, on
+# device, the looped per-iteration wall at or under the single-round
+# wall it replaces — bench.py measure_fused_waveloop)
 REQUIRED_GUARDS = ("obs_ok", "slo_ok", "forensics_ok", "chaos_ok",
                    "fleet_ok", "chaos_fleet_ok", "obs_device_ok",
                    "fused_ok", "drift_ok", "fused_round_ok",
-                   "hier_comm_ok")
+                   "hier_comm_ok", "fused_loop_ok")
 
 
 def check_required_guards(records_dir: str, guards, out=print) -> bool:

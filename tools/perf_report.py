@@ -237,6 +237,21 @@ def fused_section(w, rec):
           f"{get(rec, 'phase_hist_ms')} + {get(rec, 'phase_split_ms')} "
           f"+ {get(rec, 'phase_partition_ms')} ms/iter.")
         w("")
+    if rec.get("fused_loop_parity_ok") is not None:
+        w(f"Persistent multi-round wave loop (ISSUE 17 — "
+          f"`wave_loop_rounds={get(rec, 'fused_loop_rounds')}`, frontier "
+          f"state resident in VMEM across rounds): parity "
+          f"`fused_loop_parity_ok={rec.get('fused_loop_parity_ok')}`; "
+          f"{get(rec, 'wave_loop_ms_per_iter')} ms/iter looped vs "
+          f"{get(rec, 'wave_loop_single_round_ms_per_iter')} single-round "
+          f"({get(rec, 'fused_loop_launches_saved_per_segment')} launches "
+          f"and {get(rec, 'fused_loop_state_bytes_saved_per_segment_analytic')} "
+          f"state bytes saved per segment, analytic"
+          + (f"; measured boundary saving "
+             f"{get(rec, 'wave_loop_boundary_saving_ms_per_iter')} ms/iter"
+             if rec.get("wave_loop_boundary_saving_ms_per_iter")
+             is not None else "") + ").")
+        w("")
     if rec.get("fused_hbm_bytes_saved_per_round") is not None:
         w(f"Compiled-executable HBM accounting (cost_analysis bytes, one "
           f"sustained-bucket round incl. the staged partition pass): "
@@ -256,10 +271,14 @@ def fused_section(w, rec):
       f"`fused_round_ok={rec.get('fused_round_ok')}` (ISSUE 15): routed "
       "parity AND the binned-read-once bytes contract (>= 1.8x "
       "cost_analysis reduction vs staged partition+hist on device).  "
+      f"Guard `fused_loop_ok={rec.get('fused_loop_ok')}` (ISSUE 17): "
+      "loop-vs-single-round parity AND (on device) a non-negative "
+      "boundary saving.  "
       "The staged path stays the default until a device capture lands "
       "these guards True "
-      "(BASELINE.md \"Fused wave round\" — dispatch rules, fallback "
-      "taxonomy, parity contract).")
+      "(BASELINE.md \"Fused wave round\" / \"Persistent multi-round "
+      "wave loop\" — dispatch rules, fallback taxonomy, parity "
+      "contract).")
     w("")
 
 

@@ -527,6 +527,11 @@ PHASE_MS_KEYS = (
     # mutually exclusive with the staged hist/partition/valid_route/
     # split rows for the run that produced it
     "phase_round_fused_ms",
+    # wave_loop_rounds>1 (ISSUE 17, the persistent multi-round wave
+    # loop): R consecutive rounds — frontier state resident in VMEM —
+    # are ONE labeled dispatch; mutually exclusive with BOTH the staged
+    # rows and the single-round fused row for the run that produced it
+    "phase_wave_loop_ms",
     "phase_other_ms",
 )
 
