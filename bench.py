@@ -642,6 +642,120 @@ def measure_fused_waveloop(ds, N, backend, n_iters):
     return fields
 
 
+def measure_packed(X, y, backend, n_iters):
+    """``bin_layout=packed4`` A/B (ISSUE 18 — sub-byte bin residency),
+    every backend, at its own ``max_bin=15`` config (the nibble regime):
+
+    * **parity** — trees of the packed fused run must byte-compare to
+      the unpacked fused AND staged runs' model text: the kernels
+      unpack nibbles in VMEM onto the identical arithmetic, so packing
+      is a pure storage-layout change (the lane
+      tests/test_wave_fused.py pins across the golden matrix).
+    * **analytic bytes** — the per-round binned HBM read halves:
+      ``ceil(F/2) * N`` packed bytes vs ``F * N`` unpacked
+      (``packed_binned_bytes``, watched by bench_trend on device
+      captures); the acceptance bar is a >= 1.9x reduction.
+    * **measured bytes** — the compiled histogram executables' own
+      ``cost_analysis()`` bytes, packed vs unpacked input, recorded
+      beside the analytic figure (CPU interpret-mode accounting is
+      unrepresentative — ``packed_bytes_interpret_mode`` — like the
+      fused round's byte leg).
+
+    ``packed_ok`` is joined in main(): parity AND the analytic >= 1.9x
+    reduction AND, on device, a measured hist-bytes reduction >= 1.5x.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from lightgbmv1_tpu.basic import _objective_string
+    from lightgbmv1_tpu.config import Config
+    from lightgbmv1_tpu.io.dataset import BinnedDataset
+    from lightgbmv1_tpu.io.model_text import model_to_string
+    from lightgbmv1_tpu.models.gbdt import create_boosting
+    from lightgbmv1_tpu.obs.xla import _extract_cost
+    from lightgbmv1_tpu.ops.hist_pallas import hist_leaves_pallas, pack4bit
+
+    fields = {}
+    interp = backend == "cpu"
+    N = int(X.shape[0])
+    base = {
+        "objective": "binary", "num_leaves": 63, "max_bin": 15,
+        "learning_rate": 0.1, "min_data_in_leaf": 20, "verbosity": -1,
+        "tree_growth": "leafwise",
+    }
+
+    def run(over):
+        cfg = Config.from_dict({**base, **over})
+        ds = BinnedDataset.from_numpy(X, label=y, config=cfg)
+        gb = create_boosting(cfg, ds)
+        gb.train_iters(n_iters)
+        jax.device_get(gb._train_scores.score)
+        dt = 1e30
+        for _ in range(2):
+            t0 = time.time()
+            gb.train_iters(n_iters)
+            jax.device_get(gb._train_scores.score)
+            dt = min(dt, time.time() - t0)
+        text = model_to_string(
+            gb.materialize_host_trees(),
+            objective_string=_objective_string(cfg), num_class=1,
+            num_tree_per_iteration=1,
+            feature_names=list(ds.feature_names),
+            feature_infos=ds.feature_infos())
+        return ds, dt, text
+
+    ds_u, _, st_text = run({"hist_method": "pallas"})
+    _, u8_dt, u8_text = run({"hist_method": "fused"})
+    _, pk_dt, pk_text = run({"hist_method": "fused",
+                             "bin_layout": "packed4"})
+    _, _, sp_text = run({"hist_method": "pallas",
+                         "bin_layout": "packed4"})
+    fields["packed_parity_ok"] = bool(
+        pk_text == u8_text == st_text == sp_text)
+    fields["packed_M_row_trees_per_s"] = round(N * n_iters / pk_dt / 1e6,
+                                               3)
+    fields["packed_u8_M_row_trees_per_s"] = round(
+        N * n_iters / u8_dt / 1e6, 3)
+
+    # analytic per-round binned read (uint8 bytes): the halving contract
+    F = int(ds_u.num_features)
+    Fp = -(-F // 2)
+    fields["packed_binned_bytes"] = int(Fp * N)
+    fields["unpacked_binned_bytes"] = int(F * N)
+    fields["packed_binned_bytes_reduction"] = round(F / Fp, 3)
+
+    # measured executable bytes: the staged histogram pass, packed vs
+    # unpacked input, priced by the compiled executables themselves
+    try:
+        binned = jnp.asarray(ds_u.train_matrix)
+        pb = jnp.asarray(pack4bit(np.asarray(ds_u.train_matrix)))
+        rng = np.random.RandomState(13)
+        g3 = jnp.asarray(rng.randn(N, 3).astype(np.float32))
+        lids = jnp.asarray(rng.randint(0, 16, N).astype(np.int32))
+        u8_c = jax.jit(lambda b, g, l: hist_leaves_pallas(
+            b, g, l, 16, 16, precision="bf16x2",
+            interpret=interp)).lower(binned, g3, lids).compile()
+        pk_c = jax.jit(lambda b, g, l: hist_leaves_pallas(
+            b, g, l, 16, 16, precision="bf16x2", interpret=interp,
+            packed=True, num_features=F)).lower(pb, g3, lids).compile()
+        _, ub = _extract_cost(u8_c)
+        _, pbb = _extract_cost(pk_c)
+        if ub and pbb:
+            fields["packed_hist_bytes_accessed"] = int(pbb)
+            fields["unpacked_hist_bytes_accessed"] = int(ub)
+            fields["packed_hist_bytes_reduction"] = round(
+                ub / max(pbb, 1), 3)
+            # CPU smoke caveat: interpret mode lowers to plain XLA ops
+            # with per-grid-step block copies — the byte comparison does
+            # NOT reflect device behavior; the honest number is the
+            # device capture's
+            if interp:
+                fields["packed_bytes_interpret_mode"] = True
+    except Exception as e:  # noqa: BLE001 — the parity legs stand alone
+        fields["packed_bytes_error"] = f"{type(e).__name__}: {e}"[:200]
+    return fields
+
+
 def _fused_round_bytes(ds, N, backend, gb_fu):
     """Compiled-executable byte accounting of ONE sustained wave round,
     BOTH legs starting from the same (leaf ids + committed splits)
@@ -2187,6 +2301,16 @@ def main():
         extra["fused_loop_error"] = f"{type(e).__name__}: {e}"[:200]
         extra["fused_loop_parity_ok"] = False
 
+    # ---- 4-bit packed bins A/B (bin_layout=packed4, ISSUE 18): layout
+    # parity at max_bin=15 + the binned-bytes halving, analytic and
+    # measured; the packed_ok join lives below with the other guards.
+    try:
+        extra.update(measure_packed(X, y, backend,
+                                    n_iters=min(lw_trees, 3)))
+    except Exception as e:  # noqa: BLE001 — partial records beat none
+        extra["packed_error"] = f"{type(e).__name__}: {e}"[:200]
+        extra["packed_parity_ok"] = False
+
     if backend != "cpu" and os.environ.get("BENCH_FULL", "1") == "1":
         schedule = None
         try:
@@ -2527,6 +2651,20 @@ def main():
     pfm = extra.get("partition_fused_ms_per_iter")
     if pfm is not None and lp_save is not None:
         extra["phase_wave_loop_ms"] = round(max(pfm - lp_save, 0.0), 3)
+
+    # ---- packed_ok (ISSUE 18): 4-bit packed bins — four-way layout
+    # parity (packed/unpacked x fused/staged, model text byte-compared)
+    # AND the analytic >= 1.9x binned-read reduction AND, on device, the
+    # compiled hist executables showing >= 1.5x fewer bytes on packed
+    # input (the CPU interpreter's block-copy accounting is
+    # unrepresentative — packed_bytes_interpret_mode — so the CPU record
+    # carries the parity + analytic legs only, like fused_round_ok).
+    pk_red = extra.get("packed_hist_bytes_reduction")
+    extra["packed_ok"] = bool(
+        extra.get("packed_parity_ok")
+        and (extra.get("packed_binned_bytes_reduction") or 0) >= 1.9
+        and (backend == "cpu"
+             or (pk_red is not None and pk_red >= 1.5)))
 
     # Online-serving loadgen block (serve/ subsystem): runs on every
     # backend — the acceptance record for hot-swap-under-traffic and

@@ -315,9 +315,11 @@ class Dataset:
         from .data.block_cache import write_block_cache
 
         self.construct()
+        cfg = Config.from_dict(self.params)
         if block_rows is None:
-            block_rows = Config.from_dict(self.params).stream_block_rows
-        write_block_cache(self._binned, str(path), block_rows=block_rows)
+            block_rows = cfg.stream_block_rows
+        write_block_cache(self._binned, str(path), block_rows=block_rows,
+                          bin_layout=cfg.bin_layout)
         return self
 
     # ------------------------------------------------------------------

@@ -329,6 +329,19 @@ class Config:
     # non-wave growth, Mosaic lowering failure) fall back to the staged
     # path with a logged reason (the fallback taxonomy, BASELINE.md).
     hist_method: str = "auto"  # auto | bench | scatter | onehot | pallas | fused
+    # device bin-matrix layout (the reference's DenseBin<VAL_T, IS_4BIT>
+    # choice, bin.h): "packed4" stores two 4-bit bins per byte —
+    # (ceil(F/2), N) instead of (F, N) — so the per-round HBM binned
+    # read, the streaming block cache's disk/H2D bytes, and the kernels'
+    # VMEM row-tile footprint all halve; the hist/fused kernels unpack
+    # nibbles in VMEM (ops/hist_pallas.pack4bit layout: lo nibble =
+    # feature 2p, hi = 2p+1).  Needs num_total_bin <= 16 (max_bin <= 15
+    # plus the missing bin), uint8 bins, no EFB bundling, a pallas-family
+    # hist method, and not gpu_use_dp / feature-parallel.  "auto" packs
+    # exactly when eligible (silent); an explicit "packed4" on an
+    # ineligible config falls back to "u8" with the staged warning.
+    # Trees are bit-identical across layouts (tests/test_wave_fused.py).
+    bin_layout: str = "auto"   # auto | u8 | packed4
     hist_dtype: str = "bf16x2"     # bf16 | bf16x2 | f32 | int8 (quantized) precision
     # histogram precision for the wave grower's SUSTAINED rounds (the
     # largest slot bucket of a big wave — deep-frontier rounds whose
@@ -754,6 +767,10 @@ class Config:
             raise ValueError(
                 f"hist_method={self.hist_method!r}: expected auto | bench "
                 "| scatter | onehot | pallas | fused")
+        if self.bin_layout not in ("auto", "u8", "packed4"):
+            raise ValueError(
+                f"bin_layout={self.bin_layout!r}: expected auto | u8 "
+                "| packed4")
         if self.data_parallel_collective not in (
                 "reduce_scatter", "allreduce", "hierarchical"):
             raise ValueError(

@@ -15,12 +15,14 @@ dataset (ROADMAP item 2 — training at dataset scales beyond HBM).
 """
 
 from .block_cache import (BLOCK_CACHE_MAGIC, BlockCacheError, is_block_cache,
-                          load_manifest, write_block_cache)
+                          load_manifest, manifest_bin_layout,
+                          write_block_cache)
 from .streaming import (DeviceLedger, InMemoryBlockSource, StreamingDataset,
                         block_source_for)
 
 __all__ = [
     "BLOCK_CACHE_MAGIC", "BlockCacheError", "is_block_cache",
-    "load_manifest", "write_block_cache", "StreamingDataset",
-    "InMemoryBlockSource", "DeviceLedger", "block_source_for",
+    "load_manifest", "manifest_bin_layout", "write_block_cache",
+    "StreamingDataset", "InMemoryBlockSource", "DeviceLedger",
+    "block_source_for",
 ]

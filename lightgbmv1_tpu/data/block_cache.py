@@ -31,10 +31,17 @@ from typing import Dict, List, Optional
 import numpy as np
 
 from ..utils.fileio import atomic_write_bytes, exists, open_file
-from ..utils.log import log_info
+from ..utils.log import log_info, log_warning
 
 BLOCK_CACHE_MAGIC = "lightgbmv1_tpu.block_cache"
-BLOCK_CACHE_VERSION = 1
+# format history: v1/v2 — unpacked (F, rows) uint8/uint16 block shards
+# (legacy; load unchanged, bin_layout implicitly "u8"); v3 (ISSUE 18) —
+# the manifest carries ``bin_layout`` and ``packed4`` shards store the
+# 4-bit (ceil(F/2), rows) layout (ops/hist_pallas.pack4bit), halving
+# disk and H2D bytes for max_bin <= 15 datasets.  Digests always cover
+# the STORED bytes, so corruption detection is layout-independent.
+BLOCK_CACHE_VERSION = 3
+BLOCK_CACHE_LEGACY_VERSIONS = (1, 2)
 MANIFEST_NAME = "manifest.json"
 META_NAME = "meta.npz"
 
@@ -92,14 +99,35 @@ def _mapper_arrays(ds) -> Dict[str, np.ndarray]:
     )
 
 
-def write_block_cache(ds, path: str, block_rows: int = 65536) -> dict:
+def packed4_eligible(ds) -> str:
+    """Why ``ds`` cannot store ``packed4`` shards — ``""`` when it can.
+    The storage-side gate: every feature must fit a nibble
+    (``num_total_bin <= 16``) and bins must be uint8."""
+    if np.dtype(ds.binned.dtype).itemsize > 1:
+        return "int16-binned data exceeds the 4-bit nibble"
+    if int(getattr(ds, "num_total_bin", 256)) > 16:
+        return (f"num_total_bin={ds.num_total_bin} needs more than 4 "
+                "bits per bin")
+    return ""
+
+
+def write_block_cache(ds, path: str, block_rows: int = 65536,
+                      bin_layout: str = "auto") -> dict:
     """Write ``ds`` (a dense-binned BinnedDataset) as a sharded block
     cache at directory ``path``; returns the manifest dict.
 
     The binned matrix must be the plain dense (F, N) representation: EFB
     bundle-only (sparse-path) datasets are refused — the streaming trainer
     speaks original features (bundling trades HBM for compute the
-    streaming path already bounds)."""
+    streaming path already bounds).
+
+    ``bin_layout``: ``"packed4"`` stores 4-bit packed shards —
+    ``(ceil(F/2), rows)`` bytes per block (ops/hist_pallas.pack4bit), so
+    disk and the streaming trainer's H2D transfers halve; requires
+    ``num_total_bin <= 16`` (raises ``BlockCacheError`` otherwise — the
+    storage API fails loudly; config-driven refusal-with-warning lives in
+    parallel/trainer.select_bin_layout).  ``"auto"`` packs exactly when
+    eligible; ``"u8"`` always stores unpacked bytes."""
     if ds.binned is None:
         raise BlockCacheError(
             "write_block_cache requires a dense-binned dataset (EFB "
@@ -107,6 +135,15 @@ def write_block_cache(ds, path: str, block_rows: int = 65536) -> dict:
             "data or set enable_bundle=false")
     if block_rows < 1:
         raise BlockCacheError(f"block_rows must be >= 1 (got {block_rows})")
+    if bin_layout not in ("auto", "u8", "packed4"):
+        raise BlockCacheError(
+            f"bin_layout={bin_layout!r}: expected auto | u8 | packed4")
+    if bin_layout == "packed4":
+        reason = packed4_eligible(ds)
+        if reason:
+            raise BlockCacheError(f"bin_layout=packed4: {reason}")
+    elif bin_layout == "auto":
+        bin_layout = "packed4" if not packed4_eligible(ds) else "u8"
     os.makedirs(path, exist_ok=True)
 
     buf = io.BytesIO()
@@ -117,6 +154,12 @@ def write_block_cache(ds, path: str, block_rows: int = 65536) -> dict:
 
     N = ds.num_data
     binned = np.ascontiguousarray(ds.binned)
+    if bin_layout == "packed4":
+        # pack ONCE over the full matrix: packing pairs feature ROWS, so
+        # slicing the packed matrix per block equals packing per block
+        from ..ops.hist_pallas import pack4bit
+
+        binned = pack4bit(binned)
     blocks: List[dict] = []
     for i, a in enumerate(range(0, N, block_rows)):
         b = min(a + block_rows, N)
@@ -136,6 +179,7 @@ def write_block_cache(ds, path: str, block_rows: int = 65536) -> dict:
         "num_features": int(ds.num_features),
         "block_rows": int(block_rows),
         "dtype": str(binned.dtype),
+        "bin_layout": bin_layout,
         "meta_file": META_NAME,
         "meta_sha256": _sha256(meta_bytes),
         # schema digest: load-time incompatibility (different binning of
@@ -147,7 +191,9 @@ def write_block_cache(ds, path: str, block_rows: int = 65536) -> dict:
                        json.dumps(manifest, indent=1).encode(),
                        site="block_cache_manifest")
     log_info(f"Wrote block cache to {path}: {N} rows x {ds.num_features} "
-             f"features in {len(blocks)} blocks of {block_rows} rows")
+             f"features in {len(blocks)} blocks of {block_rows} rows"
+             + (" (4-bit packed shards)" if bin_layout == "packed4"
+                else ""))
     return manifest
 
 
@@ -178,15 +224,36 @@ def load_manifest(path: str) -> dict:
         raise BlockCacheError(f"{mp}: wrong magic "
                               f"{manifest.get('magic')!r}")
     version = int(manifest.get("format_version", -1))
-    if version != BLOCK_CACHE_VERSION:
+    if version in BLOCK_CACHE_LEGACY_VERSIONS:
+        # legacy caches predate the bin_layout field: unpacked shards,
+        # loaded unchanged (the digests cover the same stored bytes)
+        log_warning(
+            f"{mp}: legacy block-cache format_version {version} "
+            f"(current is {BLOCK_CACHE_VERSION}); unpacked u8 shards — "
+            "rewrite with save_block_cache to store 4-bit packed shards "
+            "for max_bin <= 15 data")
+    elif version != BLOCK_CACHE_VERSION:
         raise BlockCacheError(
             f"{mp}: unsupported format_version {version} (this build "
-            f"reads version {BLOCK_CACHE_VERSION})")
+            f"reads versions {BLOCK_CACHE_LEGACY_VERSIONS} and "
+            f"{BLOCK_CACHE_VERSION})")
     for key in ("num_rows", "num_features", "dtype", "blocks",
                 "meta_sha256"):
         if key not in manifest:
             raise BlockCacheError(f"{mp}: missing manifest field {key!r}")
+    layout = manifest_bin_layout(manifest)
+    if layout not in ("u8", "packed4"):
+        raise BlockCacheError(f"{mp}: unknown bin_layout {layout!r}")
+    if layout == "packed4" and np.dtype(manifest["dtype"]).itemsize != 1:
+        raise BlockCacheError(
+            f"{mp}: packed4 shards must be uint8 "
+            f"(manifest dtype {manifest['dtype']!r})")
     return manifest
+
+
+def manifest_bin_layout(manifest: dict) -> str:
+    """The cache's stored layout (legacy manifests are implicitly u8)."""
+    return str(manifest.get("bin_layout", "u8"))
 
 
 def validate_block_table(path: str, manifest: dict) -> List[tuple]:
@@ -270,6 +337,8 @@ def read_block(path: str, manifest: dict, index: int) -> np.ndarray:
             f"{bp}: block digest mismatch (torn or corrupt cache); "
             "rebuild with task=save_binary")
     F = int(manifest["num_features"])
+    if manifest_bin_layout(manifest) == "packed4":
+        F = -(-F // 2)      # stored byte rows: two features per byte
     rows = int(entry["rows"])
     return np.frombuffer(raw, dtype=np.dtype(manifest["dtype"])) \
         .reshape(F, rows)

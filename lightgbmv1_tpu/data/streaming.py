@@ -29,7 +29,8 @@ import numpy as np
 from ..io.binning import BinMapper
 from ..io.dataset import BinnedDataset, Metadata
 from ..utils.log import log_info
-from .block_cache import (BlockCacheError, load_manifest, read_block,
+from .block_cache import (BlockCacheError, load_manifest,
+                          manifest_bin_layout, read_block,
                           read_meta_arrays, shard_blocks,
                           validate_block_table)
 
@@ -100,14 +101,21 @@ class DeviceLedger:
 
 
 class _BlockSource:
-    """Block iteration interface: contiguous row ranges, host arrays."""
+    """Block iteration interface: contiguous row ranges, host arrays.
+
+    ``bin_layout`` names the STORED block layout: ``"u8"`` blocks are
+    ``(F, rows)`` bins; ``"packed4"`` blocks are the 4-bit
+    ``(ceil(F/2), rows)`` byte layout (ops/hist_pallas.pack4bit) — the
+    consumer (models/grower_stream.py) device-puts the packed bytes
+    (H2D halves) and unpacks nibbles on device."""
 
     num_rows: int = 0
     num_features: int = 0
     block_dtype = np.uint8
+    bin_layout: str = "u8"
     ranges: List[Tuple[int, int]] = []
 
-    def load_block(self, index: int) -> np.ndarray:   # (F, rows)
+    def load_block(self, index: int) -> np.ndarray:   # (F | ceil(F/2), rows)
         raise NotImplementedError
 
     @property
@@ -142,6 +150,7 @@ class _CacheBlockSource(_BlockSource):
         self._manifest = manifest
         self.num_features = int(manifest["num_features"])
         self.block_dtype = np.dtype(manifest["dtype"])
+        self.bin_layout = manifest_bin_layout(manifest)
         self.block_rows = int(manifest["block_rows"])
         # block table sanity: contiguous, covering, ordered — an overlap
         # or gap fails LOUDLY (it would double-read / drop rows)
@@ -260,11 +269,19 @@ class StreamingDataset(BinnedDataset):
             yield a, b, self.source.load_block(i)
 
     def materialize(self) -> BinnedDataset:
-        """Densify into a resident BinnedDataset (tests / small data)."""
-        full = np.empty((self.num_features, self.num_data),
+        """Densify into a resident BinnedDataset (tests / small data).
+        Packed caches densify to the natural (F, N) bins — the resident
+        trainer re-derives its own device layout from the config."""
+        packed = self.source.bin_layout == "packed4"
+        fr = (-(-self.num_features // 2) if packed else self.num_features)
+        full = np.empty((fr, self.num_data),
                         dtype=self.source.block_dtype)
         for a, b, blk in self.iter_blocks():
             full[:, a:b] = blk
+        if packed:
+            from ..ops.hist_pallas import unpack4bit
+
+            full = unpack4bit(full, self.num_features)
         ds = BinnedDataset(full, self.bin_mappers, self.metadata,
                            feature_names=list(self.feature_names),
                            max_bin=self.max_bin)

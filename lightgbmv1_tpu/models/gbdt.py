@@ -33,7 +33,6 @@ from ..metrics import Metric, create_metrics
 from ..objectives import ObjectiveFunction, create_objective
 from ..obs import trace as obs_trace
 from ..obs import xla as obs_xla
-from ..ops.histogram import default_hist_method, hist_one_leaf
 from ..ops.split import SplitParams, make_feature_meta
 from ..utils.log import log_fatal, log_info, log_warning
 from ..utils.timer import global_timer
@@ -128,24 +127,26 @@ class GBDT:
                                             train_set.num_bins)
         # 4-bit packing (reference DenseBin<..,IS_4BIT>, dense_bin.hpp:52):
         # two bins per byte when every feature fits 4 bits — halves the
-        # binned matrix in HBM and the hist pass's dominant read stream.
-        # Pallas-path only; feature-parallel shards features, not bytes.
+        # binned matrix in HBM and the hist pass's dominant read stream,
+        # including the fused wave round/loop (in-VMEM nibble unpack).
+        # Layout resolution + once-per-build logging:
+        # parallel/trainer.select_bin_layout (config.bin_layout).
         self._packed = False
         if self._is_streaming:
             # the row bulk never lands on device whole: blocks stream per
             # histogram pass (models/grower_stream.py); EFB / 4-bit
-            # packing are resident-trainer representations
+            # packing are resident-trainer representations (the block
+            # cache stores packed SHARDS separately, data/block_cache.py)
             self._host_matrix = None
         else:
             self._host_matrix = train_set.train_matrix
-            method = default_hist_method(config.hist_method,
-                                         self._host_matrix.dtype)
-            # hist_method=fused scans unpacked uint8 bins in-kernel, so
-            # 4-bit packing would force the staged fallback — skip it
-            if (self._bundle is None and method == "pallas"
-                    and config.hist_method != "fused"
-                    and train_set.num_total_bin <= 16
-                    and config.tree_learner != "feature"):
+            from ..parallel.trainer import select_bin_layout
+
+            layout = select_bin_layout(
+                config, num_total_bin=train_set.num_total_bin,
+                bin_dtype=self._host_matrix.dtype,
+                bundled=self._bundle is not None)
+            if layout == "packed4":
                 from ..ops.hist_pallas import pack4bit
 
                 self._packed = True

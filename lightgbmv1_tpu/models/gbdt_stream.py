@@ -127,6 +127,11 @@ class StreamingGBDT(GBDT):
         self._bag_cache = None
         super().__init__(config, train_set, objective, metrics,
                          init_raw_scores)
+        # a packed4 cache (block-cache v3) streams packed shards: the
+        # prediction walker decodes nibbles (tree_predict_binned packed
+        # lane) and add_valid packs valid matrices to match
+        self._packed = getattr(self._source, "bin_layout", "u8") \
+            == "packed4"
         if self.objective is None:
             log_fatal("streaming training requires a built-in objective "
                       "(custom fobj needs full-matrix raw scores)")

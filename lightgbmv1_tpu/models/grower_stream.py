@@ -25,9 +25,13 @@ are accounted explicitly in a :class:`~lightgbmv1_tpu.data.DeviceLedger`
 
 Scope: the streaming schedule is the sequential best-first order (the
 parity configuration — ``tree_growth=leafwise_masked`` /
-``leafwise_wave_size=1``); forced splits, CEGB, EFB bundles and 4-bit
-packing are resident-trainer-only and are rejected loudly at
-construction (models/gbdt_stream.py).
+``leafwise_wave_size=1``); forced splits, CEGB and EFB bundles are
+resident-trainer-only and are rejected loudly at construction
+(models/gbdt_stream.py).  4-bit packed caches (block-cache v3
+``bin_layout=packed4``, ISSUE 18) stream their PACKED shards: the H2D
+transfer moves ``(ceil(F/2), rows)`` bytes and each per-block jit
+unpacks nibbles on device first (``unpack4bit`` — exact, so the fold
+stays bit-identical to the unpacked stream at fixed block order).
 """
 
 from __future__ import annotations
@@ -40,6 +44,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..io.binning import MISSING_NAN, MISSING_ZERO
+from ..ops.hist_pallas import unpack4bit
 from ..ops.histogram import hist_one_leaf_accum, sums_accum
 from ..ops.split import (NO_CONSTRAINT, FeatureMeta, SplitParams,
                          find_best_split, leaf_output, smooth_output)
@@ -111,6 +116,10 @@ class StreamGrower:
         self.precision = hist_precision
         self.prefetch = prefetch
         self.F = int(np.asarray(meta.num_bins).shape[0])
+        # packed cache shards: H2D moves the packed bytes; each per-block
+        # jit decodes nibbles on device first (_unpack below)
+        self.packed_src = (getattr(source, "bin_layout", "u8")
+                           == "packed4")
         self.use_mc = bool(np.asarray(meta.monotone_type).any())
         self.groups = (jnp.asarray(interaction_groups)
                        if interaction_groups is not None else None)
@@ -168,9 +177,16 @@ class StreamGrower:
             go_left = jnp.where(iscat, in_set, go_left)
             return jnp.where((lid_blk == leaf) & (~go_left), nl, lid_blk)
 
+    def _unpack(self, bins_blk):
+        """Device-side nibble decode of a packed block — exact, so every
+        downstream fold sees the same uint8 bins as an unpacked stream."""
+        return (unpack4bit(bins_blk, self.F) if self.packed_src
+                else bins_blk)
+
     def _root_block(self, acc, rs, bins_blk, g3_blk):
         """Root pass, one block, one dispatch: histogram fold + ordered
         root-sum fold."""
+        bins_blk = self._unpack(bins_blk)
         acc = hist_one_leaf_accum(
             acc, bins_blk, g3_blk, jnp.zeros(g3_blk.shape[0], jnp.int32),
             jnp.asarray(0, jnp.int32), self.B, method=self.method,
@@ -182,6 +198,7 @@ class StreamGrower:
         """Split pass, one block, one dispatch: route the block's rows
         through the split, then fold the smaller (and, pool-free, the
         larger) child's histogram."""
+        bins_blk = self._unpack(bins_blk)
         lid2 = self._apply_block(bins_blk, lid_blk, leaf, nl, feat, thr,
                                  dl, iscat, bitset)
         acc_s = hist_one_leaf_accum(acc_s, bins_blk, g3_blk, lid2,

@@ -58,6 +58,24 @@ MAX_LANES = 2048          # lanes per one-hot block: FBLK * num_bins
 _COUNT_SCALE = 64.0       # power-of-two count quantizer => exact counts
 
 
+def kernel_width(num_bins: int) -> int:
+    """Static kernel-width rung for a bin count — the TPU analog of the
+    reference's histogram16/64/256 OpenCL kernel ladder
+    (src/treelearner/ocl/histogram{16,64,256}.cl): every caller
+    specializes its tiling on the rung, not the raw bin count, so two
+    configs on the same rung compile the same kernel.  The <=16 rung is
+    the 4-bit packed leg's home: only there can a bin id live in a
+    nibble (``pack4bit``)."""
+    if num_bins <= 16:
+        return 16
+    if num_bins <= 64:
+        return 64
+    if num_bins <= 256:
+        return 256
+    raise ValueError("uint8 kernel family holds num_bins <= 256; route "
+                     "int16-binned data to the onehot/scatter path")
+
+
 def _row_tile_for(m_pad: int, num_lanes: int, num_bins: int) -> int:
     """Row-tile size keeping the VMEM working set (chunked one-hot + repeat
     buffer + lg rows + out accumulator) within Mosaic's ~16MB scoped-vmem
@@ -67,7 +85,7 @@ def _row_tile_for(m_pad: int, num_lanes: int, num_bins: int) -> int:
     B=256 with 3 features and T=1024)."""
     out_bytes = m_pad * num_lanes * 4
     per_row = 14 * min(num_lanes, 512) + 16 * m_pad
-    t0 = 1024 if num_bins <= 64 else 512
+    t0 = 1024 if kernel_width(num_bins) <= 64 else 512
     for t in (1024, 512, 256, 128):
         if t <= t0 and out_bytes + t * per_row <= 8 * 2**20:
             return t
@@ -215,6 +233,20 @@ def pack4bit(binned: np.ndarray) -> np.ndarray:
         binned = np.concatenate(
             [binned, np.zeros((1, N), binned.dtype)], axis=0)
     return (binned[0::2] | (binned[1::2] << 4)).astype(np.uint8)
+
+
+def unpack4bit(packed, num_features: int):
+    """(ceil(F/2), N) packed bytes -> (F, N) uint8 bins — ``pack4bit``'s
+    inverse in natural feature order (works on numpy and jnp arrays, so
+    the streaming cache can ship packed bytes over PCIe and unpack ON
+    DEVICE).  The phantom hi-nibble feature of an odd-F tail is sliced
+    away."""
+    xp = jnp if isinstance(packed, jax.Array) else np
+    lo = packed & 15
+    hi = packed >> 4
+    un = xp.stack([lo, hi], axis=1).reshape(2 * packed.shape[0],
+                                            packed.shape[1])
+    return un[:num_features].astype(xp.uint8)
 
 
 def packed_bins_of_feat(binned, feat):

@@ -60,8 +60,17 @@ parallel/trainer.py):
   lowering; such datasets run the staged path,
 * ``extra_trees`` — per-node threshold sampling draws ``jax.random``
   inside the scan,
-* EFB bundles / 4-bit packed bins / int16 bins — the scan runs in
-  original-feature uint8 bin space only,
+* EFB bundles / int16 bins — the scan runs in original-feature uint8
+  bin space only.  4-bit PACKED bins are NOT a fallback leg any more
+  (ISSUE 18): on the ``num_bins <= 16`` rung of the kernel-width
+  ladder (``hist_pallas.kernel_width``) the fused round and the
+  persistent wave loop consume the ``(ceil(F/2), N)`` packed matrix
+  directly — nibbles unpack in VMEM (the reused ``_hist_tile`` packed
+  path), the accumulator is restored to natural feature order before
+  the scan, and the routing stage decodes decision bins from the
+  packed bytes — so the round's dominant HBM read halves; packed bins
+  at ``num_bins > 16`` cannot exist (a nibble holds 16 values) and are
+  refused honestly,
 * row-sharded learners (``tree_learner=data``/``voting``) — the
   cross-shard histogram reduce needs the explicit histogram on the wire;
   the feature-parallel learner DOES run the kernel per feature slice and
@@ -71,11 +80,13 @@ parallel/trainer.py):
   each shard's kernel sees only its own feature slice; the
   feature-parallel learner therefore keeps the staged (S, N) partition
   and per-slice election while still fusing histogram + scan,
-* EFB / 4-bit packed decisions (partition-specific) — the go-left stage
-  compares raw uint8 bins; bundle-column and nibble decode happen in
-  ``bins_of_fn`` outside any kernel (these configs are already excluded
-  by the histogram gates above, so the partition gate never fires
-  alone),
+* EFB decisions (partition-specific) — the go-left stage compares raw
+  uint8 bins; bundle-column decode happens in ``bins_of_fn`` outside
+  any kernel (EFB is already excluded by the histogram gate above, so
+  the partition gate never fires alone).  Packed nibble decode, by
+  contrast, IS in-kernel now: ``decision_bins`` gathers the packed
+  byte by ``feature >> 1`` and selects the nibble by feature parity
+  (the ``packed_bins_of_rows`` layout contract),
 * Mosaic lowering failure on a device backend — auto-fallback with a
   warning, the ``predict_pallas`` precedent; the CPU backend always runs
   the kernel in interpret mode (the bit-parity lane the tests pin).
@@ -96,7 +107,8 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from ..io.binning import MISSING_NAN, MISSING_ZERO
-from .hist_pallas import MAX_LANES, _kernel as _hist_tile, _row_tile_for
+from .hist_pallas import (MAX_LANES, _kernel as _hist_tile, _row_tile_for,
+                          packed_bins_of_rows)
 from .split import (
     NEG_INF,
     NO_CONSTRAINT,
@@ -182,14 +194,20 @@ def pack_route_meta(feats, thrs, dls, leafs, nls, meta, sml=None):
     ], axis=1)
 
 
-def decision_bins(binned, lids, feats, leafs, num_leaves):
+def decision_bins(binned, lids, feats, leafs, num_leaves, packed=False):
     """Each row's decision bin — ``binned[f(leaf(row)), row]`` via a
     leaf→feature table and ONE per-element gather (O(N) bytes), the
     only touch of the binned matrix the routing stage adds.  Rows of
-    non-splitting leaves read feature 0; their slot mask is False."""
+    non-splitting leaves read feature 0; their slot mask is False.
+    ``packed``: ``binned`` is the 4-bit matrix — the gather indexes the
+    packed byte (``feature >> 1``, HALF the bytes touched) and selects
+    the nibble by feature parity (``packed_bins_of_rows``, the layout's
+    single source of truth)."""
     tab = jnp.zeros(num_leaves + 1, jnp.int32) \
         .at[leafs].set(feats.astype(jnp.int32), mode="drop")
     f_of = tab[lids]                                        # (N,)
+    if packed:
+        return packed_bins_of_rows(binned, f_of)
     return jnp.take_along_axis(binned, f_of[None, :], axis=0)[0] \
         .astype(jnp.int32)
 
@@ -220,7 +238,7 @@ def child_scan_residue(hc, mask_c, csum_c, constr_c, depth_c, pout_c,
 def _fused_kernel(*refs, nrt, lpad, num_bins, fblk, precision, interpret,
                   params, use_mc, monotone_penalty, has_contri, sub,
                   apply_scale, child_scale, nslots, nchildren,
-                  route_blk=False):
+                  route_blk=False, fpb=0):
     """Grid ``(1, row_tiles)``: every tile accumulates its rows via the
     REUSED ``hist_pallas._kernel``; the last tile runs the split scan on
     the VMEM accumulator and writes the per-feature residue (plus, in
@@ -233,6 +251,15 @@ def _fused_kernel(*refs, nrt, lpad, num_bins, fblk, precision, interpret,
     feature blocks consume as their ``leaf`` input — and the new per-row
     leaf ids, then accumulates this block's histogram FROM the label it
     just produced: partition and histogram share one sweep of the rows.
+
+    ``fpb > 0`` (4-bit packed bins, ISSUE 18): the bins tile holds
+    ``fpb`` packed byte columns whose nibbles ``_hist_tile`` unpacks in
+    VMEM to the ``fblk == 2*fpb`` unpacked feature block — its lane
+    order is [lo nibbles | hi nibbles], so before the scan the
+    accumulator's feature axis is re-interleaved back to NATURAL order
+    (lo/hi alternating).  Everything downstream — subtraction, residue
+    scan, the order-sensitive tie-band pick — then sees exactly the
+    unpacked kernel's values in the unpacked kernel's order.
     """
     names = ["iota", "bins", "g3"]
     names += (["dbin", "oleaf", "rmeta"] if route_blk else ["leaf"])
@@ -266,7 +293,7 @@ def _fused_kernel(*refs, nrt, lpad, num_bins, fblk, precision, interpret,
 
     _hist_tile(r["iota"], r["bins"], r["g3"], leaf_ref, r["acc"],
                lpad=lpad, num_bins=num_bins, fblk=fblk,
-               precision=precision, interpret=interpret)
+               precision=precision, interpret=interpret, packed=fpb > 0)
 
     rt = pl.program_id(1)
     B = num_bins
@@ -278,6 +305,12 @@ def _fused_kernel(*refs, nrt, lpad, num_bins, fblk, precision, interpret,
         # hist_leaves_pallas applies outside, here on VMEM values
         acc = r["acc"][0]                               # (3*lpad, B*fblk)
         h = acc.reshape(lpad, 3, B, fblk).transpose(0, 3, 2, 1)
+        if fpb:
+            # packed accumulator order is [lo nibbles | hi nibbles]; the
+            # tie-band pick is feature-ORDER-sensitive (first in band =
+            # min feature), so restore natural order BEFORE any scan
+            h = jnp.stack([h[:, :fpb], h[:, fpb:]], axis=2) \
+                .reshape(lpad, fblk, B, 3)
         meta_blk = FeatureMeta(
             num_bins=r["nb"][...][0],
             missing_type=r["mt"][...][0],
@@ -330,7 +363,8 @@ def fused_wave_scan(binned, g3, label, *, nslots, nchildren, num_bins,
                     precision, interpret, meta, params, use_mc,
                     monotone_penalty, mask, csums, constr, depth, pout,
                     cscale=None, sscale=None, sml=None, parent=None,
-                    apply_scale=False, row_tile=0, route=None):
+                    apply_scale=False, row_tile=0, route=None,
+                    packed=False):
     """One fused wave round over all feature blocks.
 
     ``nslots`` counts the ACCUMULATED slots (smaller children in
@@ -340,7 +374,13 @@ def fused_wave_scan(binned, g3, label, *, nslots, nchildren, num_bins,
     ``dbin (N,) / oleaf (N,) / rmeta (S, RMETA_COLS)``) folds the
     partition in: ``label`` is ignored (pass None) — feature block 0
     evaluates the go-left decisions in VMEM, emits the label the other
-    blocks consume and the updated per-row leaf ids.  Returns
+    blocks consume and the updated per-row leaf ids.  ``packed``:
+    ``binned`` is the ``(ceil(F/2), N)`` 4-bit matrix (num_bins <= 16)
+    — each block streams its PACKED byte columns (half the HBM binned
+    read) and unpacks nibbles in VMEM; a block's ``fblk`` unpacked
+    features are the CONTIGUOUS natural range ``[fb*fblk, (fb+1)*fblk)``
+    (lo nibble = feature 2p, hi = 2p+1), so the per-feature meta/mask/
+    parent slices below are identical to the unpacked layout.  Returns
     ``(residue (C, F, RES_COLS), hsmall (nslots, F, B, 3) or None,
     new_leaf (N,) or None)``.
     """
@@ -349,21 +389,41 @@ def fused_wave_scan(binned, g3, label, *, nslots, nchildren, num_bins,
     F = mask.shape[1]
     B = num_bins
     N = binned.shape[1]
-    fblk = max(1, min(F, MAX_LANES // B))
-    nfb = -(-F // fblk)
+    if packed:
+        # fblk counts UNPACKED features and must be even (each byte
+        # column contributes its lo and hi nibble feature); the phantom
+        # hi-nibble feature of an odd-F tail pads to unusable below
+        Fp = binned.shape[0]
+        fblk = max(2, min(2 * Fp, MAX_LANES // B) & ~1)
+        fpb = fblk // 2                  # packed byte columns per block
+        nfb = -(-Fp // fpb)
+    else:
+        fpb = 0
+        fblk = max(1, min(F, MAX_LANES // B))
+        nfb = -(-F // fblk)
     f_pad = nfb * fblk
     L = nslots + 1
     lpad = -(-L // 8) * 8
     m_pad = 3 * lpad
-    T = row_tile if row_tile > 0 else _row_tile_for(m_pad, fblk * B, B)
+    # the row tile is priced on the UNPACKED lane count either way: the
+    # same T means the same row partition, so every (leaf, bin, feature)
+    # accumulator cell sums the same per-tile dots in the same order —
+    # the packed round's f32 histograms are bit-identical to unpacked
+    T = row_tile if row_tile > 0 else _row_tile_for(
+        m_pad, max(1, min(F, MAX_LANES // B)) * B, B)
     nrt = -(-N // T)
     n_pad = nrt * T
 
     # padding identical to hist_leaves_pallas: padded features collect
-    # bin 255 (no bin when B < 256; masked unusable below when B == 256),
-    # padded rows carry zero g3 and an out-of-range slot id
-    binned_rm = jnp.pad(binned, ((0, f_pad - F), (0, n_pad - N)),
-                        constant_values=255).T          # (n_pad, f_pad)
+    # bin 255 (no bin when B < 256; masked unusable below when B == 256;
+    # packed pad bytes are 0 -> phantom features collect bin 0 and are
+    # masked unusable below), padded rows carry zero g3 and an
+    # out-of-range slot id
+    tile_cols = fpb if packed else fblk   # stored byte columns per block
+    binned_rm = jnp.pad(
+        binned,
+        ((0, nfb * tile_cols - binned.shape[0]), (0, n_pad - N)),
+        constant_values=0 if packed else 255).T   # (n_pad, nfb*tile_cols)
     g3t = jnp.pad(g3.astype(jnp.float32), ((0, n_pad - N), (0, 0))).T
     if route is not None:
         # pad rows: leaf -1 matches no slot -> the routing stage labels
@@ -409,7 +469,7 @@ def fused_wave_scan(binned, g3, label, *, nslots, nchildren, num_bins,
         precision=precision, interpret=interpret, params=params,
         use_mc=use_mc, monotone_penalty=monotone_penalty,
         has_contri=has_contri, sub=sub, apply_scale=apply_scale,
-        child_scale=child_scale, nslots=nslots, nchildren=C)
+        child_scale=child_scale, nslots=nslots, nchildren=C, fpb=fpb)
 
     def full_spec(shape):
         nd = len(shape)
@@ -420,10 +480,11 @@ def fused_wave_scan(binned, g3, label, *, nslots, nchildren, num_bins,
     for fb in range(nfb):
         route_blk = route is not None and fb == 0
         sl = slice(fb * fblk, (fb + 1) * fblk)
-        ins = [iota_bins, binned_rm[:, sl], g3t]
+        bsl = slice(fb * tile_cols, (fb + 1) * tile_cols)
+        ins = [iota_bins, binned_rm[:, bsl], g3t]
         specs = [
             pl.BlockSpec((1, fblk * B), lambda fb_, rt: (0, 0)),
-            pl.BlockSpec((T, fblk), lambda fb_, rt: (rt, 0)),
+            pl.BlockSpec((T, tile_cols), lambda fb_, rt: (rt, 0)),
             pl.BlockSpec((3, T), lambda fb_, rt: (0, rt)),
         ]
         if route_blk:
@@ -504,7 +565,8 @@ def _route_only_kernel(dbin_ref, oleaf_ref, rmeta_ref, out_ref):
 
 
 def fused_route_rows(binned, lids, *, feats, thrs, dls, leafs, nls,
-                     num_leaves, meta, interpret, row_tile=1024):
+                     num_leaves, meta, interpret, row_tile=1024,
+                     packed=False):
     """Route one row set through a round's committed splits with the
     SAME kernel decision stage the megakernel runs on the train rows —
     the valid-set lane of the single-pass round (ISSUE 15).
@@ -515,11 +577,14 @@ def fused_route_rows(binned, lids, *, feats, thrs, dls, leafs, nls,
     only the updated leaf ids.  Every update term is int32, so the
     result is bit-identical to the staged ``go_left_s``/
     ``route_pending`` routing (pinned in tests/test_wave_fused.py).
+    ``packed``: ``binned`` is the 4-bit matrix — the decision-bin
+    gather decodes nibbles (``decision_bins``), same int32 values.
     """
     N = lids.shape[0]
     if N == 0:
         return lids
-    dbin = decision_bins(binned, lids, feats, leafs, num_leaves)
+    dbin = decision_bins(binned, lids, feats, leafs, num_leaves,
+                         packed=packed)
     rmeta = pack_route_meta(feats, thrs, dls, leafs, nls, meta)
     T = min(row_tile, max(128, -(-N // 128) * 128))
     nrt = -(-N // T)
@@ -603,7 +668,7 @@ def unpack_children(packed: jnp.ndarray, num_bins: int) -> SplitResult:
 
 def make_fused_round(*, meta, params, num_bins, precision, deep_precision,
                      monotone_penalty=0.0, interpret=False,
-                     axis_name=None):
+                     axis_name=None, packed=False):
     """Build the grower-facing ``fused_round_fn``.
 
     ``fused_round(binned, g3, label, S, *, deep, quant_key, scaled,
@@ -644,6 +709,10 @@ def make_fused_round(*, meta, params, num_bins, precision, deep_precision,
       learner passes its (traced) per-shard meta slice and block offset;
       packed feature ids come back shard-local and are rebased by the
       caller after the SplitInfo election.
+    * ``packed`` (builder-static, ISSUE 18) — the binned matrix is the
+      4-bit ``(ceil(F/2), N)`` layout; the kernel unpacks nibbles in
+      VMEM and the routing stage (train AND valid: ``route_rows`` binds
+      it too) decodes decision bins from the packed bytes.
     """
     from .quantize import sr_quantize_g3
 
@@ -675,7 +744,7 @@ def make_fused_round(*, meta, params, num_bins, precision, deep_precision,
             route_in = dict(
                 dbin=decision_bins(binned, route["leaf_id"],
                                    route["feats"], route["leafs"],
-                                   route["num_leaves"]),
+                                   route["num_leaves"], packed=packed),
                 oleaf=route["leaf_id"],
                 rmeta=pack_route_meta(route["feats"], route["thrs"],
                                       route["dls"], route["leafs"],
@@ -690,19 +759,20 @@ def make_fused_round(*, meta, params, num_bins, precision, deep_precision,
                 cscale=(scales if (scaled and not sub) else None),
                 sscale=(scales if (scaled and sub) else None),
                 sml=sml, parent=parent, apply_scale=(scaled and sub),
-                route=route_in)
+                route=route_in, packed=packed)
             shift = jax.vmap(
                 lambda ps, po: gain_shift(ps, po, params))(csums, pout)
-            packed = jax.vmap(
+            ptab = jax.vmap(
                 lambda rc, sh, ps: _pick_pack(rc, sh, ps, m, num_bins)
             )(residue, shift, csums)
         if route is not None:
-            return packed, hsmall, scales, new_leaf
-        return packed, hsmall, scales
+            return ptab, hsmall, scales, new_leaf
+        return ptab, hsmall, scales
 
     fused_round.supports_route = True
+    fused_round.packed = packed
     fused_round.route_rows = functools.partial(
-        fused_route_rows, meta=meta, interpret=interpret)
+        fused_route_rows, meta=meta, interpret=interpret, packed=packed)
     return fused_round
 
 
@@ -733,7 +803,8 @@ _LOOP_VMEM_BUDGET = 14 * 2 ** 20
 
 def plan_wave_loop(*, rounds, N, F, num_bins, K, L, use_sub, slot_buckets,
                    quant_buckets=(), precision="f32", deep_precision="f32",
-                   use_mc=False, vmem_budget=_LOOP_VMEM_BUDGET):
+                   use_mc=False, packed=False,
+                   vmem_budget=_LOOP_VMEM_BUDGET):
     """Static VMEM-budget planner for the persistent wave loop.
 
     Decides — entirely at trace/build time, from shapes and knobs — how
@@ -758,8 +829,17 @@ def plan_wave_loop(*, rounds, N, F, num_bins, K, L, use_sub, slot_buckets,
     * a reachable deep bucket (K >= 32, multi-bucket ladder, no quant)
       requires ``deep_precision == precision`` — one static accumulate
       dtype for the whole loop.
+
+    ``packed`` (ISSUE 18): the loop keeps the 4-bit PACKED matrix
+    resident — the bins row tile is priced on packed bytes (HALF), and
+    the kernel feature width is the even ``2*ceil(F/2)`` nibble span
+    (the phantom odd-F feature rides masked-unusable).  The row tile
+    itself is still derived from the UNPACKED lane count, so packed and
+    unpacked loops share the accumulation partition (bit parity).
     """
     B = num_bins
+    Fk = 2 * -(-F // 2) if packed else F    # kernel feature width
+    Fb = -(-F // 2) if packed else F        # stored bins columns
 
     def lanes_pad(S):
         nsl = S if use_sub else 2 * S
@@ -769,22 +849,27 @@ def plan_wave_loop(*, rounds, N, F, num_bins, K, L, use_sub, slot_buckets,
     T = _row_tile_for(m_pad, F * B, B)
     nrt = -(-max(N, 1) // T)
     n_pad = nrt * T
-    acc_bytes = m_pad * F * B * 4
-    # the one-hot working set _row_tile_for budgets for, per row tile
-    stream_bytes = T * (14 * min(F * B, 512) + 16 * m_pad)
+    acc_bytes = m_pad * Fk * B * 4
+    # the one-hot working set _row_tile_for budgets for, per row tile,
+    # plus the resident bins row tile (packed bytes when packed — the
+    # layout's VMEM dividend)
+    stream_bytes = T * (14 * min(Fk * B, 512) + 16 * m_pad) + T * Fb
     state_bytes = (L * 12 * 4 + n_pad * 4
-                   + (L * F * B * 3 * 4 if use_sub else 0))
+                   + (L * Fk * B * 3 * 4 if use_sub else 0))
     total_bytes = acc_bytes + stream_bytes + state_bytes
     plan = dict(eligible=False, rounds=1, reason="",
                 acc_bytes=int(acc_bytes), state_bytes=int(state_bytes),
                 stream_bytes=int(stream_bytes),
                 total_bytes=int(total_bytes), row_tile=int(T),
                 ladder=tuple(int(s) for s in slot_buckets),
-                vmem_budget=int(vmem_budget))
+                vmem_budget=int(vmem_budget),
+                packed=bool(packed),
+                binned_bytes=int(Fb * max(N, 1)),
+                binned_tile_bytes=int(T * Fb))
     if rounds <= 1:
         plan["reason"] = "wave_loop_rounds=1 (single-round dispatch)"
         return plan
-    if F * B > MAX_LANES:
+    if Fk * B > MAX_LANES:
         plan["reason"] = ("F*num_bins > MAX_LANES: multi-feature-block "
                           "rounds keep the single-round kernel")
         return plan
@@ -819,7 +904,7 @@ def plan_wave_loop(*, rounds, N, F, num_bins, K, L, use_sub, slot_buckets,
 def _loop_kernel(*refs, R, nrt, T, lpad, num_bins, fblk, N, K, L,
                  precision, interpret, params, monotone_penalty,
                  has_contri, sub, scaled, ladder, quant_ladder, max_depth,
-                 topk_fn, qmax):
+                 topk_fn, qmax, packed=False):
     """Grid ``(R, row_tiles)`` — R consecutive wave rounds in ONE launch,
     the frontier state resident in VMEM scratch between them:
 
@@ -954,9 +1039,18 @@ def _loop_kernel(*refs, R, nrt, T, lpad, num_bins, fblk, N, K, L,
     tab = jnp.zeros(L + 1, jnp.int32) \
         .at[leafs_s].set(feats_s, mode="drop")
     f_of = tab[oleaf[0]]
-    bins_t = r["bins"][...].astype(jnp.int32)           # (T, fblk)
-    dbin = jnp.take_along_axis(bins_t, f_of[:, None],
-                               axis=1)[:, 0][None, :]
+    bins_t = r["bins"][...].astype(jnp.int32)     # (T, fblk | fblk//2)
+    if packed:
+        # nibble-decode decision lane (packed_bins_of_rows' layout, in
+        # VMEM): gather the packed byte, select by feature parity — the
+        # select form avoids a variable-amount vector shift
+        byte = jnp.take_along_axis(bins_t, (f_of >> 1)[:, None],
+                                   axis=1)[:, 0]
+        dbin = (jnp.where((f_of & 1) == 1, byte >> 4, byte)
+                & 15)[None, :]
+    else:
+        dbin = jnp.take_along_axis(bins_t, f_of[:, None],
+                                   axis=1)[:, 0][None, :]
     rmeta = pack_route_meta(feats_s, thrs_s, dls_s, leafs_s, nls_s,
                             meta_blk, sml=sml_s)
     new_leaf, label = route_tile(dbin, oleaf, rmeta, nslots=nsl, sub=sub)
@@ -981,12 +1075,18 @@ def _loop_kernel(*refs, R, nrt, T, lpad, num_bins, fblk, N, K, L,
         val3 = g3v
     _hist_tile(r["iota"], r["bins"], _ValRef(val3), _ValRef(label),
                r["acc"], lpad=lpad, num_bins=B, fblk=fblk,
-               precision=precision, interpret=interpret)
+               precision=precision, interpret=interpret, packed=packed)
 
     @pl.when(rt == nrt - 1)
     def _commit():
         acc = r["acc"][0]
         h = acc.reshape(lpad, 3, B, fblk).transpose(0, 3, 2, 1)
+        if packed:
+            # [lo nibbles | hi nibbles] -> natural feature order BEFORE
+            # the order-sensitive tie-band pick (and the pool commit,
+            # which the host replay reads in natural order)
+            h = jnp.stack([h[:, :fblk // 2], h[:, fblk // 2:]], axis=2) \
+                .reshape(lpad, fblk, B, 3)
         ones3 = jnp.ones((1, 3), jnp.float32)
         scale3 = (jnp.where(quant_r, r["qscale"][...], ones3)
                   if quant else ones3)                  # (1, 3)
@@ -1052,10 +1152,10 @@ def _loop_kernel(*refs, R, nrt, T, lpad, num_bins, fblk, N, K, L,
                                     depth_c, pout_c, cscale_c)
         shift = jax.vmap(
             lambda ps, po: gain_shift(ps, po, params))(csums_c, pout_c)
-        packed = jax.vmap(
+        ptab = jax.vmap(
             lambda rc, sh, ps: _pick_pack(rc, sh, ps, meta_blk, B)
         )(residue, shift, csums_c)
-        r["packed"][...] = packed[None]
+        r["packed"][...] = ptab[None]
 
         # frontier + pool commit — slot->rank gather then scatter-by-
         # child-leaf, the staged store.write's index math
@@ -1063,7 +1163,7 @@ def _loop_kernel(*refs, R, nrt, T, lpad, num_bins, fblk, N, K, L,
                            axis=1).reshape(C)
         cvalid = jnp.stack([valid, valid], axis=1).reshape(C)
         cidx = jnp.where(cvalid, cleafs, L + 1)
-        pk = packed[ch_idx]
+        pk = ptab[ch_idx]
         cgain = jnp.where(depth_ok, pk[:, 0], -jnp.inf)
         crows = jnp.concatenate([
             cgain[:, None], pk[:, 1:4], pk[:, 4:10], couts[:, None],
@@ -1082,7 +1182,7 @@ def _loop_kernel(*refs, R, nrt, T, lpad, num_bins, fblk, N, K, L,
 
 def make_fused_wave_loop(*, meta, params, num_bins, precision,
                          deep_precision, rounds, monotone_penalty=0.0,
-                         interpret=False):
+                         interpret=False, packed=False):
     """Build the grower-facing persistent wave-loop driver (ROADMAP
     item 1's endpoint: R consecutive wave rounds per launch, frontier
     state resident in VMEM — the R-1 intermediate kernel launches and
@@ -1116,13 +1216,27 @@ def make_fused_wave_loop(*, meta, params, num_bins, precision,
                    slot_buckets, quant_buckets, max_depth, base_mask,
                    pool=None):
         sub = pool is not None
-        F, N = binned.shape
+        if packed:
+            # binned is the RESIDENT (ceil(F/2), N) packed matrix; the
+            # kernel's feature width is the even nibble span — an odd-F
+            # tail's phantom hi-nibble feature rides masked-unusable
+            # through every round (pads below) and is sliced off the
+            # returned pool
+            Fb, N = binned.shape            # stored packed byte rows
+            F0 = int(meta.num_bins.shape[0])
+            F = 2 * Fb                      # kernel feature width
+        else:
+            F, N = binned.shape
+            F0, Fb = F, F
+        fpad = F - F0                       # 0 or 1 (phantom feature)
         L = ft12.shape[0]
         C = 2 * K
         nsl = K if sub else C
         lpad = -(-(nsl + 1) // 8) * 8
         m_pad = 3 * lpad
-        T = _row_tile_for(m_pad, F * B, B)
+        # row tile from the UNPACKED lane count (plan_wave_loop's rule):
+        # same T => same row partition => bit-identical f32 accumulation
+        T = _row_tile_for(m_pad, F0 * B, B)
         nrt = -(-N // T)
         n_pad = nrt * T
         R = rounds
@@ -1132,11 +1246,15 @@ def make_fused_wave_loop(*, meta, params, num_bins, precision,
             nd = len(shape)
             return pl.BlockSpec(shape, lambda ri, rt, _n=nd: (0,) * _n)
 
-        def row(a, dtype=jnp.int32):
-            return a.astype(dtype)[None, :]
+        def row(a, dtype=jnp.int32, cv=0):
+            a = a.astype(dtype)
+            if fpad:
+                a = jnp.pad(a, (0, fpad), constant_values=cv)
+            return a[None, :]
 
         binned_rm = jnp.pad(binned, ((0, 0), (0, n_pad - N)),
-                            constant_values=255).T      # (n_pad, F)
+                            constant_values=0 if packed else 255).T
+        # (n_pad, Fb)
         g3t = jnp.pad(g3.astype(jnp.float32),
                       ((0, n_pad - N), (0, 0))).T       # (3, n_pad)
         oleaf_p = jnp.pad(leaf_id.astype(jnp.int32), (0, n_pad - N),
@@ -1147,7 +1265,7 @@ def make_fused_wave_loop(*, meta, params, num_bins, precision,
         ins = [iota_bins, binned_rm, g3t]
         specs = [
             pl.BlockSpec((1, F * B), lambda ri, rt: (0, 0)),
-            pl.BlockSpec((T, F), lambda ri, rt: (rt, 0)),
+            pl.BlockSpec((T, Fb), lambda ri, rt: (rt, 0)),
             pl.BlockSpec((3, T), lambda ri, rt: (0, rt)),
         ]
         if quant:
@@ -1170,18 +1288,22 @@ def make_fused_wave_loop(*, meta, params, num_bins, precision,
                 kd = jax.random.key_data(kd)
             ins += [kd.reshape(1, 2).astype(jnp.uint32), scales[0:1]]
             specs += [full_spec((1, 2)), full_spec((1, 3))]
-        ins += [row(meta.num_bins), row(meta.missing_type),
-                row(meta.nan_bin), row(meta.zero_bin),
+        ins += [row(meta.num_bins, cv=1), row(meta.missing_type),
+                row(meta.nan_bin, cv=-1), row(meta.zero_bin),
                 row(meta.usable), row(meta.monotone_type)]
         specs += [full_spec((1, F))] * 6
         if has_contri:
-            ins.append(row(meta.contri, jnp.float32))
+            ins.append(row(meta.contri, jnp.float32, cv=1.0))
             specs.append(full_spec((1, F)))
         ins.append(row(base_mask, jnp.int8))
         specs.append(full_spec((1, F)))
         if sub:
-            ins.append(pool.astype(jnp.float32))
-            specs.append(full_spec(pool.shape))
+            pool_in = pool.astype(jnp.float32)
+            if fpad:
+                pool_in = jnp.pad(pool_in,
+                                  ((0, 0), (0, fpad), (0, 0), (0, 0)))
+            ins.append(pool_in)
+            specs.append(full_spec(pool_in.shape))
 
         out_shape = [
             jax.ShapeDtypeStruct((R, C, PACK_COLS), jnp.float32),
@@ -1193,8 +1315,8 @@ def make_fused_wave_loop(*, meta, params, num_bins, precision,
         ]
         if sub:
             out_shape.append(
-                jax.ShapeDtypeStruct(pool.shape, jnp.float32))
-            out_specs.append(full_spec(pool.shape))
+                jax.ShapeDtypeStruct(pool_in.shape, jnp.float32))
+            out_specs.append(full_spec(pool_in.shape))
 
         scratch = [
             pltpu.VMEM((1, m_pad, F * B), jnp.float32),   # acc
@@ -1203,7 +1325,7 @@ def make_fused_wave_loop(*, meta, params, num_bins, precision,
             pltpu.VMEM((1, n_pad), jnp.int32),            # leaf_scr
         ]
         if sub:
-            scratch.append(pltpu.VMEM(pool.shape, jnp.float32))
+            scratch.append(pltpu.VMEM(pool_in.shape, jnp.float32))
 
         kern = functools.partial(
             _loop_kernel, R=R, nrt=nrt, T=T, lpad=lpad, num_bins=B,
@@ -1212,18 +1334,22 @@ def make_fused_wave_loop(*, meta, params, num_bins, precision,
             monotone_penalty=monotone_penalty, has_contri=has_contri,
             sub=sub, scaled=quant, ladder=tuple(slot_buckets),
             quant_ladder=tuple(quant_buckets), max_depth=max_depth,
-            topk_fn=_topk_by_rank, qmax=INT8_QMAX)
+            topk_fn=_topk_by_rank, qmax=INT8_QMAX, packed=packed)
         out = pl.pallas_call(
             kern, grid=(R, nrt), in_specs=specs, out_specs=out_specs,
             out_shape=out_shape, scratch_shapes=scratch,
             interpret=interpret)(*ins)
-        return out[0], out[1][0, :N], (out[2] if sub else None)
+        pool_out = out[2] if sub else None
+        if sub and fpad:
+            pool_out = pool_out[:, :F0]     # drop the phantom feature
+        return out[0], out[1][0, :N], pool_out
 
     fused_loop.rounds = rounds
+    fused_loop.packed = packed
     fused_loop.plan = functools.partial(
         plan_wave_loop, rounds=rounds, num_bins=num_bins,
         precision=precision, deep_precision=deep_precision,
-        use_mc=use_mc)
+        use_mc=use_mc, packed=packed)
     return fused_loop
 
 
@@ -1235,8 +1361,8 @@ def fused_ineligible_reason(*, meta, params, bin_dtype, num_bins,
     if bundled:
         return ("EFB bundle-space histograms expand to original features "
                 "before the scan")
-    if packed:
-        return "4-bit packed bins decode outside the fused kernel"
+    if packed and num_bins > 16:
+        return "4-bit packed bins hold num_bins <= 16 only"
     if np.dtype(bin_dtype).itemsize > 1:
         return "int16 bins exceed the uint8 one-hot kernel family"
     if num_bins > 256:

@@ -283,6 +283,54 @@ def resolve_deep_dtype(requested: str, precision: str, backend: str) -> str:
     return requested or ("bf16" if precision == "bf16x2" else precision)
 
 
+def select_bin_layout(config: Config, *, num_total_bin: int, bin_dtype,
+                      bundled: bool) -> str:
+    """Resolve ``config.bin_layout`` to the device layout actually built
+    (``"u8"`` or ``"packed4"``) — ONE call per GBDT build, which also
+    owns the once-per-build engagement/refusal logging (the wave-loop
+    logging precedent).
+
+    Eligibility for ``packed4`` (the reference ``DenseBin<.., IS_4BIT>``
+    gate, dense_bin.hpp:52): every feature fits 4 bits
+    (``num_total_bin <= 16``), uint8 bins (int16-binned data exceeds the
+    nibble), no EFB bundling (bundle offsets address byte bins), a
+    pallas-family hist method (scatter/onehot gathers address unpacked
+    bins), ``tree_learner != "feature"`` (feature shards split the byte
+    pairing), and not ``gpu_use_dp`` (an explicit request for the widest
+    histogram datapath; packing narrows the read stream — dp wins, the
+    int8sr precedent).  ``auto`` packs exactly when eligible, silently on
+    refusal; an EXPLICIT ``packed4`` refusal logs the staged warning."""
+    if config.bin_layout == "u8":
+        return "u8"
+    explicit = config.bin_layout == "packed4"
+    method = default_hist_method(config.hist_method, bin_dtype)
+    reason = ""
+    if np.dtype(bin_dtype).itemsize > 1:
+        reason = "int16-binned data exceeds the 4-bit nibble"
+    elif num_total_bin > 16:
+        reason = (f"num_total_bin={num_total_bin} needs more than 4 bits "
+                  "per bin")
+    elif bundled:
+        reason = "EFB bundle offsets address unpacked byte bins"
+    elif method != "pallas":
+        reason = (f"hist method {method!r} gathers unpacked bins "
+                  "(pallas-family kernels unpack nibbles in VMEM)")
+    elif config.tree_learner == "feature":
+        reason = ("tree_learner=feature shards features, not byte "
+                  "pairs")
+    elif config.gpu_use_dp:
+        reason = ("gpu_use_dp requests the widest histogram datapath; "
+                  "packed bins narrow the read stream")
+    if reason:
+        if explicit:
+            log_warning(f"bin_layout=packed4: {reason}; storing u8 bins")
+        return "u8"
+    log_info("bin_layout=packed4: 4-bit packed bins engaged — two bins "
+             "per byte, the (F, N) binned read and the streaming cache "
+             "shards halve (ops/hist_pallas.pack4bit)")
+    return "packed4"
+
+
 def build_trainer(
     config: Config,
     binned_np: np.ndarray,           # (F, N) bins or (BF, N) EFB bundles
@@ -574,6 +622,7 @@ def build_trainer(
                      "histogram+split kernel with partition, valid "
                      "routing and top-k folded into the same dispatch "
                      "(ops/wave_fused.py, single-pass wave round"
+                     + (", 4-bit packed bins" if packed else "")
                      + (", interpret mode"
                         if jax.default_backend() == "cpu" else "") + ")")
 
@@ -593,7 +642,8 @@ def build_trainer(
                     meta=meta, params=params, num_bins=B,
                     precision=precision, deep_precision=deep_precision,
                     monotone_penalty=config.monotone_penalty,
-                    interpret=jax.default_backend() == "cpu")
+                    interpret=jax.default_backend() == "cpu",
+                    packed=packed)
             # ---- persistent multi-round wave loop (ROADMAP item 1) ----
             # wave_loop_rounds > 1 on the fused path: ONE Pallas launch
             # runs R consecutive rounds with the frontier state resident
@@ -635,7 +685,8 @@ def build_trainer(
                         deep_precision=deep_precision,
                         rounds=config.wave_loop_rounds,
                         monotone_penalty=config.monotone_penalty,
-                        interpret=jax.default_backend() == "cpu")
+                        interpret=jax.default_backend() == "cpu",
+                        packed=packed)
                     # replicate the grower's trace-time plan for the
                     # dispatch label / log line (shape statics only)
                     K_eff = max(1, min(wave_size,

@@ -119,6 +119,17 @@ _T1_REMARK_SLOW = frozenset((
     "test_wave_fused.py::test_fused_pool_free_parity",
     "test_train.py::test_weights_change_model",
     "test_parallel.py::test_parallel_matches_serial_binary[feature]",
+    # third tranche (PR 18): the packed-bin additions (~44 s) put the
+    # measured wall at 865 s / projected 853.5 s — over the 95% bar —
+    # so the next tier1_budget offenders move, again one arm per family
+    # kept (three_way_parity binary/lambdarank/dart, the golden
+    # zero_as_missing + regression training parities, the other
+    # publish-rejection and wave-loop-fallback reasons)
+    "test_params.py::test_objective_seed_changes_rank_xendcg",
+    "test_predict_engine.py::test_three_way_parity_multiclass",
+    "test_wave_fused.py::test_wave_loop_ffbynode_falls_back_with_reason",
+    "test_golden_compat.py::test_max_delta_step_training_parity",
+    "test_serve_faults.py::test_publish_rejects_nan_leaves",
 ))
 
 

@@ -44,7 +44,8 @@ def test_block_cache_roundtrip_and_edges(tmp_path, block_rows):
     path = str(tmp_path / "cache")
     manifest = write_block_cache(ds, path, block_rows=block_rows)
     assert is_block_cache(path)
-    assert manifest["format_version"] == 1
+    assert manifest["format_version"] == 3
+    assert manifest["bin_layout"] == "u8"   # default max_bin: auto -> u8
     assert manifest["num_rows"] == ds.num_data
     expect_blocks = -(-ds.num_data // block_rows)
     assert len(manifest["blocks"]) == expect_blocks
@@ -306,6 +307,123 @@ def test_host_shard_ranking_data_refused(tmp_path):
     StreamingDataset(path)          # unsharded streaming still fine
     with pytest.raises(BlockCacheError, match="ranking"):
         StreamingDataset(path, shard=(0, 2))
+
+
+# ---------------------------------------------------------------------------
+# 4-bit packed shards (format v3, ISSUE 18): packed4 caches store two bins
+# per byte — disk and H2D halve; digests cover the STORED bytes
+# ---------------------------------------------------------------------------
+
+
+def make_binned_small(n=300, f=7, seed=0, max_bin=15):
+    """A packed4-eligible dataset: num_total_bin <= 16, odd F so the
+    phantom hi-nibble tail rides every packed test."""
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, f)
+    y = (X[:, 0] > 0).astype(float)
+    ds = lgb.Dataset(X, label=y,
+                     params={"verbosity": -1, "max_bin": max_bin})
+    return ds.construct()._binned
+
+
+def test_block_cache_packed_roundtrip(tmp_path):
+    ds = make_binned_small()
+    assert ds.num_total_bin <= 16
+    path = str(tmp_path / "cache")
+    manifest = write_block_cache(ds, path, block_rows=77,
+                                 bin_layout="packed4")
+    assert manifest["format_version"] == 3
+    assert manifest["bin_layout"] == "packed4"
+    fr = -(-ds.num_features // 2)
+    for e in manifest["blocks"]:
+        assert e["nbytes"] == fr * e["rows"]    # halved bytes on disk
+    sds = StreamingDataset(path)
+    assert sds.source.bin_layout == "packed4"
+    a, b, blk = next(iter(sds.iter_blocks()))
+    assert blk.shape == (fr, b - a)             # blocks STAY packed
+    # densify restores the natural (F, N) bins bit-exactly
+    np.testing.assert_array_equal(sds.materialize().binned, ds.binned)
+
+
+def test_block_cache_packed_auto_and_ineligible(tmp_path):
+    # auto packs exactly when eligible; wide-bin data stores u8
+    m = write_block_cache(make_binned_small(), str(tmp_path / "a"),
+                          block_rows=100)
+    assert m["bin_layout"] == "packed4"
+    wide = make_binned()
+    m2 = write_block_cache(wide, str(tmp_path / "b"), block_rows=100)
+    assert m2["bin_layout"] == "u8"
+    # the storage API fails LOUDLY on an explicit ineligible ask (the
+    # config-driven refusal-with-warning lives in select_bin_layout)
+    with pytest.raises(BlockCacheError, match="4 bits"):
+        write_block_cache(wide, str(tmp_path / "c"), block_rows=100,
+                          bin_layout="packed4")
+
+
+def test_block_cache_packed_digest_corruption(tmp_path):
+    # digests cover the STORED packed bytes — a flipped nibble pair in a
+    # packed shard fails the block load, intact blocks still verify
+    ds = make_binned_small()
+    path = str(tmp_path / "cache")
+    manifest = write_block_cache(ds, path, block_rows=100,
+                                 bin_layout="packed4")
+    bp = os.path.join(path, manifest["blocks"][1]["file"])
+    raw = bytearray(open(bp, "rb").read())
+    raw[len(raw) // 2] ^= 0xFF
+    open(bp, "wb").write(bytes(raw))
+    sds = StreamingDataset(path)
+    with pytest.raises(BlockCacheError, match="digest mismatch"):
+        sds.source.load_block(1)
+    sds.source.load_block(0)
+
+
+def test_block_cache_legacy_version_warns_and_loads(tmp_path):
+    """A v2 cache (predates bin_layout) loads unchanged — implicitly u8
+    shards — with a one-line legacy warning, never an error."""
+    import json
+
+    from lightgbmv1_tpu.utils import log
+
+    ds = make_binned_small()
+    path = str(tmp_path / "cache")
+    write_block_cache(ds, path, block_rows=100, bin_layout="u8")
+    mp = os.path.join(path, "manifest.json")
+    m = json.load(open(mp))
+    m["format_version"] = 2
+    del m["bin_layout"]
+    json.dump(m, open(mp, "w"))
+    lines = []
+    old = log._level
+    log.set_verbosity(0)
+    log.register_callback(lines.append)
+    try:
+        sds = StreamingDataset(path)
+    finally:
+        log.register_callback(None)
+        log.set_verbosity(old)
+    assert any("legacy block-cache format_version 2" in ln
+               for ln in lines), lines
+    assert sds.source.bin_layout == "u8"
+    np.testing.assert_array_equal(sds.materialize().binned, ds.binned)
+
+
+def test_host_shard_packed_partition_reconstructs(tmp_path):
+    """Host-sharded streaming over PACKED shards: every rank streams its
+    contiguous packed block run; concatenating the materialized shards
+    reproduces the full natural-order matrix bit-exactly."""
+    ds = make_binned_small(n=307)
+    path = str(tmp_path / "cache")
+    write_block_cache(ds, path, block_rows=77, bin_layout="packed4")
+    world, parts, row_end = 3, [], 0
+    for rank in range(world):
+        sds = StreamingDataset(path, shard=(rank, world))
+        assert sds.source.bin_layout == "packed4"
+        assert sds.shard_row_range[0] == row_end
+        row_end = sds.shard_row_range[1]
+        parts.append(np.asarray(sds.materialize().binned))
+    assert row_end == ds.num_data
+    np.testing.assert_array_equal(np.concatenate(parts, axis=1),
+                                  ds.binned)
 
 
 def test_save_binary_newer_version_refused(tmp_path):

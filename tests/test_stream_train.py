@@ -147,6 +147,30 @@ def test_stream_block_edges_and_disk_cache(tmp_path):
     assert bst.model_to_string() == t_res
 
 
+@pytest.mark.slow    # tier-1 budget (ISSUE 18 discipline): the full
+                     # suite and every capture still run this; tier-1
+                     # keeps the packed cache roundtrip + digest pins
+                     # (test_stream_cache.py)
+def test_stream_packed_cache_training_parity(tmp_path):
+    """A packed4 block cache (format v3, ISSUE 18) trains byte-identical
+    to the resident run: packed bytes cross H2D (halved), nibbles unpack
+    on device inside the jitted block step (models/grower_stream.py)."""
+    from lightgbmv1_tpu.data import load_manifest
+
+    X, y = make_data(n=300)
+    params = {**BASE, "objective": "binary", "max_bin": 15}
+    t_res, _, _ = train_text(params, X, y, rounds=3)
+    ds = lgb.Dataset(X, label=y, params=dict(params),
+                     categorical_feature=[7])
+    cache = str(tmp_path / "blocks")
+    ds.save_block_cache(cache, block_rows=97)
+    # bin_layout=auto resolved packed4 (max_bin 15 fits the nibble)
+    assert load_manifest(cache)["bin_layout"] == "packed4"
+    bst = lgb.train(dict(params), lgb.Dataset(cache, params=dict(params)),
+                    num_boost_round=3, verbose_eval=False)
+    assert bst.model_to_string() == t_res
+
+
 def test_hist_accum_continues_resident_fold():
     """Unit pin of the parity mechanism: folding blocks into the scatter
     accumulator reproduces the resident full-matrix pass BIT-exactly at

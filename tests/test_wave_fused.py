@@ -740,3 +740,236 @@ def test_fused_feature_parallel_parity():
 def test_config_rejects_unknown_hist_method():
     with pytest.raises(ValueError, match="hist_method"):
         Config.from_dict({"objective": "binary", "hist_method": "warp"})
+
+
+# ---------------------------------------------------------------------------
+# Sub-byte bin residency (ISSUE 18): 4-bit packed bins through the fused
+# round, the persistent wave loop, and the width-specialized kernel ladder
+# ---------------------------------------------------------------------------
+
+
+_PACKED_ENGAGED = "4-bit packed bins engaged"
+
+
+def _packed_parity(over=None, problem=None, iters=3, **ds_kw):
+    """The packed contract: bin_layout=packed4 trees are byte-identical
+    to the unpacked fused AND staged paths — four texts, one string."""
+    X, y = problem if problem is not None else _binary_problem()
+    over = {"max_bin": 15, **(over or {})}
+    texts = {
+        (hm, bl): _train_text(
+            {**over, "hist_method": hm, "bin_layout": bl}, X, y,
+            iters=iters, **ds_kw)
+        for hm in ("pallas", "fused") for bl in ("u8", "packed4")}
+    ref = texts[("pallas", "u8")]
+    for key, t in texts.items():
+        assert t == ref, f"{key} diverged from staged u8 trees"
+    return ref
+
+
+def test_pack4bit_roundtrip_and_odd_tail(rng):
+    """pack/unpack inverse across even and odd F; an odd-F tail's
+    phantom hi nibble is ZERO (the inert feature the kernels pad meta
+    for) and unpack slices it away."""
+    from lightgbmv1_tpu.ops.hist_pallas import pack4bit, unpack4bit
+
+    for F in (1, 2, 7, 8):
+        a = rng.randint(0, 16, (F, 33)).astype(np.uint8)
+        p = pack4bit(a)
+        assert p.shape == (-(-F // 2), 33)
+        np.testing.assert_array_equal(unpack4bit(p, F), a)
+        np.testing.assert_array_equal(
+            np.asarray(unpack4bit(jnp.asarray(p), F)), a)
+        if F % 2:
+            np.testing.assert_array_equal(np.asarray(p[-1] >> 4),
+                                          np.zeros(33, np.uint8))
+
+
+def test_kernel_width_ladder():
+    # the histogram16/64/256 rungs: callers specialize tiling on the
+    # rung, and ONLY the <=16 rung admits nibble-packed bins
+    from lightgbmv1_tpu.ops.hist_pallas import kernel_width
+
+    assert kernel_width(2) == 16
+    assert kernel_width(16) == 16
+    assert kernel_width(17) == 64
+    assert kernel_width(64) == 64
+    assert kernel_width(65) == 256
+    assert kernel_width(256) == 256
+    with pytest.raises(ValueError, match="num_bins <= 256"):
+        kernel_width(257)
+
+
+def test_packed_parity_binary():
+    # tier-1 arm of the packed parity family, sized for the wall budget;
+    # the full-shape cells below (odd F, wave loop, multiclass, DART,
+    # int8sr, valid routing) run in the full suite and every capture
+    _packed_parity(problem=_binary_problem(n=700, f=6, seed=11), iters=2)
+
+
+@pytest.mark.slow    # tier-1 budget (ISSUE 18 discipline): the full suite,
+                     # bench measure_packed (packed_ok) and every
+                     # dryrun_multichip capture still run this
+def test_packed_parity_odd_f():
+    # odd F exercises the phantom hi-nibble feature end to end: it must
+    # be inert in the scan (never picked) and in routing
+    _packed_parity(problem=_binary_problem(n=1000, f=7, seed=2))
+
+
+@pytest.mark.slow    # tier-1 budget (ISSUE 18 discipline): the full suite,
+                     # bench measure_packed (packed_ok) and every
+                     # dryrun_multichip capture still run this
+def test_packed_wave_loop_parity_r4():
+    # the packed matrix stays resident across R in-VMEM rounds: the loop
+    # kernel's decision lane decodes nibbles per round
+    _packed_parity({"wave_loop_rounds": 4}, problem=_loop_problem(),
+                   iters=2)
+
+
+@pytest.mark.slow    # tier-1 budget (ISSUE 18 discipline): the full suite,
+                     # bench measure_packed (packed_ok) and every
+                     # dryrun_multichip capture still run this
+def test_packed_wave_loop_parity_odd_f():
+    _packed_parity({"wave_loop_rounds": 4},
+                   problem=_binary_problem(n=1000, f=7, seed=2), iters=2)
+
+
+@pytest.mark.slow    # tier-1 budget (ISSUE 18 discipline): the full suite,
+                     # bench measure_packed (packed_ok) and every
+                     # dryrun_multichip capture still run this
+def test_packed_parity_multiclass():
+    rng = np.random.RandomState(3)
+    n, f, k = 1200, 6, 3
+    X = rng.randn(n, f)
+    y = np.clip((np.abs(X[:, 0]) + X[:, 1] > 1).astype(np.float64)
+                + (X[:, 2] > 0.3).astype(np.float64), 0, k - 1)
+
+    def text(over):
+        cfg = Config.from_dict({
+            "objective": "multiclass", "num_class": k, "num_leaves": 15,
+            "min_data_in_leaf": 5, "verbosity": -1, "max_bin": 15,
+            "tree_growth": "leafwise", "leafwise_wave_size": 4,
+            "metric": "multi_logloss", **over})
+        ds = BinnedDataset.from_numpy(X, label=y, config=cfg)
+        gb = create_boosting(cfg, ds)
+        for _ in range(2):
+            gb.train_one_iter(check_stop=False)
+        return model_to_string(
+            gb.materialize_host_trees(),
+            objective_string=_objective_string(cfg), num_class=k,
+            num_tree_per_iteration=k,
+            feature_names=list(ds.feature_names),
+            feature_infos=ds.feature_infos())
+
+    ref = text({"hist_method": "pallas"})
+    assert ref == text({"hist_method": "fused", "bin_layout": "packed4"})
+    assert ref == text({"hist_method": "pallas", "bin_layout": "packed4"})
+
+
+@pytest.mark.slow    # tier-1 budget (ISSUE 18 discipline): the full suite,
+                     # bench measure_packed (packed_ok) and every
+                     # dryrun_multichip capture still run this
+def test_packed_parity_dart():
+    _packed_parity({"boosting": "dart", "drop_rate": 0.3,
+                    "drop_seed": 5}, iters=4)
+
+
+@pytest.mark.slow    # tier-1 budget (ISSUE 18 discipline): the full suite,
+                     # bench measure_packed (packed_ok) and every
+                     # dryrun_multichip capture still run this
+def test_packed_parity_int8sr(monkeypatch):
+    # the quantized lane consumes the UNPACKED VMEM view — the same
+    # sr_quantize_g3 stream, so packed int8sr == unpacked int8sr
+    monkeypatch.setattr(gw, "_BUCKET_MIN_N", 1)
+    _packed_parity({"num_leaves": 48, "leafwise_wave_size": 32,
+                    "hist_dtype_deep": "int8sr"},
+                   problem=_binary_problem(n=1600), iters=2)
+
+
+@pytest.mark.slow    # tier-1 budget (ISSUE 18 discipline): the full suite,
+                     # bench measure_packed (packed_ok) and every
+                     # dryrun_multichip capture still run this
+def test_packed_valid_routing_parity():
+    """Valid rows route through the packed decision lane (nibble decode
+    in decision_bins / the loop kernel): valid METRICS and trees must
+    be bit-equal across layouts AND vs the staged path."""
+    X, y = _binary_problem()
+    Xv, yv = _valid_problem()
+    for extra in ({}, {"wave_loop_rounds": 4}):
+        over = {"max_bin": 15, **extra}
+        t_s, ev_s = _train_with_valid(
+            {**over, "hist_method": "pallas"}, X, y, Xv, yv)
+        t_u, ev_u = _train_with_valid(
+            {**over, "hist_method": "fused"}, X, y, Xv, yv)
+        t_p, ev_p = _train_with_valid(
+            {**over, "hist_method": "fused", "bin_layout": "packed4"},
+            X, y, Xv, yv)
+        assert t_s == t_u == t_p, f"trees diverged ({extra})"
+        assert ev_s == ev_u == ev_p, f"valid metrics diverged ({extra})"
+
+
+@pytest.mark.slow    # tier-1 budget (ISSUE 18 discipline): the full suite,
+                     # bench measure_packed (packed_ok) and every
+                     # dryrun_multichip capture still run this
+def test_packed_num_bins_boundary():
+    """num_bins 15/16 fit a nibble (no refusal, trees bit-equal to
+    unpacked); 17 exceeds 4 bits — an explicit packed4 falls back to u8
+    with the staged warning and trains unpacked."""
+    X, y = _binary_problem()
+    for mb in (15, 16):
+        texts = {}
+        lines = _warnings(lambda: texts.update(
+            (bl, _train_text({"hist_method": "fused", "max_bin": mb,
+                              "bin_layout": bl, "verbosity": 0},
+                             X, y, iters=2))
+            for bl in ("u8", "packed4")))
+        assert not any("storing u8 bins" in ln for ln in lines), (mb, lines)
+        assert texts["u8"] == texts["packed4"], f"max_bin={mb} diverged"
+    lines = _warnings(lambda: _train_text(
+        {"hist_method": "fused", "max_bin": 17, "bin_layout": "packed4",
+         "verbosity": 0}, X, y, iters=1))
+    assert any("needs more than 4 bits" in ln
+               and "storing u8 bins" in ln for ln in lines), lines
+
+
+def test_packed_engagement_logged_once():
+    X, y = _binary_problem()
+    lines = _warnings(lambda: _train_text(
+        {"hist_method": "fused", "bin_layout": "packed4", "max_bin": 15,
+         "verbosity": 1}, X, y, iters=3))
+    hits = [ln for ln in lines if _PACKED_ENGAGED in ln]
+    assert len(hits) == 1, lines
+
+
+def test_packed_refused_by_gpu_use_dp():
+    # gpu_use_dp pins the double-precision staged lane — packed4 refuses
+    # with the staged warning and the run proceeds on u8 bins
+    X, y = _binary_problem()
+    lines = _warnings(lambda: _train_text(
+        {"hist_method": "fused", "bin_layout": "packed4", "max_bin": 15,
+         "gpu_use_dp": True, "verbosity": 0}, X, y, iters=1))
+    assert any("gpu_use_dp" in ln and "storing u8 bins" in ln
+               for ln in lines), lines
+
+
+@pytest.mark.slow    # tier-1 budget (ISSUE 18 discipline): the full suite,
+                     # bench measure_packed (packed_ok) and every
+                     # dryrun_multichip capture still run this
+def test_packed_auto_engages_and_auto_refuses():
+    # bin_layout=auto packs exactly when eligible: engagement info at
+    # max_bin<=15, SILENT u8 fallback above (no staged warning — the
+    # user never asked for packing)
+    X, y = _binary_problem()
+    lines = _warnings(lambda: _train_text(
+        {"hist_method": "fused", "max_bin": 15, "verbosity": 1},
+        X, y, iters=1))
+    assert any(_PACKED_ENGAGED in ln for ln in lines), lines
+    lines = _warnings(lambda: _train_text(
+        {"hist_method": "fused", "max_bin": 63, "verbosity": 0},
+        X, y, iters=1))
+    assert not any("storing u8 bins" in ln for ln in lines), lines
+
+
+def test_config_rejects_unknown_bin_layout():
+    with pytest.raises(ValueError, match="bin_layout"):
+        Config.from_dict({"objective": "binary", "bin_layout": "packed2"})

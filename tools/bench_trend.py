@@ -107,6 +107,12 @@ WATCHED: Tuple[Tuple[str, str, float], ...] = (
     # state; fused_loop_ok / fused_loop_parity_ok are booleans the
     # guard sweep flags automatically
     ("phase_wave_loop_ms", "down", 0.10),
+    # sub-byte bin residency (ISSUE 18): the per-round packed binned
+    # read in bytes — analytic ceil(F/2) * N, so ANY upward move means
+    # the packed layout stopped engaging at the bench config; packed_ok
+    # / packed_parity_ok are booleans the guard sweep flags
+    # automatically
+    ("packed_binned_bytes", "down", 0.10),
     # model-quality & drift (ISSUE 14): the skew-injection probe's
     # detection magnitude is deterministic (same shift, same shape) —
     # a capture where the injected PSI collapses means the detector

@@ -298,6 +298,11 @@ def run_flip_rehearsal(records_dir: str = ROOT, iters: int = 3,
         "dart": {"objective": "binary", "boosting": "dart",
                  "drop_rate": 0.5},
         "wave_loop": {"objective": "binary", "wave_loop_rounds": 4},
+        # sub-byte residency (ISSUE 18): the packed fused run's twin is
+        # the staged UNPACKED run — the flip must hold across the layout
+        # change, not just the scheduling change
+        "packed4": {"objective": "binary", "max_bin": 15,
+                    "bin_layout": "packed4"},
     }
     base = {"num_leaves": 31, "max_bin": 63, "min_data_in_leaf": 5,
             "verbosity": -1, "seed": 5, "tree_growth": "leafwise",
@@ -314,8 +319,10 @@ def run_flip_rehearsal(records_dir: str = ROOT, iters: int = 3,
     for name, over in battery.items():
         label = y_mc if name == "multiclass" else y_bin
         flip = text({**base, **over, **FLIP_DEFAULTS}, label)
-        staged = text({**base, **over, **FLIP_DEFAULTS,
-                       "hist_method": "pallas"}, label)
+        twin = {"hist_method": "pallas"}
+        if name == "packed4":
+            twin["bin_layout"] = "u8"
+        staged = text({**base, **over, **FLIP_DEFAULTS, **twin}, label)
         same = bool(flip == staged)
         summary["parity"][name] = same
         parity_ok &= same

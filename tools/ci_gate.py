@@ -69,7 +69,7 @@ import tier1_budget  # noqa: E402
 REQUIRED_GUARDS = ("obs_ok", "slo_ok", "forensics_ok", "chaos_ok",
                    "fleet_ok", "chaos_fleet_ok", "obs_device_ok",
                    "fused_ok", "drift_ok", "fused_round_ok",
-                   "hier_comm_ok", "fused_loop_ok")
+                   "hier_comm_ok", "fused_loop_ok", "packed_ok")
 
 
 def check_required_guards(records_dir: str, guards, out=print) -> bool:
