@@ -721,7 +721,7 @@ class Booster:
         method = str(kwargs.get("predict_method",
                                 self.params.get("predict_method", "auto")))
         raw = None
-        if method in ("depthwise", "pallas", "scan") and trees \
+        if method in ("depthwise", "pallas", "fused", "scan") and trees \
                 and not pred_contrib and not (es and not raw_score):
             bp = self._device_predictor(trees, K, start_iteration, method,
                                         kwargs)
@@ -849,6 +849,7 @@ class Booster:
             bp = BatchPredictor(
                 trees, K, self.num_feature(), method=method,
                 prebin=str(p("predict_prebin", "auto")),
+                code_layout=str(p("predict_code_layout", "auto")),
                 num_shards=int(p("predict_num_shards", 0)),
                 bucket_min=int(p("predict_bucket_min", 256)),
                 chunk_rows=int(p("predict_chunk_rows", 131072)),

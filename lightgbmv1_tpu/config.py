@@ -467,8 +467,13 @@ class Config:
     # "native"/"host" force those; "depthwise" is the depth-stepped
     # all-trees device walk; "pallas" pins the node tables in VMEM
     # (ops/predict_pallas.py, falls back to depthwise if Mosaic cannot
-    # lower on the backend); "scan" is the legacy per-tree scan walk,
-    # kept as the bit-parity pin.
+    # lower on the backend); "fused" is the serving megakernel — one
+    # Pallas pass per row tile walks every tree AND accumulates the
+    # per-class scores in VMEM (plan_predict_tiles tiles the node
+    # tables when they exceed the VMEM budget; staged fallback with a
+    # logged reason when the planner refuses or Mosaic cannot lower);
+    # "scan" is the legacy per-tree scan walk, kept as the bit-parity
+    # pin.
     predict_method: str = "auto"
     # prebinned serving codes (uint8/uint16) for the device walks: "auto"
     # = on whenever the ensemble's thresholds admit an EXACT serving
@@ -476,6 +481,13 @@ class Config:
     # walk; "on"/"off" force it (on falls back with a warning when
     # exactness is impossible)
     predict_prebin: str = "auto"
+    # serving-code transport width: "auto" packs two 4-bit codes per
+    # byte for predict_method=fused whenever every feature's serving
+    # binner fits 16 codes (reserved NaN/zero included), halving the
+    # H2D bytes per row; "packed4" forces packing for any prebinned
+    # device walk (refused with a warning when a feature needs more
+    # than 16 codes); "u8" keeps the byte-wide codes.
+    predict_code_layout: str = "auto"
     predict_bucket_min: int = 256   # smallest power-of-two row bucket of
                                     # the predictor's compile cache
     predict_chunk_rows: int = 131072  # streaming chunk: bounds device
@@ -787,14 +799,19 @@ class Config:
                              "(modeled link bandwidths of the "
                              "hierarchical collective's comm table)")
         if self.predict_method not in (
-                "auto", "native", "host", "depthwise", "pallas", "scan"):
+                "auto", "native", "host", "depthwise", "pallas", "fused",
+                "scan"):
             raise ValueError(
                 f"predict_method={self.predict_method!r}: expected auto | "
-                "native | host | depthwise | pallas | scan")
+                "native | host | depthwise | pallas | fused | scan")
         if self.predict_prebin not in ("auto", "on", "off"):
             raise ValueError(
                 f"predict_prebin={self.predict_prebin!r}: expected "
                 "auto | on | off")
+        if self.predict_code_layout not in ("auto", "u8", "packed4"):
+            raise ValueError(
+                f"predict_code_layout={self.predict_code_layout!r}: "
+                "expected auto | u8 | packed4")
         if self.serve_max_batch_rows < 1:
             raise ValueError("serve_max_batch_rows must be >= 1")
         if self.serve_max_batch_delay_ms < 0:

@@ -35,6 +35,15 @@ Three layers:
   chunks with the next chunk's H2D issued before the current chunk's walk
   is consumed (double buffering via JAX's async dispatch).
 
+* **Serving megakernel** (``predict_method=fused``,
+  ops/predict_pallas.serving_fused_pallas) — one Pallas launch per row
+  tile walks every tree AND accumulates the per-class scores in VMEM;
+  ``plan_predict_tiles`` tiles oversized ensembles into VMEM-sized tree
+  groups, and with <= 15 serving codes per feature the codes ship 4-bit
+  PACKED (two per byte), halving the H2D stream.  Node-exactness is
+  pinned against the staged walk on the CPU interpret lane; a Mosaic
+  lowering failure falls back to the staged walk, warned ONCE.
+
 Row-sharded multi-chip serving reuses the training mesh helpers
 (`parallel/cluster.make_mesh` + `parallel/trainer.shard_rows`): rows are
 split over the mesh, the model is replicated, and no collective runs at
@@ -52,12 +61,65 @@ import numpy as np
 from ..io.binning import K_ZERO_THRESHOLD, MISSING_NAN, MISSING_ZERO
 from ..obs import xla as obs_xla
 from ..utils import faults
-from ..utils.log import log_warning
+from ..utils.log import log_info, log_warning
 from .tree import HostTree, host_tree_depth, validate_host_tree
 
 # widest raw category representable as a serving bitset (same bar as the
 # native predictor pack, native/__init__.py build_ensemble_pack)
 _MAX_CAT_BITSET = 1 << 22
+
+# process-wide log-once keys (the select_bin_layout engage/refuse idiom):
+# a chunked streaming predict hits the same fallback on every chunk and
+# a server rebuilds predictors per publish — the reason is logged once
+_logged_once: set = set()
+
+
+def _log_once(key: str, msg: str, warn: bool = False) -> None:
+    if key in _logged_once:
+        return
+    _logged_once.add(key)
+    (log_warning if warn else log_info)(msg)
+
+
+def pack_serving_codes(codes: np.ndarray) -> np.ndarray:
+    """(N, F) serving codes <= 15 -> (N, ceil(F/2)) packed bytes, two
+    features per byte in the ops/hist_pallas.pack4bit nibble layout (lo
+    nibble = even feature 2p, hi = 2p+1) — halves the serving H2D
+    payload and the kernel's per-tile code footprint."""
+    codes = np.asarray(codes, np.uint8)
+    n, f = codes.shape
+    if f % 2:
+        codes = np.concatenate([codes, np.zeros((n, 1), np.uint8)], axis=1)
+    return (codes[:, 0::2] | (codes[:, 1::2] << 4)).astype(np.uint8)
+
+
+def unpack_serving_codes(packed, num_features: int):
+    """``pack_serving_codes``'s inverse, numpy or jnp — the staged
+    fallback unpacks ON DEVICE so packed H2D transport still pays off
+    when the fused kernel refuses or fails to lower."""
+    import jax
+    import jax.numpy as jnp
+
+    xp = jnp if isinstance(packed, jax.Array) else np
+    lo = packed & 15
+    hi = packed >> 4
+    un = xp.stack([lo, hi], axis=2).reshape(packed.shape[0], -1)
+    return un[:, :num_features].astype(xp.uint8)
+
+
+def _transform_scores(s, transform):
+    """The objective epilogue (None | 'sigmoid' | 'softmax') applied
+    OUTSIDE the megakernel — the staged path's equivalent of the fused
+    kernel's in-launch epilogue (same f32 math)."""
+    if transform is None:
+        return s
+    import jax.numpy as jnp
+
+    if transform == "sigmoid":
+        return 1.0 / (1.0 + jnp.exp(-s))
+    m = jnp.max(s, axis=1, keepdims=True)
+    e = jnp.exp(s - m)
+    return e / jnp.sum(e, axis=1, keepdims=True)
 
 
 class ServingArrays(NamedTuple):
@@ -112,6 +174,12 @@ class ServingBinner:
     dtype: Any                        # np.uint8 | np.uint16 | np.int32
     ok: bool = True
     why_not: str = ""
+
+    @property
+    def packed_ok(self) -> bool:
+        """4-bit packed serving codes are exact when every code —
+        including the two reserved NaN/zero codes — fits a nibble."""
+        return bool(self.ok and self.nan_code <= 15)
 
     def prebin(self, X: np.ndarray) -> np.ndarray:
         """(N, F) float -> (N, F) serving codes.  Float64 exact."""
@@ -421,16 +489,19 @@ class BatchPredictor:
 
     def __init__(self, trees: List[HostTree], K: int, num_features: int, *,
                  method: str = "depthwise", prebin: str = "auto",
-                 num_shards: int = 0, bucket_min: int = 256,
-                 chunk_rows: int = 1 << 17, interpret: Optional[bool] = None,
-                 cache_entries: int = 64):
+                 code_layout: str = "auto", num_shards: int = 0,
+                 bucket_min: int = 256, chunk_rows: int = 1 << 17,
+                 interpret: Optional[bool] = None, cache_entries: int = 64):
         import jax
 
         if not trees:
             raise ValueError("BatchPredictor needs at least one tree")
-        if method not in ("depthwise", "pallas", "scan"):
+        if method not in ("depthwise", "pallas", "scan", "fused"):
             raise ValueError(f"predict_method={method!r}: expected "
-                             "depthwise | pallas | scan")
+                             "depthwise | pallas | scan | fused")
+        if code_layout not in ("auto", "u8", "packed4"):
+            raise ValueError(f"predict_code_layout={code_layout!r}: "
+                             "expected auto | u8 | packed4")
         self.K = max(int(K), 1)
         self.T = len(trees)
         self.F = int(num_features)
@@ -460,6 +531,32 @@ class BatchPredictor:
                         f"be exact ({self.binner.why_not}); using the raw "
                         "walk")
             self.prebin = False
+        # -- 4-bit packed serving codes (the select_bin_layout engage/
+        # refuse contract): "auto" engages exactly when eligible AND the
+        # fused kernel consumes nibbles directly; an explicit "packed4"
+        # engages on any prebinned walk (the staged path unpacks ON
+        # DEVICE, keeping the halved H2D) or refuses with one reason
+        self.code_layout = code_layout
+        packed_able = bool(self.prebin and self.binner.packed_ok
+                           and method != "scan")
+        if code_layout == "packed4":
+            if packed_able:
+                self.packed = True
+                _log_once("packed4:on",
+                          "predict_code_layout=packed4: serving codes "
+                          "packed two per byte")
+            else:
+                reason = (f"{self.binner.nan_code + 1} serving codes "
+                          "exceed the 16 nibble values"
+                          if self.prebin and self.binner.ok
+                          else "prebinned serving codes not in play")
+                _log_once(f"packed4:refuse:{reason}",
+                          f"predict_code_layout=packed4: {reason}; "
+                          "storing unpacked codes", warn=True)
+                self.packed = False
+        else:
+            self.packed = bool(code_layout == "auto" and method == "fused"
+                               and packed_able)
         # float64 leaf table for exact score reconstruction (the native
         # predictor / HostTree accumulate f64 in tree order)
         self._leaf_value64 = np.zeros((self.T, self.arrays.leaf_value.shape[1]),
@@ -488,6 +585,30 @@ class BatchPredictor:
         self.cache_evictions = 0
         self._scan_stacked = None
         self._pallas_broken = False
+        # -- serving-megakernel plan (static, recorded in BENCH): tiles
+        # trees into VMEM-sized groups; refusal = staged walk + one
+        # honest reason line
+        self.fused_plan = None
+        self._fused_tables = None
+        self._fused_broken = False
+        if method == "fused":
+            from ..ops.predict_pallas import plan_predict_tiles
+
+            self.fused_plan = plan_predict_tiles(
+                T=self.T, L1=self.arrays.split_feature.shape[1],
+                L=self.arrays.leaf_value.shape[1], F=self.F, K=self.K,
+                depth=self.depth, has_cat=self.has_cat,
+                prebin=self.prebin, packed=self.packed)
+            if self.fused_plan["eligible"]:
+                from .tree import pad_tree_axis
+
+                self._fused_tables = pad_tree_axis(
+                    self.arrays, self.fused_plan["t_pad"])
+            else:
+                _log_once("fused:refuse:" + self.fused_plan["reason"],
+                          f"predict_method=fused: "
+                          f"{self.fused_plan['reason']}; serving the "
+                          "staged depth-stepped walk", warn=True)
 
     # -- cache ----------------------------------------------------------
     def bucket_for(self, n: int) -> int:
@@ -541,9 +662,12 @@ class BatchPredictor:
         method, prebin = self.method, self.prebin
         depth, has_cat = self.depth, self.has_cat
         zc, nc = self.binner.zero_code, self.binner.nan_code
+        packed, F = self.packed, self.F
 
         def walk(arrays, xb):
             self.trace_count += 1        # trace-time side effect only
+            if packed:
+                xb = unpack_serving_codes(xb, F)
             if method == "pallas" and prebin and not has_cat:
                 from ..ops.predict_pallas import serving_leaf_pallas
 
@@ -571,7 +695,9 @@ class BatchPredictor:
     def _pallas_guard(self, jfn, bucket):
         """First-call fallback: if the Pallas kernel fails to lower on
         this backend, swap in the pure-XLA walk (the bit-parity pin) for
-        every subsequent call."""
+        every subsequent call.  The warning is deduplicated process-wide
+        (``_log_once``): a chunked streaming predict previously re-logged
+        it per chunk."""
 
         def guarded(arrays, xb):
             if self._pallas_broken:
@@ -579,9 +705,10 @@ class BatchPredictor:
             try:
                 return jfn(arrays, xb)
             except Exception as e:  # noqa: BLE001 — Mosaic lowering gap
-                log_warning(f"predict_method=pallas failed to lower "
-                            f"({type(e).__name__}); falling back to the "
-                            "XLA depth-stepped walk")
+                _log_once(f"pallas:lower:{type(e).__name__}",
+                          f"predict_method=pallas failed to lower "
+                          f"({type(e).__name__}); falling back to the "
+                          "XLA depth-stepped walk", warn=True)
                 self._pallas_broken = True
                 return self._xla_fallback(bucket)(arrays, xb)
 
@@ -596,10 +723,12 @@ class BatchPredictor:
 
         depth, has_cat = self.depth, self.has_cat
         zc, nc = self.binner.zero_code, self.binner.nan_code
-        prebin = self.prebin
+        prebin, packed, F = self.prebin, self.packed, self.F
 
         def walk(arrays, xb):
             self.trace_count += 1
+            if packed:
+                xb = unpack_serving_codes(xb, F)
             if prebin:
                 return serving_leaf_binned(arrays, xb, depth, zc, nc,
                                            has_cat)
@@ -612,6 +741,82 @@ class BatchPredictor:
             fn = shard_rows(walk, self._mesh, "rows", n_replicated=1)
         return self._cache_put(key, obs_xla.instrument_jit(
             fn, "predict.leaf"))
+
+    # -- serving megakernel (predict_method=fused) -----------------------
+    def _fused_engaged(self) -> bool:
+        return bool(self.method == "fused" and self.fused_plan is not None
+                    and self.fused_plan["eligible"]
+                    and not self._fused_broken)
+
+    def _fused_walk(self, mode: str = "scores", transform=None):
+        """The raw (unjitted) megakernel call for one bucket — exposed
+        separately so bench.py can ``jax.jit(...).lower()`` it for the
+        single-read ``cost_analysis`` contract."""
+        from ..ops.predict_pallas import serving_fused_pallas
+
+        depth, K, T = self.depth, self.K, self.T
+        zc, nc = self.binner.zero_code, self.binner.nan_code
+        packed, interpret = self.packed, self.interpret
+        tree_tile = self.fused_plan["tree_tile"]
+
+        def walk(tables, xb):
+            self.trace_count += 1
+            out = serving_fused_pallas(
+                tables, xb, n_steps=depth, zero_code=zc, nan_code=nc,
+                K=K, tree_tile=tree_tile, mode=mode, packed=packed,
+                transform=transform, interpret=interpret)
+            if mode == "leaf":
+                out = out[:, :T]      # slice the tree-tile pad away
+            return out
+
+        return walk
+
+    def _fused_fn(self, bucket: int, mode: str = "scores", transform=None):
+        """Compiled megakernel per (bucket, output kind): leaves for the
+        node-exact / f64 lane, (N, K) scores — optionally with the
+        in-launch sigmoid/softmax epilogue — for the fast lane."""
+        kind = ("fused_leaf" if mode == "leaf"
+                else f"fused:{transform or 'raw'}")
+        key = (bucket, kind)
+        cached = self._cache_get(key)
+        if cached is not None:
+            return cached
+        fn = self._fused_walk(mode=mode, transform=transform)
+        if self._mesh is not None:
+            from ..parallel.trainer import shard_rows
+
+            fn = shard_rows(fn, self._mesh, "rows", n_replicated=1)
+        jfn = obs_xla.instrument_jit(fn, "predict.fused")
+        return self._cache_put(
+            key, self._fused_guard(jfn, bucket, mode, transform))
+
+    def _fused_guard(self, jfn, bucket, mode, transform):
+        """Mosaic probe for the megakernel: a lowering failure swaps in
+        the staged walk (+ the out-of-kernel epilogue) for every
+        subsequent call, warned ONCE process-wide — the chunked stream
+        must not re-log per chunk."""
+
+        def staged(xb):
+            leaf = self._xla_fallback(bucket)(self.arrays, xb)
+            if mode == "leaf":
+                return leaf
+            s = self._scores_fn(bucket)(self.arrays.leaf_value, leaf)
+            return _transform_scores(s, transform)
+
+        def guarded(tables, xb):
+            if self._fused_broken:
+                return staged(xb)
+            try:
+                return jfn(tables, xb)
+            except Exception as e:  # noqa: BLE001 — Mosaic lowering gap
+                _log_once(f"fused:lower:{type(e).__name__}",
+                          f"predict_method=fused failed to lower "
+                          f"({type(e).__name__}); falling back to the "
+                          "staged depth-stepped walk", warn=True)
+                self._fused_broken = True
+                return staged(xb)
+
+        return guarded
 
     def _scan_fn(self, bucket: int):
         """The parity-pin scan walk (models/tree.ensemble_predict_raw) as
@@ -639,9 +844,13 @@ class BatchPredictor:
     # -- host <-> device ------------------------------------------------
     def encode(self, X: np.ndarray) -> np.ndarray:
         """Host-side input encoding for the device walk: prebinned codes
-        (uint8/uint16) or f32 raw features."""
+        (uint8/uint16, or 4-bit packed bytes when the nibble layout is
+        engaged) or f32 raw features."""
         if self.prebin:
-            return self.binner.prebin(X)
+            codes = self.binner.prebin(X)
+            if self.packed:
+                return pack_serving_codes(codes)
+            return codes
         return np.asarray(X, np.float32)
 
     def _pad(self, enc: np.ndarray, bucket: int) -> np.ndarray:
@@ -669,7 +878,12 @@ class BatchPredictor:
             # serving retry loop must absorb it
             faults.fire("h2d", site="predict_leaf")
             self.call_count += 1
-            leaf = self._leaf_fn(bucket)(self.arrays, jax.numpy.asarray(enc))
+            if self._fused_engaged():
+                leaf = self._fused_fn(bucket, mode="leaf")(
+                    self._fused_tables, jax.numpy.asarray(enc))
+            else:
+                leaf = self._leaf_fn(bucket)(self.arrays,
+                                             jax.numpy.asarray(enc))
             outs.append(jax.device_get(leaf)[: chunk.shape[0]])
         return np.concatenate(outs, axis=0)
 
@@ -716,9 +930,48 @@ class BatchPredictor:
                 nxt_dev = (jax.device_put(
                     self._pad(self.encode(chunks[i + 1]), nb)), nb)
             self.call_count += 1
-            leaf = self._leaf_fn(bucket)(self.arrays, enc_dev)
-            scores = self._scores_fn(bucket)(self.arrays.leaf_value, leaf)
+            if self._fused_engaged():
+                # one launch: walk + accumulate, no (N, T) intermediate
+                scores = self._fused_fn(bucket)(self._fused_tables,
+                                                enc_dev)
+            else:
+                leaf = self._leaf_fn(bucket)(self.arrays, enc_dev)
+                scores = self._scores_fn(bucket)(self.arrays.leaf_value,
+                                                 leaf)
             pending.append((scores, chunk.shape[0]))
+        return np.concatenate(
+            [np.asarray(jax.device_get(s))[:m] for s, m in pending], axis=0)
+
+    def predict_scores(self, X: np.ndarray, transform=None,
+                       chunk_rows: Optional[int] = None) -> np.ndarray:
+        """(N, K) scores with the optional objective epilogue
+        (``transform``: None | 'sigmoid' | 'softmax').  When the
+        megakernel is engaged the transform runs IN-KERNEL on the VMEM
+        accumulator — the whole request is one launch; otherwise it is
+        applied after the staged walk's score sum (same f32 math, one
+        extra elementwise pass)."""
+        import jax
+        import jax.numpy as jnp
+
+        if transform not in (None, "sigmoid", "softmax"):
+            raise ValueError(f"transform={transform!r}: expected None | "
+                             "sigmoid | softmax")
+        X = np.asarray(X)
+        if not self._fused_engaged():
+            raw = jnp.asarray(self.predict_raw(X, chunk_rows=chunk_rows))
+            return np.asarray(jax.device_get(
+                _transform_scores(raw, transform)))
+        n = X.shape[0]
+        chunk_rows = chunk_rows or self.chunk_rows
+        pending = []
+        for lo in range(0, n, chunk_rows):
+            chunk = X[lo: lo + chunk_rows]
+            bucket = self.bucket_for(chunk.shape[0])
+            enc_dev = jnp.asarray(self._pad(self.encode(chunk), bucket))
+            self.call_count += 1
+            s = self._fused_fn(bucket, transform=transform)(
+                self._fused_tables, enc_dev)
+            pending.append((s, chunk.shape[0]))
         return np.concatenate(
             [np.asarray(jax.device_get(s))[:m] for s, m in pending], axis=0)
 
@@ -786,7 +1039,10 @@ class BatchPredictor:
 
     def h2d_bytes(self, n_rows: int) -> int:
         """Host->device payload of one batch (the prebinned path's 4-8x
-        shrink is the point; recorded by bench.py / dryrun_multichip)."""
+        shrink is the point; packed nibble codes halve it again —
+        recorded by bench.py / dryrun_multichip)."""
+        if self.prebin and self.packed:
+            return int(n_rows) * (-(-self.F // 2))
         itemsize = (np.dtype(self.binner.dtype).itemsize if self.prebin
                     else 4)
         return int(n_rows) * self.F * itemsize

@@ -380,6 +380,26 @@ def leaves_to_scores(leaf_value: jax.Array, leaf: jax.Array,
     return vals.reshape(N, T // K, K).sum(axis=1)
 
 
+def pad_tree_axis(tables, t_pad: int):
+    """Zero-pad every stacked (T, ...) table along the TREE axis to
+    ``t_pad`` trees — the fused serving kernel's tile slicing
+    (ops/predict_pallas.serving_fused_pallas) needs the tree axis to be
+    a multiple of the planner's tree tile.  A zero-padded tree has
+    ``num_leaves == 0``, so the walks park it on leaf 0 whose value is
+    0.0: scores are unchanged and leaf-mode callers slice the pad away.
+    Works on any NamedTuple of stacked arrays whose leading axis is T
+    (ServingArrays, TreeArrays)."""
+    T = int(tables.num_leaves.shape[0])
+    if t_pad < T:
+        raise ValueError(f"t_pad={t_pad} < T={T}")
+    if t_pad == T:
+        return tables
+    pad = t_pad - T
+    return type(tables)(*(
+        jnp.pad(a, [(0, pad)] + [(0, 0)] * (a.ndim - 1))
+        for a in tables))
+
+
 def validate_host_tree(t, index: int = -1) -> None:
     """Child-pointer structural validation (cycle / out-of-range /
     reconvergence / unreachable-leaf detection).  A malformed model file

@@ -346,17 +346,23 @@ def publish_comm_metrics(learner: str, table: dict) -> None:
 
 
 def predict_comm_table(n_rows: int, num_features: int, ndev: int, *,
-                       itemsize: int = 4, K: int = 1) -> dict:
+                       itemsize: int = 4, K: int = 1,
+                       bytes_per_row: Optional[int] = None) -> dict:
     """Per-device payloads of one row-sharded predict batch (the serving
     analog of ``comm_table_per_round``): inference is embarrassingly
     parallel — NO collective runs at all — so the only traffic is the H2D
     of each chip's row shard (``itemsize`` 1 for uint8 serving codes, 2
     for uint16, 4 for raw f32 — the prebinned path's 4x HBM shrink shows
-    up here) and the D2H of its (rows, K) scores.  Recorded into the
-    MULTICHIP record by tools/dryrun_multichip."""
+    up here) and the D2H of its (rows, K) scores.  ``bytes_per_row``
+    overrides the ``num_features * itemsize`` product for transports no
+    integer itemsize expresses — the 4-bit packed serving codes ship
+    ``ceil(F / 2)`` bytes per row (BatchPredictor.h2d_bytes(1)).
+    Recorded into the MULTICHIP record by tools/dryrun_multichip."""
     rows = -(-int(n_rows) // max(int(ndev), 1))
+    per_row = (int(bytes_per_row) if bytes_per_row is not None
+               else int(num_features) * int(itemsize))
     return {
-        "h2d_bytes": rows * int(num_features) * int(itemsize),
+        "h2d_bytes": rows * per_row,
         "d2h_bytes": rows * int(K) * 4,
         "collective_bytes": 0,
     }

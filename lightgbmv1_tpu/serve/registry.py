@@ -184,7 +184,12 @@ class ModelRegistry:
         the host-tree oracle BIT-EXACTLY (f64 reconstruction path, the
         PR 4 parity contract) on a seeded batch of random rows.  Catches
         what structural checks cannot: a mis-stacked serving table, a
-        broken binner, a miscompiled walk."""
+        broken binner, a miscompiled walk.  Probes BOTH lanes when they
+        differ: the f64 reconstruction path must be bit-exact, and the
+        fast f32 serving lane (the fused megakernel when
+        ``predictor_kwargs={"method": "fused"}``) must agree to f32
+        round-off — a fused walk that silently fell back or mis-tiled
+        fails here, before the swap."""
         rng = np.random.RandomState(0xC0FFEE ^ (len(trees) * 2654435761
                                                 & 0x7FFFFFFF))
         Xp = rng.randn(int(probe_rows), F)
@@ -197,6 +202,13 @@ class ModelRegistry:
                 f"{mv.tag}: golden-probe mismatch — device predictor "
                 "diverges from the host-tree oracle on "
                 f"{int(probe_rows)} probe rows")
+        got32 = np.asarray(mv.predictor.predict_raw(Xp), np.float64)
+        if got32.shape != want.shape or not np.allclose(
+                got32, want, rtol=1e-4, atol=1e-5):
+            raise PublishValidationError(
+                f"{mv.tag}: golden-probe mismatch — fast f32 serving "
+                "lane diverges from the host-tree oracle beyond f32 "
+                f"round-off on {int(probe_rows)} probe rows")
 
     # -- public API ------------------------------------------------------
     def prepare(self, model, *, degrade_trees: int = 0,

@@ -726,6 +726,10 @@ def serve_config_from(config) -> ServeConfig:
         predictor_kwargs={
             "bucket_min": config.predict_bucket_min,
             "cache_entries": config.predict_cache_entries,
+            **({"method": config.predict_method}
+               if config.predict_method in ("depthwise", "pallas",
+                                            "fused", "scan") else {}),
+            "code_layout": config.predict_code_layout,
         },
     )
 

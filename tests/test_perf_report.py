@@ -117,6 +117,48 @@ def test_prediction_section_renders_split_fields():
     assert "No predict fields" in txt
 
 
+def test_prediction_section_renders_fused_fields():
+    """ISSUE 19: the fused-megakernel rows — engine-table row, the packed
+    transport line (bytes/row, reduction, cost_analysis bytes) and the
+    predict_fused_ok guard — all grep to BENCH record fields, and a
+    record predating the fields (the r05 lineage) renders without them."""
+    import perf_report
+
+    rec = {
+        "predict_rows": 1000000, "predict_n_trees": 100,
+        "predict_M_rows_per_s": 1.5,
+        "predict_native_compute_M_rows_per_s": 4.2,
+        "predict_device_M_rows_per_s": 2.5,
+        "predict_device_compute_M_rows_per_s": 61.25,
+        "predict_fused_M_rows_per_s": 133.5,
+        "predict_h2d_bytes_per_row_packed": 14,
+        "predict_packed_h2d_reduction": 2.0,
+        "predict_fused_bytes_accessed": 4100096,
+        "predict_fused_bytes_analytic": 3670016,
+        "predict_fused_cache_retraces": 0,
+        "predict_fused_parity_ok": True, "predict_fused_ok": True,
+        "predict_parity_ok": True, "predict_ok": True,
+    }
+    lines = []
+    perf_report.prediction_section(lines.append, rec)
+    txt = "\n".join(lines)
+    for needle in ("fused megakernel (walk+accumulate)", "133.5",
+                   "14 H2D", "2x reduction", "4100096", "3670016",
+                   "predict_fused_ok=True", "single-read contract",
+                   "0 retraces across varied batch sizes through the "
+                   "fused dispatch"):
+        assert needle in txt, needle
+    # an r05-era record without the fused fields: no fused rows, no crash
+    for k in list(rec):
+        if "fused" in k or "packed" in k:
+            rec.pop(k)
+    lines = []
+    perf_report.prediction_section(lines.append, rec)
+    txt = "\n".join(lines)
+    assert "fused megakernel" not in txt
+    assert "predict_fused_ok" not in txt
+
+
 def test_serving_section_renders_serve_fields():
     """The Serving section (PR 5) is generated from the BENCH serve_*
     fields (bench.py measure_serve via tools/loadgen.py): the loadgen

@@ -323,6 +323,10 @@ def test_predict_comm_table():
                  "collective_bytes": 0}
     assert predict_comm_table(8000, 16, 1, itemsize=4)["h2d_bytes"] \
         == 8000 * 64
+    # bytes_per_row override: the 4-bit packed transport ships ceil(F/2)
+    # bytes/row, which no integer itemsize expresses
+    assert predict_comm_table(8000, 15, 8, bytes_per_row=8)["h2d_bytes"] \
+        == 1000 * 8
 
 
 # ---------------------------------------------------------------------------
@@ -440,6 +444,13 @@ def test_config_validates_predict_knobs():
         Config.from_dict({"predict_method": "warp"})
     with pytest.raises(ValueError, match="predict_prebin"):
         Config.from_dict({"predict_prebin": "yes"})
+    # ISSUE 19: the megakernel method + the code-layout knob
+    cfg = Config.from_dict({"predict_method": "fused",
+                            "predict_code_layout": "packed4"})
+    assert (cfg.predict_method, cfg.predict_code_layout) \
+        == ("fused", "packed4")
+    with pytest.raises(ValueError, match="predict_code_layout"):
+        Config.from_dict({"predict_code_layout": "nibble"})
 
 
 def test_cli_task_predict_device_route(bin_model, rng, tmp_path):
@@ -460,3 +471,393 @@ def test_cli_task_predict_device_route(bin_model, rng, tmp_path):
     cli_main(base + [f"output_result={out_dev}",
                      "predict_method=depthwise", "predict_f64_scores=true"])
     assert out_host.read_text() == out_dev.read_text()
+
+
+# ---------------------------------------------------------------------------
+# serving megakernel (predict_method=fused, ISSUE 19)
+# ---------------------------------------------------------------------------
+
+
+def _fused_assert_parity(booster, X, K=1, **bpk):
+    """Fused megakernel vs HostTree oracle: leaf node-exact, f64 scores
+    bit-exact, f32 single-launch scores value-equal — and the kernel must
+    have actually run (not the staged fallback)."""
+    trees = booster._all_trees()
+    bp = BatchPredictor(trees, K, booster.num_feature(), method="fused",
+                        **bpk)
+    assert bp.fused_plan is not None and bp.fused_plan["eligible"], \
+        bp.fused_plan
+    leaf_host = np.stack([t.predict_leaf_index(X) for t in trees], axis=1)
+    assert np.array_equal(bp.predict_leaf(X), leaf_host)
+    raw_host = _host_raw(booster, X)
+    raw64 = bp.predict_raw(X, f64_exact=True)
+    if K == 1:
+        raw64 = raw64[:, 0]
+    assert np.array_equal(raw64, raw_host), (
+        "fused f64-reconstructed scores must be bit-identical to the "
+        "HostTree walk")
+    raw32 = bp.predict_raw(X)
+    if K == 1:
+        raw32 = raw32[:, 0]
+    np.testing.assert_allclose(raw32, raw_host, rtol=1e-4, atol=1e-5)
+    assert not bp._fused_broken, "megakernel silently fell back staged"
+    return bp
+
+
+def test_fused_parity_binary_with_missing(bin_model, xt_nan):
+    bp = _fused_assert_parity(bin_model, xt_nan)
+    assert bp.interpret            # CPU lane pins via interpret mode
+    assert bp.fused_plan["n_tree_tiles"] >= 1
+    # the tree-tile pad parks on zero-leaf trees: T rounded up
+    assert bp.fused_plan["t_pad"] % bp.fused_plan["tree_tile"] == 0
+
+
+def test_fused_parity_dart(rng):
+    X, y = make_binary_problem(700, 8, seed=3)
+    b = _train({"objective": "binary", "boosting": "dart",
+                "num_leaves": 15, "drop_rate": 0.3}, X, y, rounds=8)
+    _fused_assert_parity(b, rng.randn(400, 8))
+
+
+@pytest.mark.slow
+def test_fused_parity_multiclass(rng):
+    X = rng.randn(700, 10)
+    y = rng.randint(0, 4, 700).astype(float)
+    b = _train({"objective": "multiclass", "num_class": 4,
+                "num_leaves": 15}, X, y, rounds=4)
+    _fused_assert_parity(b, rng.randn(400, 10), K=4)
+
+
+@pytest.mark.slow
+def test_fused_parity_lambdarank(rng):
+    X = rng.randn(600, 8)
+    y = rng.randint(0, 4, 600).astype(float)
+    b = _train({"objective": "lambdarank", "num_leaves": 15}, X, y,
+               rounds=6, group=np.full(30, 20))
+    _fused_assert_parity(b, rng.randn(300, 8))
+
+
+@pytest.mark.slow
+def test_fused_parity_zero_as_missing(rng):
+    X = rng.randn(700, 8)
+    X[rng.rand(*X.shape) < 0.3] = 0.0
+    y = (X[:, 1] > 0).astype(float)
+    b = _train({"objective": "binary", "num_leaves": 31,
+                "zero_as_missing": True}, X, y, rounds=8)
+    Xt = rng.randn(500, 8)
+    Xt[rng.rand(*Xt.shape) < 0.3] = 0.0
+    Xt[rng.rand(*Xt.shape) < 0.05] = np.nan
+    _fused_assert_parity(b, Xt)
+
+
+def test_fused_categorical_falls_back_staged(rng):
+    """Categorical bitsets stay on the staged walk: the planner refuses
+    with the honest reason line and predictions remain oracle-exact."""
+    X = rng.randn(500, 8)
+    X[:, 2] = rng.randint(0, 12, 500)
+    y = ((X[:, 2] % 3 == 0) ^ (X[:, 0] > 0)).astype(float)
+    b = _train({"objective": "binary", "num_leaves": 15}, X, y, rounds=4,
+               categorical_feature=[2])
+    bp = BatchPredictor(b._all_trees(), 1, 8, method="fused")
+    assert not bp.fused_plan["eligible"]
+    assert "categorical" in bp.fused_plan["reason"]
+    assert not bp._fused_engaged()
+    Xt = rng.randn(300, 8)
+    Xt[:, 2] = rng.randint(-3, 20, 300)
+    leaf_host = np.stack([t.predict_leaf_index(Xt)
+                          for t in b._all_trees()], axis=1)
+    assert np.array_equal(bp.predict_leaf(Xt), leaf_host)
+    assert np.array_equal(bp.predict_raw(Xt, f64_exact=True)[:, 0],
+                          _host_raw(b, Xt))
+
+
+def test_fused_epilogue_predict_scores(bin_model, xt_nan):
+    """The in-kernel sigmoid epilogue rides the same launch and matches
+    the host-side transform of the raw scores; the staged engine's
+    predict_scores applies the same math out of kernel."""
+    raw_host = _host_raw(bin_model, xt_nan)
+    want = 1.0 / (1.0 + np.exp(-raw_host))
+    trees = bin_model._all_trees()
+    bpf = BatchPredictor(trees, 1, 8, method="fused")
+    got = bpf.predict_scores(xt_nan, transform="sigmoid")[:, 0]
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-6)
+    bpd = BatchPredictor(trees, 1, 8)
+    got_staged = bpd.predict_scores(xt_nan, transform="sigmoid")[:, 0]
+    np.testing.assert_allclose(got_staged, want, rtol=1e-4, atol=1e-6)
+    # raw passthrough and validation
+    np.testing.assert_allclose(
+        bpf.predict_scores(xt_nan)[:, 0], raw_host, rtol=1e-4, atol=1e-5)
+    with pytest.raises(ValueError, match="transform"):
+        bpf.predict_scores(xt_nan, transform="probit")
+
+
+def test_fused_zero_retraces_within_bucket(bin_model, rng):
+    bp = BatchPredictor(bin_model._all_trees(), 1, 8, method="fused",
+                        bucket_min=256)
+    bp.predict_raw(rng.randn(700, 8))    # traces the 1024 bucket
+    t0 = bp.trace_count
+    for n in (700, 513, 1000, 1024, 600):
+        bp.predict_raw(rng.randn(n, 8))
+    assert bp.trace_count == t0, (
+        "varying batch sizes within one bucket must never retrace "
+        "through the fused dispatch")
+    assert bp._fused_engaged()
+
+
+def test_fused_warn_once_dedup(bin_model, monkeypatch):
+    """A lowering failure mid-stream warns ONCE process-wide, not once
+    per chunk — and every chunk still serves staged, oracle-exact."""
+    from lightgbmv1_tpu.models import predict as predict_mod
+    from lightgbmv1_tpu.ops import predict_pallas as pp_mod
+
+    def boom(*a, **k):
+        raise RuntimeError("no Mosaic on this backend")
+
+    monkeypatch.setattr(pp_mod, "serving_fused_pallas", boom)
+    monkeypatch.setattr(predict_mod, "_logged_once", set())
+    warnings = []
+    monkeypatch.setattr(predict_mod, "log_warning",
+                        lambda m: warnings.append(m))
+    rng = np.random.RandomState(31)
+    Xt = rng.randn(600, 8)
+    trees = bin_model._all_trees()
+    bp = BatchPredictor(trees, 1, 8, method="fused", bucket_min=64,
+                        chunk_rows=128)          # 5 chunks
+    leaf_host = np.stack([t.predict_leaf_index(Xt) for t in trees],
+                         axis=1)
+    assert np.array_equal(bp.predict_leaf(Xt), leaf_host)
+    assert bp._fused_broken
+    fused_warns = [m for m in warnings if "fused" in m]
+    assert len(fused_warns) == 1, warnings
+    # same idiom on the pallas lane: chunked stream, one warning
+    monkeypatch.setattr(pp_mod, "serving_leaf_pallas", boom)
+    bpp = BatchPredictor(trees, 1, 8, method="pallas", bucket_min=64,
+                         chunk_rows=128)
+    warnings.clear()
+    assert np.array_equal(bpp.predict_leaf(Xt), leaf_host)
+    assert len([m for m in warnings if "pallas" in m]) == 1, warnings
+
+
+def test_booster_fused_route(bin_model, xt_nan):
+    out = bin_model.predict(xt_nan, raw_score=True,
+                            predict_method="fused",
+                            predict_f64_scores=True)
+    np.testing.assert_array_equal(out, _host_raw(bin_model, xt_nan))
+    # the code-layout knob plumbs through Booster.predict kwargs
+    bin_model._device_pred_cache = None
+    out_u8 = bin_model.predict(xt_nan, raw_score=True,
+                               predict_method="fused",
+                               predict_code_layout="u8",
+                               predict_f64_scores=True)
+    np.testing.assert_array_equal(out_u8, out)
+    bin_model._device_pred_cache = None
+
+
+# ---------------------------------------------------------------------------
+# 4-bit packed serving codes
+# ---------------------------------------------------------------------------
+
+
+def test_packed_codes_roundtrip():
+    from lightgbmv1_tpu.models.predict import (pack_serving_codes,
+                                               unpack_serving_codes)
+
+    rng = np.random.RandomState(7)
+    for F in (8, 7, 1):                     # even, odd, degenerate
+        codes = rng.randint(0, 16, (50, F)).astype(np.uint8)
+        packed = pack_serving_codes(codes)
+        assert packed.shape == (50, (F + 1) // 2)
+        assert packed.dtype == np.uint8
+        # lo nibble = even feature (the PR 18 pack4bit convention)
+        assert np.array_equal(packed[:, 0] & 15, codes[:, 0])
+        out = unpack_serving_codes(packed, F)
+        assert np.array_equal(out, codes)
+
+
+def _packed_model(rounds=8):
+    X, y = make_binary_problem(700, 8, seed=9)
+    return _train({"objective": "binary", "num_leaves": 15,
+                   "max_bin": 10}, X, y, rounds=rounds)
+
+
+def test_packed_fused_parity_and_h2d(rng):
+    """A packed-eligible model (every feature <= 15 serving codes incl.
+    the reserves): auto-packing engages on the fused path, halves the
+    transport, and stays node/bit-exact; the staged packed4 twin unpacks
+    ON DEVICE with identical results."""
+    b = _packed_model()
+    Xt = rng.randn(400, 8)
+    bp = _fused_assert_parity(b, Xt)
+    assert bp.binner.packed_ok and bp.packed
+    assert bp.h2d_bytes(1) == 4            # ceil(8/2), was 8
+    bp_u8 = BatchPredictor(b._all_trees(), 1, 8, method="fused",
+                           code_layout="u8")
+    assert not bp_u8.packed and bp_u8.h2d_bytes(1) == 8
+    assert bp_u8.h2d_bytes(1) == 2 * bp.h2d_bytes(1)   # 2.0x analytic
+    # staged twin: explicit packed4 on the depth-stepped engine
+    bp_st = BatchPredictor(b._all_trees(), 1, 8, code_layout="packed4")
+    assert bp_st.packed and bp_st.h2d_bytes(1) == 4
+    leaf_host = np.stack([t.predict_leaf_index(Xt)
+                          for t in b._all_trees()], axis=1)
+    assert np.array_equal(bp_st.predict_leaf(Xt), leaf_host)
+
+
+def test_packed_refusal_reasons(bin_model, monkeypatch):
+    """Explicit packed4 on an ineligible model refuses with one honest
+    reason and serves unpacked."""
+    from lightgbmv1_tpu.models import predict as predict_mod
+
+    monkeypatch.setattr(predict_mod, "_logged_once", set())
+    warnings = []
+    monkeypatch.setattr(predict_mod, "log_warning",
+                        lambda m: warnings.append(m))
+    # bin_model's binner needs > 16 codes (31-leaf trees, 10 rounds)
+    bp = BatchPredictor(bin_model._all_trees(), 1, 8,
+                        code_layout="packed4")
+    assert not bp.packed
+    assert any("exceed the 16 nibble values" in m for m in warnings)
+    # raw-walk predictor: packing needs prebinned codes at all
+    warnings.clear()
+    monkeypatch.setattr(predict_mod, "_logged_once", set())
+    bp2 = BatchPredictor(bin_model._all_trees(), 1, 8, prebin="off",
+                         code_layout="packed4")
+    assert not bp2.packed
+    assert any("not in play" in m for m in warnings)
+
+
+def test_packed_eligibility_boundary():
+    """The 15/16-code boundary: 13 thresholds -> nan_code 15 (the last
+    nibble value) packs; 14 thresholds -> nan_code 16 refuses."""
+    t_ok = _bst_tree([i + 0.5 for i in range(13)])
+    binner = build_serving_binner([t_ok], 4)
+    assert binner.ok and binner.nan_code == 15 and binner.packed_ok
+    t_over = _bst_tree([i + 0.5 for i in range(14)])
+    binner2 = build_serving_binner([t_over], 4)
+    assert binner2.ok and binner2.nan_code == 16 and not binner2.packed_ok
+    bp = BatchPredictor([t_ok], 1, 4, method="fused")
+    assert bp.packed
+    bp2 = BatchPredictor([t_over], 1, 4, method="fused")
+    assert not bp2.packed
+
+
+# ---------------------------------------------------------------------------
+# serving-binner edge geometry through fused + staged (ISSUE 19)
+# ---------------------------------------------------------------------------
+
+
+def _bst_tree(thresholds, feature=0, nan_left=False):
+    """A balanced BST HostTree over sorted numeric thresholds on one
+    feature (value <= t goes left), MISSING_NAN routing — the geometry
+    scaffold for binner-edge tests where training can't pin the exact
+    threshold count."""
+    import jax.numpy as jnp
+
+    from lightgbmv1_tpu.io.binning import MISSING_NAN
+    from lightgbmv1_tpu.models.tree import HostTree, empty_tree
+
+    ths = sorted(float(v) for v in thresholds)
+    n = len(ths)
+    nodes = [None] * n
+    order = []
+
+    def build(lo, hi):                    # leaves lo..hi inclusive
+        if lo == hi:
+            return -(lo + 1)
+        i = len(order)
+        order.append(i)
+        mid = (lo + hi) // 2
+        nodes[i] = [ths[mid], build(lo, mid), build(mid + 1, hi)]
+        return i
+
+    build(0, n)
+    arr = empty_tree(n + 1)._replace(
+        num_leaves=jnp.asarray(n + 1, jnp.int32),
+        split_feature=jnp.full(n, feature, jnp.int32),
+        threshold=jnp.asarray([nd[0] for nd in nodes], jnp.float32),
+        default_left=jnp.full(n, bool(nan_left), bool),
+        missing_type=jnp.full(n, MISSING_NAN, jnp.int32),
+        left_child=jnp.asarray([nd[1] for nd in nodes], jnp.int32),
+        right_child=jnp.asarray([nd[2] for nd in nodes], jnp.int32),
+        leaf_value=jnp.asarray(
+            np.linspace(-1.0, 1.0, n + 1), jnp.float32),
+    )
+    return HostTree(arr)
+
+
+def _geometry_assert(trees, F, X):
+    """Fused (interpret) == staged depth-stepped == HostTree oracle."""
+    leaf_host = np.stack([t.predict_leaf_index(X) for t in trees], axis=1)
+    bpf = BatchPredictor(trees, 1, F, method="fused", bucket_min=64)
+    assert bpf.fused_plan["eligible"], bpf.fused_plan
+    assert np.array_equal(bpf.predict_leaf(X), leaf_host)
+    assert not bpf._fused_broken
+    bps = BatchPredictor(trees, 1, F, bucket_min=64)
+    assert np.array_equal(bps.predict_leaf(X), leaf_host)
+    return bpf
+
+
+@pytest.mark.slow
+def test_uint16_codes_with_reserved_geometry(rng):
+    """> 255 serving bins force uint16 codes; the reserved NaN/zero codes
+    then live above 255 and must still route exactly through the fused
+    walk and its staged twin."""
+    ths = [i + 0.5 for i in range(300)]
+    trees = [_bst_tree(ths, feature=0, nan_left=False),
+             _bst_tree([0.5, 1.5, 2.5], feature=1, nan_left=True)]
+    binner = build_serving_binner(trees, 3)
+    assert binner.ok and binner.dtype == np.uint16
+    assert binner.nan_code > 255 and not binner.packed_ok
+    X = np.column_stack([
+        rng.uniform(-5, 305, 500),
+        rng.uniform(-2, 5, 500),
+        rng.randn(500)])
+    X[rng.rand(500) < 0.15, 0] = np.nan       # reserved nan code
+    X[rng.rand(500) < 0.15, 1] = np.nan
+    X[rng.rand(500) < 0.15, 0] = 0.0          # reserved zero code
+    X[:8, 0] = [0.5, 299.5, -1e9, 1e9, 0.0, np.nan, 150.5, 150.4999]
+    bpf = _geometry_assert(trees, 3, X)
+    assert not bpf.packed
+
+
+def test_single_serving_bin_collapse(rng):
+    """A feature whose threshold set collapses to ONE serving bin edge
+    (single threshold -> two codes + reserves) beside a wide feature:
+    the degenerate geometry must not skew either walk."""
+    trees = [_bst_tree([2.5], feature=0),
+             _bst_tree([i + 0.5 for i in range(9)], feature=1)]
+    binner = build_serving_binner(trees, 2)
+    assert binner.ok and len(binner.thresholds[0]) == 1
+    X = np.column_stack([rng.uniform(0, 5, 300), rng.uniform(-1, 11, 300)])
+    X[rng.rand(300) < 0.2, 0] = np.nan
+    X[:4, 0] = [2.5, 2.5000002, 0.0, -1e9]    # the edge itself + zero
+    bpf = _geometry_assert(trees, 2, X)
+    assert bpf.packed                          # 10+2 codes fit nibbles
+
+
+# ---------------------------------------------------------------------------
+# plan_predict_tiles (pure planner)
+# ---------------------------------------------------------------------------
+
+
+def test_plan_predict_tiles_reasons_and_tiling():
+    from lightgbmv1_tpu.ops.predict_pallas import plan_predict_tiles
+
+    base = dict(T=100, L1=30, L=31, F=28, K=1, depth=6)
+    plan = plan_predict_tiles(**base)
+    assert plan["eligible"] and plan["reason"] == ""
+    assert plan["t_pad"] % plan["tree_tile"] == 0
+    assert plan["t_pad"] >= base["T"]
+    assert plan["total_bytes"] <= plan["vmem_budget"]
+    # refusals carry one honest reason line each
+    assert "prebinned" in plan_predict_tiles(**base, prebin=False)["reason"]
+    assert "categorical" in \
+        plan_predict_tiles(**base, has_cat=True)["reason"]
+    tight = plan_predict_tiles(**base, vmem_budget=1 << 10)
+    assert not tight["eligible"] and "VMEM budget" in tight["reason"]
+    # a model too big for one tile still fits via tree tiling
+    big = plan_predict_tiles(T=4096, L1=255, L=256, F=28, K=1, depth=8)
+    assert big["eligible"] and big["n_tree_tiles"] > 1
+    assert big["tree_tile"] * big["n_tree_tiles"] == big["t_pad"]
+    # the packed layout halves the codes-tile footprint
+    pk = plan_predict_tiles(**base, packed=True)
+    assert pk["codes_tile_bytes"] < plan["codes_tile_bytes"]

@@ -68,6 +68,12 @@ WATCHED: Tuple[Tuple[str, str, float], ...] = (
     ("rank_ndcg10", "up", 0.005),
     ("predict_M_rows_per_s", "up", 0.10),
     ("predict_device_compute_M_rows_per_s", "up", 0.10),
+    # serving megakernel (ISSUE 19): the fused walk+accumulate rate and
+    # the 4-bit packed serving-code transport — analytic ceil(F/2)
+    # bytes/row, so ANY upward move means packing stopped engaging at
+    # the bench twin; predict_fused_ok is the boolean guard beside them
+    ("predict_fused_M_rows_per_s", "up", 0.10),
+    ("predict_h2d_bytes_per_row_packed", "down", 0.10),
     ("serve_qps", "up", 0.10),
     ("serve_p99_ms", "down", 0.10),
     ("stream_ms_per_iter", "down", 0.10),

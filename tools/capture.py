@@ -328,6 +328,30 @@ def run_flip_rehearsal(records_dir: str = ROOT, iters: int = 3,
         parity_ok &= same
         out(f"flip-defaults: {name} parity "
             f"{'OK' if same else 'DIVERGED'}")
+
+    # serving megakernel (ISSUE 19): the fused walk+accumulate predictor
+    # over a packed-eligible model (max_bin 10 → every feature fits the
+    # 16 nibble values), packed + unpacked twins both node-exact against
+    # the HostTree oracle — so the next driver capture lands the device
+    # legs with the parity half already rehearsed
+    from lightgbmv1_tpu.models.predict import BatchPredictor
+
+    pk_params = {**base, "objective": "binary", "max_bin": 10}
+    ds_pk = lgb.Dataset(X, label=y_bin, params=dict(pk_params))
+    bst_pk = lgb.train(dict(pk_params), ds_pk, num_boost_round=int(iters),
+                       verbose_eval=False)
+    trees_pk = bst_pk._all_trees()
+    leaf_host = np.stack([t.predict_leaf_index(X) for t in trees_pk],
+                         axis=1)
+    for layout in ("packed4", "u8"):
+        bp = BatchPredictor(trees_pk, 1, X.shape[1], method="fused",
+                            code_layout=layout)
+        same = bool(bp._fused_engaged()
+                    and np.array_equal(bp.predict_leaf(X), leaf_host))
+        summary["parity"][f"predict_fused_{layout}"] = same
+        parity_ok &= same
+        out(f"flip-defaults: predict_fused_{layout} parity "
+            f"{'OK' if same else 'DIVERGED'}")
     summary["parity_ok"] = parity_ok
 
     gate_ok = ci_gate.check_required_guards(

@@ -65,11 +65,17 @@ import tier1_budget  # noqa: E402
 # persistent multi-round wave-loop guard (ISSUE 17: wave_loop_rounds>1
 # model-text parity with the single-round fused path everywhere AND, on
 # device, the looped per-iteration wall at or under the single-round
-# wall it replaces — bench.py measure_fused_waveloop)
+# wall it replaces — bench.py measure_fused_waveloop);
+# predict_fused_ok is the serving-megakernel guard (ISSUE 19: fused
+# walk+accumulate node/bit parity with the host oracle, zero retraces
+# within a bucket, and on device >= 1.5x the scan walk's compute rate
+# with cost_analysis bytes confirming the single-read contract —
+# bench.py measure_predict)
 REQUIRED_GUARDS = ("obs_ok", "slo_ok", "forensics_ok", "chaos_ok",
                    "fleet_ok", "chaos_fleet_ok", "obs_device_ok",
                    "fused_ok", "drift_ok", "fused_round_ok",
-                   "hier_comm_ok", "fused_loop_ok", "packed_ok")
+                   "hier_comm_ok", "fused_loop_ok", "packed_ok",
+                   "predict_fused_ok")
 
 
 def check_required_guards(records_dir: str, guards, out=print) -> bool:

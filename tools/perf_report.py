@@ -312,6 +312,9 @@ def prediction_section(w, rec):
     if rec.get("predict_device_scan_M_rows_per_s") is not None:
         w(f"| device scan walk (parity pin) | — | "
           f"{get(rec, 'predict_device_scan_M_rows_per_s', 3)} |")
+    if rec.get("predict_fused_M_rows_per_s") is not None:
+        w(f"| fused megakernel (walk+accumulate) | — | "
+          f"{get(rec, 'predict_fused_M_rows_per_s', 3)} |")
     if rec.get("predict_ref_cpp_M_rows_per_s"):
         w(f"| reference CLI task=predict | "
           f"{get(rec, 'predict_ref_cpp_M_rows_per_s', 3)} | — |")
@@ -328,12 +331,33 @@ def prediction_section(w, rec):
           f"{get(rec, 'predict_cache_retraces', 0)} retraces across "
           "varied batch sizes (predictor cache).")
         w("")
+    if rec.get("predict_h2d_bytes_per_row_packed") is not None:
+        w("Serving megakernel transport: "
+          f"{get(rec, 'predict_h2d_bytes_per_row_packed', 0)} H2D "
+          "bytes/row with 4-bit packed serving codes "
+          f"({get(rec, 'predict_packed_h2d_reduction')}x reduction vs "
+          "the byte-wide twin, analytic ceil(F/2)); measured "
+          "cost_analysis bytes "
+          f"{get(rec, 'predict_fused_bytes_accessed', 0)} vs analytic "
+          f"single-read floor {get(rec, 'predict_fused_bytes_analytic', 0)}"
+          f"; {get(rec, 'predict_fused_cache_retraces', 0)} retraces "
+          "across varied batch sizes through the fused dispatch.")
+        w("")
     if rec.get("predict_ok") is not None:
         w(f"Guard `predict_ok={rec.get('predict_ok')}`: node-exact leaf "
           f"parity vs the host walk "
           f"(`predict_parity_ok={rec.get('predict_parity_ok')}`) AND the "
           "depth-stepped walk at >= 0.95x the scan-walk compute rate "
           "(bench.py asserts the split; this report surfaces it).")
+        w("")
+    if rec.get("predict_fused_ok") is not None:
+        w(f"Guard `predict_fused_ok={rec.get('predict_fused_ok')}`: the "
+          "fused walk+accumulate megakernel node/bit-exact vs the host "
+          "oracle "
+          f"(`predict_fused_parity_ok={rec.get('predict_fused_parity_ok')}"
+          "`), zero retraces within a bucket, and on device >= 1.5x the "
+          "scan walk's compute rate with cost_analysis bytes confirming "
+          "the single-read contract.")
         w("")
 
 
