@@ -1559,6 +1559,241 @@ def measure_fleet(gb_lw, X):
     return fields
 
 
+def measure_tenants(gb_lw, X):
+    """Multi-tenant serving block (ISSUE 20) — on EVERY backend:
+
+    * **compile-bucket sharing** — two tenants whose models share the
+      same stacked-tree SHAPES (one is a leaf-value-scaled clone of the
+      other, so thresholds/structure — and hence the shape signature —
+      match while every prediction differs) publish into one server
+      with shared-cache predictors: the second tenant's warm must add
+      ZERO per-label XLA compiles (PR 12 counters) and mixed-tenant
+      traffic must run retrace-free through ONE executable.
+      ``tenant_compile_share_frac`` is the shared-jit-cache hit rate.
+    * **fair-share isolation** — a hot tenant offered ~2x its fair
+      share on the same server as a well-behaved cold tenant: the hot
+      tenant must shed its OWN traffic (503s > 0) while the cold tenant
+      keeps ZERO sheds and a p99 inside its SLO latency bound.
+      ``tenant_isolation_p99_delta_ms`` = cold p99 under the overload
+      minus cold p99 solo — the noisy-neighbor tax the fair-share
+      admission is supposed to bound.
+    * **per-tenant publish/rollback parity** — publishing v2 into
+      tenant A must leave tenant B's answers bit-identical to its v1
+      host oracle, and A's rollback must restore A's v1 bit-exactly.
+    * **placement-move drill** — a 2-replica fleet with both tenants
+      pinned to r0: overloading the hot tenant must trip the burn-rate
+      signal and the placement controller must migrate it to r1 with a
+      fully-attributed ``placement.move`` record.
+
+    ``tenant_ok`` = all four probes green."""
+    import copy
+    import threading as _threading
+
+    from lightgbmv1_tpu.basic import Booster, _objective_string
+    from lightgbmv1_tpu.io.model_text import model_to_string
+    from lightgbmv1_tpu.models import predict as predict_mod
+    from lightgbmv1_tpu.obs import xla as obs_xla
+    from lightgbmv1_tpu.serve import (Fleet, PlacementConfig,
+                                      PlacementController, Router,
+                                      RouterConfig, ServeConfig, Server,
+                                      ServerOverloaded, SLOConfig,
+                                      TenantRegistry)
+    from tools.loadgen import run_loadgen
+
+    trees = gb_lw.materialize_host_trees()
+    ds = gb_lw.train_set
+
+    def to_booster(tt):
+        return Booster(model_str=model_to_string(
+            tt, objective_string=_objective_string(gb_lw.config),
+            num_class=1, num_tree_per_iteration=1,
+            feature_names=list(ds.feature_names),
+            feature_infos=ds.feature_infos()))
+
+    # same structure/thresholds (same shape signature), different values
+    scaled = copy.deepcopy(trees)
+    for t in scaled:
+        t.leaf_value = t.leaf_value * 0.5
+    full, half_vals = to_booster(trees), to_booster(scaled)
+    pool = np.asarray(X[:4096], np.float64)
+    fields = {}
+
+    # ---- probe 1: compile-bucket sharing ------------------------------
+    predict_mod.reset_shared_cache()
+    cfg = ServeConfig(max_batch_rows=128, max_batch_delay_ms=1.0,
+                      queue_depth_rows=2048, f64_scores=True,
+                      predictor_kwargs={"bucket_min": 64})
+    server = Server(config=cfg)
+    tenreg = TenantRegistry(server)
+    tenreg.add("acme")
+    tenreg.add("globex")
+    try:
+        tenreg.publish("acme", full)
+        server.submit(pool[:64], tenant="acme")     # compile the bucket
+        before = {k: (v["compiles"], v["retraces"])
+                  for k, v in obs_xla.compile_stats().items()
+                  if k.startswith("predict.")}
+        tenreg.publish("globex", half_vals)         # same shapes: adopts
+        ra = server.submit(pool[:64], tenant="acme")
+        rg = server.submit(pool[:64], tenant="globex")
+        after = {k: (v["compiles"], v["retraces"])
+                 for k, v in obs_xla.compile_stats().items()
+                 if k.startswith("predict.")}
+        share = tenreg.compile_share_stats()
+        fields["tenant_compile_share_frac"] = share["share_frac"]
+        fields["tenant_shared_cache_hits"] = share["hits"]
+        fields["tenant_second_warm_compiles"] = sum(
+            c for c, _ in after.values()) - sum(
+            c for c, _ in before.values())
+        fields["tenant_mixed_retraces"] = sum(
+            r for _, r in after.values()) - sum(
+            r for _, r in before.values())
+        values_differ = bool(np.allclose(
+            np.asarray(rg.values), np.asarray(ra.values) * 0.5)
+            and not np.array_equal(np.asarray(rg.values),
+                                   np.asarray(ra.values)))
+        fields["tenant_compile_share_ok"] = bool(
+            fields["tenant_second_warm_compiles"] == 0
+            and fields["tenant_mixed_retraces"] == 0
+            and share["hits"] > 0 and values_differ)
+    finally:
+        server.close()
+
+    # ---- probe 2: fair-share isolation under 2x hot overload ----------
+    slo_ms = 250.0     # CPU-lenient latency objective for the cold SLO
+    iso_cfg = ServeConfig(max_batch_rows=64, max_batch_delay_ms=1.0,
+                          queue_depth_rows=512, f64_scores=True,
+                          predictor_kwargs={"bucket_min": 64})
+    server = Server(config=iso_cfg)
+    tenreg = TenantRegistry(server)
+    tenreg.add("hot")
+    tenreg.add("cold", slo=SLOConfig(latency_ms=slo_ms))
+    try:
+        tenreg.publish("hot", full)
+        tenreg.publish("cold", full)
+        server.submit(pool[:64], tenant="cold")     # warm both paths
+        server.submit(pool[:64], tenant="hot")
+
+        def cold_p99(n_req=120):
+            lats = []
+            sheds = 0
+            for i in range(n_req):
+                s = (i * 17) % (pool.shape[0] - 2)
+                t0 = time.monotonic()
+                try:
+                    server.submit(pool[s:s + 2], tenant="cold")
+                    lats.append((time.monotonic() - t0) * 1e3)
+                except ServerOverloaded:
+                    sheds += 1
+                time.sleep(0.004)
+            return (float(np.percentile(lats, 99)) if lats else None,
+                    sheds)
+
+        solo_p99, _ = cold_p99()
+        hot_result = {}
+
+        def flood():
+            hot_result.update(run_loadgen(
+                server, pool, rate_qps=600.0, duration_s=1.6,
+                rows_per_req=32, n_threads=12, seed=11,
+                tenants="hot"))
+
+        th = _threading.Thread(target=flood, daemon=True)
+        th.start()
+        time.sleep(0.2)                  # let the overload establish
+        loaded_p99, cold_sheds = cold_p99()
+        th.join()
+        hot_shed = hot_result["per_tenant"]["hot"]["shed"]
+        fields["tenant_cold_solo_p99_ms"] = round(solo_p99, 3)
+        fields["tenant_cold_p99_ms"] = round(loaded_p99, 3)
+        fields["tenant_isolation_p99_delta_ms"] = round(
+            max(loaded_p99 - solo_p99, 0.0), 3)
+        fields["tenant_hot_shed"] = int(hot_shed)
+        fields["tenant_cold_shed"] = int(cold_sheds)
+        fields["tenant_fair_share_ok"] = bool(
+            hot_shed > 0 and cold_sheds == 0 and loaded_p99 <= slo_ms)
+    finally:
+        server.close()
+
+    # ---- probe 3: per-tenant publish/rollback parity ------------------
+    server = Server(config=cfg)
+    tenreg = TenantRegistry(server)
+    tenreg.add("a")
+    tenreg.add("b")
+    try:
+        want_full = np.asarray(full.predict(
+            pool[:256], raw_score=True, predict_method="host"),
+            np.float64)
+        want_half = np.asarray(half_vals.predict(
+            pool[:256], raw_score=True, predict_method="host"),
+            np.float64)
+        tenreg.publish("a", half_vals)
+        tenreg.publish("b", half_vals)
+        tenreg.publish("a", full)       # v2 into A only
+        got_a = server.submit(pool[:256], tenant="a").values[:, 0]
+        got_b = server.submit(pool[:256], tenant="b").values[:, 0]
+        a_v2_ok = np.array_equal(got_a, want_full)
+        b_iso_ok = np.array_equal(got_b, want_half)
+        tenreg.rollback("a")
+        got_a1 = server.submit(pool[:256], tenant="a").values[:, 0]
+        fields["tenant_publish_parity_ok"] = bool(
+            a_v2_ok and b_iso_ok
+            and np.array_equal(got_a1, want_half)
+            and tenreg.version("a") == "v1"
+            and tenreg.version("b") == "v1")
+    finally:
+        server.close()
+
+    # ---- probe 4: placement-move drill --------------------------------
+    move_cfg = ServeConfig(max_batch_rows=64, max_batch_delay_ms=1.0,
+                           queue_depth_rows=256, f64_scores=True,
+                           predictor_kwargs={"bucket_min": 64})
+    fleet = Fleet(n_replicas=2, config=move_cfg)
+    router = Router(fleet, RouterConfig(health_period_ms=50.0,
+                                        retry_max=0))
+    tenreg = TenantRegistry(fleet)
+    tenreg.add("hot")
+    tenreg.add("quiet")
+    try:
+        tenreg.publish("hot", full)
+        tenreg.publish("quiet", full)
+        router.set_placement("hot", ["r0"])
+        router.set_placement("quiet", ["r0"])
+        pc = PlacementController(fleet, router, PlacementConfig(
+            replicas_per_tenant=1, burn_threshold=2.0,
+            occupancy_frac=0.75, cooldown_s=0.0))
+        # burn error budget on r0's hot tenant: a request over the
+        # fair-share row cap sheds deterministically, each shed is an
+        # SLO failure, and the fast-window burn rate trips the mover
+        n_over = move_cfg.queue_depth_rows    # > any tenant's share
+        for _ in range(20):
+            try:
+                router.submit(pool[:n_over], tenant="hot")
+            except ServerOverloaded:
+                pass
+        moves = pc.step()
+        fields["tenant_placement_moves"] = len(moves)
+        mv = moves[0] if moves else {}
+        fields["tenant_placement_move_ok"] = bool(
+            moves and mv.get("tenant") == "hot"
+            and mv.get("from") == "r0"
+            and mv.get("to") == "r1"
+            and router.placement().get("hot") == ("r1",)
+            and router.placement().get("quiet") == ("r0",)
+            and mv.get("burn_rate") is not None
+            and "warm_compile_ms" in mv)
+    finally:
+        router.close()
+        fleet.close()
+
+    fields["tenant_ok"] = bool(
+        fields.get("tenant_compile_share_ok")
+        and fields.get("tenant_fair_share_ok")
+        and fields.get("tenant_publish_parity_ok")
+        and fields.get("tenant_placement_move_ok"))
+    return fields
+
+
 def measure_chaos():
     """Robustness block (PR 6): the scripted fault suite (tools/chaos.py)
     runs its fast deterministic subset on EVERY backend — kill-and-resume
@@ -2823,6 +3058,16 @@ def main():
     except Exception as e:  # noqa: BLE001
         extra["fleet_error"] = f"{type(e).__name__}: {e}"[:200]
         extra["fleet_ok"] = False
+
+    # Multi-tenant serving block (ISSUE 20): compile-bucket sharing
+    # proven by per-label counters, fair-share isolation under a hot-
+    # tenant overload, per-tenant publish/rollback parity, and the
+    # SLO-driven placement-move drill — on every backend.
+    try:
+        extra.update(measure_tenants(gb_lw, X))
+    except Exception as e:  # noqa: BLE001
+        extra["tenant_error"] = f"{type(e).__name__}: {e}"[:200]
+        extra["tenant_ok"] = False
 
     # Robustness block (PR 6): the scripted chaos suite on every backend
     # — every injected fault (kill/torn-file/NaN/stall/garbage-publish/

@@ -461,6 +461,7 @@ def _run_serve_armed(config: Config, finish_profile, tracing: bool):
     booster = Booster(params=_config_to_params(config),
                       model_file=config.input_model)
     fleet = None
+    placement = None
     if config.serve_replicas > 1:
         from .serve import (Fleet, Router, RouterConfig, SLOConfig,
                             serve_config_from)
@@ -485,15 +486,41 @@ def _run_serve_armed(config: Config, finish_profile, tracing: bool):
                  f"({fleet.version()}) behind the router")
     else:
         server = build_server(booster, config)
+    if config.tenant_manifest:
+        # multi-tenant serving (serve/tenants.py): one named lineage per
+        # manifest entry, each seeded with the input model (re-publish
+        # per tenant over the registry from then on); shared-shape
+        # tenants serve through one compiled executable
+        from .serve import PlacementConfig, PlacementController, \
+            TenantRegistry
+
+        backend = fleet if fleet is not None else server
+        tenreg = TenantRegistry(backend)
+        specs = tenreg.add_manifest(config.tenant_manifest)
+        for spec in specs:
+            tenreg.publish(spec.name, booster)
+        log_info(f"serve: {len(specs)} tenant(s) published "
+                 f"({', '.join(s.name for s in specs)})")
+        if fleet is not None and config.placement_replicas_per_tenant:
+            placement = PlacementController(fleet, server, PlacementConfig(
+                replicas_per_tenant=config.placement_replicas_per_tenant,
+                burn_threshold=config.placement_burn_threshold,
+                occupancy_frac=config.placement_occupancy_frac,
+                cooldown_s=config.placement_cooldown_s))
+            placement.assign()
     http = ServeHTTP(server, port=config.serve_http_port).start()
     log_info(f"serve: HTTP listening on 127.0.0.1:{http.port} "
              "(POST /predict, GET /metrics, GET /healthz)")
     try:
-        if config.serve_duration_s > 0:
-            _time.sleep(config.serve_duration_s)
-        else:
-            while True:
-                _time.sleep(3600)
+        deadline = (_time.monotonic() + config.serve_duration_s
+                    if config.serve_duration_s > 0 else None)
+        while deadline is None or _time.monotonic() < deadline:
+            step = 3600.0 if placement is None else 1.0
+            if deadline is not None:
+                step = min(step, max(deadline - _time.monotonic(), 0.0))
+            _time.sleep(step)
+            if placement is not None:
+                placement.step()
     except KeyboardInterrupt:
         log_info("serve: interrupted")
     finally:

@@ -542,6 +542,28 @@ class Config:
     # BEFORE the atomic swap (a corrupt model can never reach traffic);
     # 0 disables the semantic probe (structural+finite checks remain)
     serve_probe_rows: int = 64
+    # -- multi-tenant serving (ISSUE 20; serve/tenants.py) -------------
+    # bounded ModelRegistry history: the registry retains the current
+    # version plus the most recent keep_versions-1 predecessors per
+    # lineage (rollback stays safe down to the oldest kept); continuous
+    # publish churn can no longer grow memory without bound
+    registry_keep_versions: int = 4
+    # task=serve tenant manifest: "name[:weight],name[:weight],..." —
+    # stands up one named model lineage per entry with that fair-share
+    # admission weight (default 1.0).  Empty = single-tenant serving,
+    # bit-identical to the pre-tenancy behavior
+    tenant_manifest: str = ""
+    # placement controller (serve/placement.py): number of replicas each
+    # tenant is pinned to; 0 disables placement (every tenant routes to
+    # every replica)
+    placement_replicas_per_tenant: int = 0
+    # migration triggers: a tenant whose fast-window SLO burn rate
+    # exceeds placement_burn_threshold OR whose queue occupancy exceeds
+    # placement_occupancy_frac is a candidate to move to the
+    # least-loaded replica subset; per-tenant cooldown bounds churn
+    placement_burn_threshold: float = 2.0
+    placement_occupancy_frac: float = 0.75
+    placement_cooldown_s: float = 30.0
     # -- training robustness ------------------------------------------
     # guard on the grad/hess pass: "off" (no cost) | "warn" / "raise"
     # (detect NaN/Inf propagation at each iteration boundary — one
@@ -835,6 +857,19 @@ class Config:
                              "(0 disables the watchdog)")
         if self.serve_probe_rows < 0:
             raise ValueError("serve_probe_rows must be >= 0")
+        if self.registry_keep_versions < 1:
+            raise ValueError("registry_keep_versions must be >= 1 "
+                             "(the current version is always kept)")
+        if self.placement_replicas_per_tenant < 0:
+            raise ValueError("placement_replicas_per_tenant must be "
+                             ">= 0 (0 disables placement)")
+        if self.placement_burn_threshold <= 0:
+            raise ValueError("placement_burn_threshold must be > 0")
+        if not 0 < self.placement_occupancy_frac <= 1:
+            raise ValueError("placement_occupancy_frac must be in "
+                             "(0, 1]")
+        if self.placement_cooldown_s < 0:
+            raise ValueError("placement_cooldown_s must be >= 0")
         if self.stream_block_rows < 1:
             raise ValueError("stream_block_rows must be >= 1")
         if self.snapshot_keep < 2:

@@ -52,6 +52,7 @@ all — `tools` dryrun_multichip asserts node-exact parity vs single-device.
 
 from __future__ import annotations
 
+import threading
 from collections import OrderedDict
 from dataclasses import dataclass
 from typing import Any, Dict, List, NamedTuple, Optional, Tuple
@@ -451,21 +452,77 @@ def serving_leaf_binned(sm: ServingArrays, codes, n_steps: int,
 _obs_cache = {}
 
 
-def _obs_cache_counter(event: str):
+def _obs_cache_counter(event: str,
+                       metric_name: str = "predict_cache_events_total"):
     """Process-wide predictor-cache counters in the unified registry
-    (``predict_cache_events_total{event=hits|misses|evictions}``) — the
-    per-instance ``cache_info()`` integers stay the test surface; these
-    aggregate across predictors for scraping."""
-    c = _obs_cache.get(event)
+    (``predict_cache_events_total{event=hits|misses|evictions}``, and
+    ``predict_shared_cache_events_total`` for the cross-instance
+    executable cache) — the per-instance ``cache_info()`` integers stay
+    the test surface; these aggregate across predictors for scraping."""
+    c = _obs_cache.get((metric_name, event))
     if c is None:
         from ..obs.metrics import default_registry
 
         metric = default_registry().counter(
-            "predict_cache_events_total",
+            metric_name,
             "Compiled-walk cache hits/misses/evictions",
             label_names=("event",))
-        c = _obs_cache[event] = metric.labels(event=event)
+        c = _obs_cache[(metric_name, event)] = metric.labels(event=event)
     return c
+
+
+# ---------------------------------------------------------------------------
+# Cross-instance shared executable cache (multi-tenant serving, ISSUE 20)
+# ---------------------------------------------------------------------------
+# The walk closures are pure in everything per-model — node tables and
+# encoded rows arrive as ARGUMENTS — so two predictors whose traced
+# program is byte-identical (same tree-shape signature: stacked table
+# geometry, binner code geometry, walk statics) can share ONE
+# InstrumentedJit and therefore ONE compiled executable per bucket.
+# That is the multi-tenant compile-bucket sharing contract: the cache
+# key is ``(shape_signature, bucket, kind)`` — TENANT IDENTITY IS NOT
+# IN THE KEY.  Opt-in per predictor (``shared_cache=True``; the tenant
+# platform enables it) so single-model deployments keep today's
+# per-instance behavior bit-identically.  Entries hold only the jitted
+# closure + small statics (never the model arrays), LRU-bounded.
+
+_SHARED_CACHE_CAPACITY = 256
+_shared_lock = threading.RLock()
+_shared_cache: "OrderedDict[tuple, Any]" = OrderedDict()
+_shared_stats = {"hits": 0, "misses": 0, "evictions": 0}
+
+
+def shared_cache_stats() -> Dict[str, int]:
+    """Point read of the cross-instance executable cache — bench.py's
+    ``tenant_compile_share_frac`` is ``hits / (hits + misses)``."""
+    with _shared_lock:
+        out = dict(_shared_stats)
+        out["entries"] = len(_shared_cache)
+        out["capacity"] = _SHARED_CACHE_CAPACITY
+    return out
+
+
+def reset_shared_cache() -> None:
+    """Drop every shared executable and zero the counters (tests and
+    bench probes only — live predictors keep their adopted entries)."""
+    with _shared_lock:
+        _shared_cache.clear()
+        for k in _shared_stats:
+            _shared_stats[k] = 0
+
+
+class _TraceCell:
+    """Trace-time counter the walk closures bump instead of closing over
+    the predictor — a shared executable must never keep its builder's
+    model arrays alive through the cache."""
+
+    __slots__ = ("n",)
+
+    def __init__(self):
+        self.n = 0
+
+    def bump(self):
+        self.n += 1
 
 
 def _next_pow2(n: int) -> int:
@@ -491,7 +548,8 @@ class BatchPredictor:
                  method: str = "depthwise", prebin: str = "auto",
                  code_layout: str = "auto", num_shards: int = 0,
                  bucket_min: int = 256, chunk_rows: int = 1 << 17,
-                 interpret: Optional[bool] = None, cache_entries: int = 64):
+                 interpret: Optional[bool] = None, cache_entries: int = 64,
+                 shared_cache: bool = False):
         import jax
 
         if not trees:
@@ -578,7 +636,14 @@ class BatchPredictor:
         # executable; re-touching that bucket retraces (counted).
         self._cache: "OrderedDict[Tuple[int, str], Any]" = OrderedDict()
         self.cache_capacity = max(int(cache_entries), 2)
-        self.trace_count = 0
+        # cross-instance executable sharing (multi-tenant serving): the
+        # per-instance LRU stays the front line; on a miss the shared
+        # cache is consulted under (shape signature, bucket, kind).
+        # Row-sharded predictors are excluded (their walks close over a
+        # per-instance mesh binding).
+        self.shared_cache = bool(shared_cache) and self.num_shards <= 1
+        self._shape_sig: Optional[tuple] = None
+        self._tc = _TraceCell()
         self.call_count = 0
         self.cache_hits = 0
         self.cache_misses = 0
@@ -611,12 +676,77 @@ class BatchPredictor:
                           "staged depth-stepped walk", warn=True)
 
     # -- cache ----------------------------------------------------------
+    @property
+    def trace_count(self) -> int:
+        """Traces this instance's walk builds triggered (the zero-retrace
+        contract's per-instance surface).  A predictor that ADOPTS a
+        shared executable never traces — its count stays 0, which is
+        exactly the multi-tenant compile-sharing assertion."""
+        return self._tc.n
+
     def bucket_for(self, n: int) -> int:
         b = _next_pow2(max(n, self.bucket_min))
         b = min(b, _next_pow2(self.chunk_rows))
         if self.num_shards > 1 and b % self.num_shards:
             b = self.num_shards * (-(-b // self.num_shards))
         return b
+
+    def shape_signature(self) -> tuple:
+        """Every static the traced walk program depends on — two
+        predictors with equal signatures lower to byte-identical XLA
+        programs per (bucket, kind), which is what makes the shared
+        executable cache sound.  Covers the walk statics (method /
+        prebin / packed / depth / categorical handling), the binner code
+        geometry (zero/nan codes are baked into the trace as constants),
+        the stacked table geometry (shape + dtype of every SoA field —
+        they are jit ARGUMENTS, so shape/dtype is what the trace keys
+        on), and the megakernel tiling plan."""
+        if self._shape_sig is None:
+            geom = tuple((tuple(v.shape), str(v.dtype))
+                         for v in self.arrays)
+            fused = None
+            if self.fused_plan is not None and self.fused_plan["eligible"]:
+                fused = (int(self.fused_plan["tree_tile"]),
+                         int(self.fused_plan["t_pad"]))
+            self._shape_sig = (
+                self.method, self.prebin, self.packed, self.interpret,
+                self.depth, self.has_cat, self.K, self.T, self.F,
+                self.binner.zero_code, self.binner.nan_code,
+                str(np.dtype(self.binner.dtype)), geom, fused)
+        return self._shape_sig
+
+    def _shared_jit(self, bucket: int, kind: str, build):
+        """Fetch-or-build one instrumented jitted walk through the
+        cross-instance shared cache (``shared_cache=True`` only) —
+        keyed ``(shape_signature, bucket, kind)``, never on model or
+        tenant identity.  ``build()`` must return a closure that is
+        pure in everything per-model (tables arrive as arguments)."""
+        if not self.shared_cache:
+            return build()
+        skey = (self.shape_signature(), bucket, kind)
+        with _shared_lock:
+            ent = _shared_cache.get(skey)
+            if ent is not None:
+                _shared_cache.move_to_end(skey)
+                _shared_stats["hits"] += 1
+        if ent is not None:
+            _obs_cache_counter(
+                "hits", "predict_shared_cache_events_total").inc()
+            return ent
+        _obs_cache_counter(
+            "misses", "predict_shared_cache_events_total").inc()
+        jfn = build()
+        with _shared_lock:
+            _shared_stats["misses"] += 1
+            _shared_cache[skey] = jfn
+            _shared_cache.move_to_end(skey)
+            while len(_shared_cache) > _SHARED_CACHE_CAPACITY:
+                _shared_cache.popitem(last=False)
+                _shared_stats["evictions"] += 1
+                _obs_cache_counter(
+                    "evictions",
+                    "predict_shared_cache_events_total").inc()
+        return jfn
 
     def _cache_get(self, key):
         fn = self._cache.get(key)
@@ -663,32 +793,40 @@ class BatchPredictor:
         depth, has_cat = self.depth, self.has_cat
         zc, nc = self.binner.zero_code, self.binner.nan_code
         packed, F = self.packed, self.F
+        interpret, tc = self.interpret, self._tc
 
-        def walk(arrays, xb):
-            self.trace_count += 1        # trace-time side effect only
-            if packed:
-                xb = unpack_serving_codes(xb, F)
-            if method == "pallas" and prebin and not has_cat:
-                from ..ops.predict_pallas import serving_leaf_pallas
+        def build():
+            def walk(arrays, xb):
+                tc.bump()                # trace-time side effect only
+                if packed:
+                    xb = unpack_serving_codes(xb, F)
+                if method == "pallas" and prebin and not has_cat:
+                    from ..ops.predict_pallas import serving_leaf_pallas
 
-                return serving_leaf_pallas(
-                    arrays, xb, n_steps=depth, zero_code=zc, nan_code=nc,
-                    interpret=self.interpret)
-            if prebin:
-                return serving_leaf_binned(arrays, xb, depth, zc, nc,
-                                           has_cat)
-            return serving_leaf_raw(arrays, xb, depth, has_cat)
+                    return serving_leaf_pallas(
+                        arrays, xb, n_steps=depth, zero_code=zc,
+                        nan_code=nc, interpret=interpret)
+                if prebin:
+                    return serving_leaf_binned(arrays, xb, depth, zc, nc,
+                                               has_cat)
+                return serving_leaf_raw(arrays, xb, depth, has_cat)
 
-        fn = walk
-        if self._mesh is not None:
-            from ..parallel.trainer import shard_rows
+            fn = walk
+            if self._mesh is not None:
+                from ..parallel.trainer import shard_rows
 
-            fn = shard_rows(walk, self._mesh, "rows", n_replicated=1)
-        # labeled compile telemetry (obs/xla.py): every (bucket, kind)
-        # compile is an observed event, and the per-label retrace
-        # counters are the serving zero-retrace contract's instrument
-        jfn = obs_xla.instrument_jit(fn, "predict.leaf")
+                fn = shard_rows(walk, self._mesh, "rows", n_replicated=1)
+            # labeled compile telemetry (obs/xla.py): every (bucket,
+            # kind) compile is an observed event, and the per-label
+            # retrace counters are the serving zero-retrace contract's
+            # instrument
+            return obs_xla.instrument_jit(fn, "predict.leaf")
+
+        jfn = self._shared_jit(bucket, "leaf", build)
         if self.method == "pallas":
+            # the lowering-failure guard is PER INSTANCE (it reads this
+            # predictor's broken flag and fallback tables); only the
+            # inner jitted walk is shared
             jfn = self._pallas_guard(jfn, bucket)
         return self._cache_put(key, jfn)
 
@@ -724,23 +862,27 @@ class BatchPredictor:
         depth, has_cat = self.depth, self.has_cat
         zc, nc = self.binner.zero_code, self.binner.nan_code
         prebin, packed, F = self.prebin, self.packed, self.F
+        tc = self._tc
 
-        def walk(arrays, xb):
-            self.trace_count += 1
-            if packed:
-                xb = unpack_serving_codes(xb, F)
-            if prebin:
-                return serving_leaf_binned(arrays, xb, depth, zc, nc,
-                                           has_cat)
-            return serving_leaf_raw(arrays, xb, depth, has_cat)
+        def build():
+            def walk(arrays, xb):
+                tc.bump()
+                if packed:
+                    xb = unpack_serving_codes(xb, F)
+                if prebin:
+                    return serving_leaf_binned(arrays, xb, depth, zc, nc,
+                                               has_cat)
+                return serving_leaf_raw(arrays, xb, depth, has_cat)
 
-        fn = walk
-        if self._mesh is not None:
-            from ..parallel.trainer import shard_rows
+            fn = walk
+            if self._mesh is not None:
+                from ..parallel.trainer import shard_rows
 
-            fn = shard_rows(walk, self._mesh, "rows", n_replicated=1)
-        return self._cache_put(key, obs_xla.instrument_jit(
-            fn, "predict.leaf"))
+                fn = shard_rows(walk, self._mesh, "rows", n_replicated=1)
+            return obs_xla.instrument_jit(fn, "predict.leaf")
+
+        return self._cache_put(key, self._shared_jit(
+            bucket, "leaf_xla", build))
 
     # -- serving megakernel (predict_method=fused) -----------------------
     def _fused_engaged(self) -> bool:
@@ -758,9 +900,10 @@ class BatchPredictor:
         zc, nc = self.binner.zero_code, self.binner.nan_code
         packed, interpret = self.packed, self.interpret
         tree_tile = self.fused_plan["tree_tile"]
+        tc = self._tc
 
         def walk(tables, xb):
-            self.trace_count += 1
+            tc.bump()
             out = serving_fused_pallas(
                 tables, xb, n_steps=depth, zero_code=zc, nan_code=nc,
                 K=K, tree_tile=tree_tile, mode=mode, packed=packed,
@@ -781,12 +924,16 @@ class BatchPredictor:
         cached = self._cache_get(key)
         if cached is not None:
             return cached
-        fn = self._fused_walk(mode=mode, transform=transform)
-        if self._mesh is not None:
-            from ..parallel.trainer import shard_rows
 
-            fn = shard_rows(fn, self._mesh, "rows", n_replicated=1)
-        jfn = obs_xla.instrument_jit(fn, "predict.fused")
+        def build():
+            fn = self._fused_walk(mode=mode, transform=transform)
+            if self._mesh is not None:
+                from ..parallel.trainer import shard_rows
+
+                fn = shard_rows(fn, self._mesh, "rows", n_replicated=1)
+            return obs_xla.instrument_jit(fn, "predict.fused")
+
+        jfn = self._shared_jit(bucket, kind, build)
         return self._cache_put(
             key, self._fused_guard(jfn, bucket, mode, transform))
 
@@ -829,17 +976,22 @@ class BatchPredictor:
 
         from .tree import ensemble_predict_raw
 
-        def fwd(stacked, xb):
-            self.trace_count += 1
-            return ensemble_predict_raw(stacked, xb)
+        tc = self._tc
 
-        fn = fwd
-        if self._mesh is not None:
-            from ..parallel.trainer import shard_rows
+        def build():
+            def fwd(stacked, xb):
+                tc.bump()
+                return ensemble_predict_raw(stacked, xb)
 
-            fn = shard_rows(fwd, self._mesh, "rows", n_replicated=1)
-        return self._cache_put(key, obs_xla.instrument_jit(
-            fn, "predict.scan"))
+            fn = fwd
+            if self._mesh is not None:
+                from ..parallel.trainer import shard_rows
+
+                fn = shard_rows(fwd, self._mesh, "rows", n_replicated=1)
+            return obs_xla.instrument_jit(fn, "predict.scan")
+
+        return self._cache_put(key, self._shared_jit(
+            bucket, "scan", build))
 
     # -- host <-> device ------------------------------------------------
     def encode(self, X: np.ndarray) -> np.ndarray:
@@ -984,14 +1136,17 @@ class BatchPredictor:
 
         from .tree import leaves_to_scores
 
-        K = self.K
+        K, tc = self.K, self._tc
 
-        def fn(leaf_value, leaf):
-            self.trace_count += 1
-            return leaves_to_scores(leaf_value, leaf, K)
+        def build():
+            def fn(leaf_value, leaf):
+                tc.bump()
+                return leaves_to_scores(leaf_value, leaf, K)
 
-        return self._cache_put(key, obs_xla.instrument_jit(
-            fn, "predict.scores"))
+            return obs_xla.instrument_jit(fn, "predict.scores")
+
+        return self._cache_put(key, self._shared_jit(
+            bucket, "scores", build))
 
     def _predict_raw_scan(self, X, chunk_rows):
         import jax

@@ -24,21 +24,28 @@ open-loop Poisson traffic against any of them.
 
 from .metrics import ServeMetrics
 from .registry import ModelRegistry, ModelVersion, PublishValidationError
-from .server import (DispatcherDied, DispatcherStalled, RequestTimeout,
-                     ServeConfig, ServeError, ServeResult, Server,
-                     ServerClosed, ServerOverloaded, build_server,
-                     serve_config_from)
+from .server import (DEFAULT_TENANT, DispatcherDied, DispatcherStalled,
+                     RequestTimeout, ServeConfig, ServeError, ServeResult,
+                     Server, ServerClosed, ServerOverloaded, UnknownTenant,
+                     build_server, serve_config_from)
 from .http import ServeHTTP
 from .slo import SLOConfig, SLOTracker
 from .fleet import Fleet, FleetPublishError
 from .router import Router, RouterConfig
+from .tenants import (TenantRegistry, TenantSpec, compile_share_stats,
+                      parse_manifest)
+from .placement import PlacementConfig, PlacementController
 
 __all__ = [
+    "DEFAULT_TENANT",
     "DispatcherDied", "DispatcherStalled", "Fleet", "FleetPublishError",
     "ModelRegistry", "ModelVersion",
+    "PlacementConfig", "PlacementController",
     "PublishValidationError", "RequestTimeout", "Router", "RouterConfig",
     "SLOConfig", "SLOTracker",
     "ServeConfig", "ServeError", "ServeHTTP", "ServeMetrics",
     "ServeResult", "Server", "ServerClosed", "ServerOverloaded",
-    "build_server", "serve_config_from",
+    "TenantRegistry", "TenantSpec", "UnknownTenant",
+    "build_server", "compile_share_stats", "parse_manifest",
+    "serve_config_from",
 ]

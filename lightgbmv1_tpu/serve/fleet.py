@@ -38,7 +38,7 @@ from typing import Any, Dict, List, Optional
 
 from ..utils.log import log_info, log_warning
 from .registry import ModelVersion
-from .server import ServeConfig, Server
+from .server import DEFAULT_TENANT, ServeConfig, Server
 
 
 class FleetPublishError(RuntimeError):
@@ -81,20 +81,47 @@ class Fleet:
     def names(self) -> List[str]:
         return [r.name for r in self.replicas]
 
-    def version(self) -> Optional[str]:
-        """The fleet's consensus version tag (None when replicas
-        disagree or nothing is published — a mixed fleet must be
-        VISIBLE, not averaged away)."""
-        tags = {r.registry.current_tag() for r in self.replicas}
+    def version(self, tenant: str = DEFAULT_TENANT) -> Optional[str]:
+        """The fleet's consensus version tag for one tenant lineage
+        (None when replicas disagree or nothing is published — a mixed
+        fleet must be VISIBLE, not averaged away)."""
+        tags = {r.tenant_registry(tenant).current_tag()
+                for r in self.replicas}
         return tags.pop() if len(tags) == 1 else None
 
     def healths(self) -> Dict[str, Dict[str, Any]]:
         return {r.name: r.health() for r in self.replicas}
 
+    # -- tenants ---------------------------------------------------------
+    def add_tenant(self, name: str, *, weight: float = 1.0,
+                   slo=None, predictor_kwargs=None) -> None:
+        """Stand a named tenant lineage up on EVERY replica (idempotent
+        per replica, so a partially-added tenant heals on retry)."""
+        for r in self.replicas:
+            r.add_tenant(name, weight=weight, slo=slo,
+                         predictor_kwargs=predictor_kwargs)
+
+    def remove_tenant(self, name: str) -> None:
+        for r in self.replicas:
+            r.remove_tenant(name)
+
+    def tenant_names(self) -> List[str]:
+        return self.replicas[0].tenant_names()
+
+    def tenants_snapshot(self) -> Dict[str, Any]:
+        """Per-replica tenant snapshots keyed by replica name, plus the
+        fleet-consensus version per tenant."""
+        per_replica = {r.name: r.tenants_snapshot()["tenants"]
+                       for r in self.replicas}
+        versions = {t: self.version(t) for t in self.tenant_names()}
+        return {"replicas": per_replica, "versions": versions}
+
     # -- coordinated publish ---------------------------------------------
-    def publish(self, model, **meta) -> str:
-        """Two-phase fleet publish; returns the fleet-wide version tag.
-        Raises :class:`FleetPublishError` (no replica swapped) when any
+    def publish(self, model, tenant: str = DEFAULT_TENANT,
+                **meta) -> str:
+        """Two-phase fleet publish into one tenant's lineage; returns
+        the fleet-wide version tag.  Raises :class:`FleetPublishError`
+        (no replica swapped, no OTHER tenant touched) when any
         replica's prepare fails."""
         from ..obs import events as obs_events
 
@@ -106,7 +133,7 @@ class Fleet:
         # tags stay aligned fleet-wide)
         for r in self.replicas:
             try:
-                prepared[r.name] = r.registry.prepare(
+                prepared[r.name] = r.tenant_registry(tenant).prepare(
                     model, degrade_trees=cfg.degrade_trees,
                     max_batch_rows=cfg.max_batch_rows,
                     meta=meta or None, probe_rows=cfg.probe_rows)
@@ -118,7 +145,8 @@ class Fleet:
                 f"{len(causes)}/{len(self.replicas)} replicas failed "
                 "warm/validation — fleet publish aborted, prior version "
                 "keeps serving everywhere",
-                severity="error", causes=causes)
+                severity="error", causes=causes,
+                tenant=tenant or "default")
             log_warning(f"fleet: publish aborted in phase 1 ({causes}); "
                         "no replica swapped")
             raise FleetPublishError(
@@ -128,12 +156,12 @@ class Fleet:
         committed: List[Server] = []
         try:
             for r in self.replicas:
-                r.registry.commit(prepared[r.name])
+                r.tenant_registry(tenant).commit(prepared[r.name])
                 committed.append(r)
         except Exception as e:  # noqa: BLE001
             for r in committed:
                 try:
-                    r.registry.rollback()
+                    r.tenant_registry(tenant).rollback()
                 except Exception:   # noqa: BLE001
                     pass
             obs_events.publish(
@@ -150,10 +178,11 @@ class Fleet:
                  f"{len(self.replicas)} replicas (two-phase)")
         return tag
 
-    def rollback(self) -> str:
-        """Fleet-wide rollback (each replica's retained previous
-        version; instant)."""
-        tags = {r.registry.rollback() for r in self.replicas}
+    def rollback(self, tenant: str = DEFAULT_TENANT) -> str:
+        """Fleet-wide rollback of one tenant's lineage (each replica's
+        retained previous version; instant)."""
+        tags = {r.tenant_registry(tenant).rollback()
+                for r in self.replicas}
         if len(tags) != 1:
             log_warning(f"fleet: rollback left mixed versions {tags}")
         return sorted(tags)[0]

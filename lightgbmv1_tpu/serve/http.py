@@ -44,10 +44,21 @@ import json
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
-from .server import (DispatcherStalled, RequestTimeout, ServeError, Server,
-                     ServerClosed, ServerOverloaded)
+from .server import (DEFAULT_TENANT, DispatcherStalled, RequestTimeout,
+                     ServeError, Server, ServerClosed, ServerOverloaded,
+                     UnknownTenant)
 
 PROM_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+
+def _query_param(query: str, key: str) -> str:
+    """Minimal query-string lookup (no urllib dependency creep for one
+    scalar): last ``key=value`` pair wins, '' when absent."""
+    out = ""
+    for part in query.split("&"):
+        if part.startswith(key + "="):
+            out = part[len(key) + 1:]
+    return out
 
 
 def _make_handler(server: Server):
@@ -84,34 +95,53 @@ def _make_handler(server: Server):
             return "text/plain" in accept or "openmetrics" in accept
 
         def do_GET(self):  # noqa: N802 — BaseHTTPRequestHandler API
-            route = self.path.split("?", 1)[0]
-            if route == "/metrics":
-                if self._wants_prometheus():
-                    # exemplar suffixes only for OpenMetrics consumers —
-                    # they are not part of the 0.0.4 text grammar
-                    om = "openmetrics" in self.headers.get("Accept", "")
-                    self._reply_text(
-                        200,
-                        server.metrics.prometheus_text(exemplars=om),
-                        PROM_CONTENT_TYPE)
+            route, query = (self.path.split("?", 1) + [""])[:2]
+            tenant = _query_param(query, "tenant")
+            try:
+                if route == "/metrics":
+                    if self._wants_prometheus():
+                        # exemplar suffixes only for OpenMetrics
+                        # consumers — they are not part of the 0.0.4
+                        # text grammar
+                        om = "openmetrics" in self.headers.get(
+                            "Accept", "")
+                        self._reply_text(
+                            200,
+                            server.metrics.prometheus_text(exemplars=om),
+                            PROM_CONTENT_TYPE)
+                    else:
+                        self._reply(200, server.metrics_snapshot())
+                elif route == "/slo":
+                    # burn-rate evaluation + worst-tail exemplar trace
+                    # ids (serve/slo.py) — the page/warn booleans an
+                    # external alerter can poll without scraping
+                    # histograms; ?tenant= narrows to one lineage
+                    self._reply(200, server.slo_snapshot(
+                        tenant=tenant) if tenant
+                        else server.slo_snapshot())
+                elif route == "/drift":
+                    # train/serve skew evaluation (obs/drift.py):
+                    # per-feature PSI vs the active version's training
+                    # reference, skew counters and score drift —
+                    # computed on READ, never on the serving path;
+                    # ?tenant= narrows to that tenant's detector
+                    self._reply(200, server.drift_snapshot(
+                        tenant=tenant) if tenant
+                        else server.drift_snapshot())
+                elif route == "/tenants":
+                    # the multi-tenant control surface: per-tenant
+                    # version, fair-share occupancy, shed/error counts
+                    # and SLO page/burn summary (serve/server.py
+                    # tenants_snapshot; on a router, per-replica views
+                    # plus the placement map)
+                    self._reply(200, server.tenants_snapshot())
+                elif route == "/healthz":
+                    health = server.health()
+                    self._reply(200 if health["ok"] else 503, health)
                 else:
-                    self._reply(200, server.metrics_snapshot())
-            elif route == "/slo":
-                # burn-rate evaluation + worst-tail exemplar trace ids
-                # (serve/slo.py) — the page/warn booleans an external
-                # alerter can poll without scraping histograms
-                self._reply(200, server.slo_snapshot())
-            elif route == "/drift":
-                # train/serve skew evaluation (obs/drift.py): per-feature
-                # PSI vs the active version's training reference, skew
-                # counters and score drift — computed on READ, never on
-                # the serving path
-                self._reply(200, server.drift_snapshot())
-            elif route == "/healthz":
-                health = server.health()
-                self._reply(200 if health["ok"] else 503, health)
-            else:
-                self._reply(404, {"error": f"no route {self.path}"})
+                    self._reply(404, {"error": f"no route {self.path}"})
+            except UnknownTenant as e:
+                self._reply(404, {"error": str(e), "tenant": tenant})
 
         def do_POST(self):  # noqa: N802
             if self.path != "/predict":
@@ -132,6 +162,9 @@ def _make_handler(server: Server):
                 rows = req["rows"]
                 if not isinstance(rows, list) or not rows:
                     raise ValueError("'rows' must be a non-empty list")
+                tenant = req.get("tenant", DEFAULT_TENANT)
+                if not isinstance(tenant, str):
+                    raise ValueError("'tenant' must be a string")
             except KeyError as e:
                 self._reply(400, {"error": f"missing field {e}"},
                             headers=tid_hdr)
@@ -141,7 +174,14 @@ def _make_handler(server: Server):
                             headers=tid_hdr)
                 return
             try:
-                res = server.submit(rows, trace_id=trace_id)
+                res = server.submit(rows, trace_id=trace_id,
+                                    tenant=tenant)
+            except UnknownTenant as e:
+                # the lineage does not exist — routing elsewhere cannot
+                # create it, so this is the caller's 404, not a 503
+                self._reply(404, {"error": str(e), "tenant": tenant},
+                            headers=tid_hdr)
+                return
             except ServerOverloaded as e:
                 self._reply(503, {"error": str(e), "shed": True},
                             headers=tid_hdr)
@@ -174,7 +214,7 @@ def _make_handler(server: Server):
                 self._reply(500, {"error": f"{type(e).__name__}: {e}"},
                             headers=tid_hdr)
                 return
-            self._reply(200, {
+            payload = {
                 "values": res.values.tolist(),
                 "version": res.version,
                 "degraded": res.degraded,
@@ -182,7 +222,10 @@ def _make_handler(server: Server):
                 "trace_id": res.trace_id,
                 "queue_ms": round(res.queue_ms, 3),
                 "walk_ms": round(res.walk_ms, 3),
-            }, headers=tid_hdr)
+            }
+            if tenant:
+                payload["tenant"] = tenant
+            self._reply(200, payload, headers=tid_hdr)
 
     return Handler
 

@@ -55,9 +55,9 @@ import numpy as np
 from ..utils import faults
 from ..utils.log import log_info, log_warning
 from .metrics import ServeMetrics
-from .server import (DispatcherDied, DispatcherStalled, RequestTimeout,
-                     ServeError, ServeResult, Server, ServerClosed,
-                     ServerOverloaded)
+from .server import (DEFAULT_TENANT, DispatcherDied, DispatcherStalled,
+                     RequestTimeout, ServeError, ServeResult, Server,
+                     ServerClosed, ServerOverloaded, UnknownTenant)
 from .slo import SLOConfig, SLOTracker
 
 
@@ -131,6 +131,10 @@ class Router:
         self._t_start = time.monotonic()
         self._rr = 0
         self._lock = threading.Lock()
+        # placement map (serve/placement.py): tenant -> tuple of replica
+        # names its traffic is pinned to; a tenant with no entry routes
+        # over every replica (the pre-placement behavior)
+        self._placement: Dict[str, tuple] = {}
         self.metrics = ServeMetrics(window=self.config.metrics_window)
         self.slo = SLOTracker(self.config.slo)
         reg = self.metrics.registry
@@ -214,17 +218,43 @@ class Router:
                             rep, f"failed {rep.consec_bad} consecutive "
                             "health checks")
 
-    def _pick(self, tried: set) -> Optional[_Replica]:
+    # -- placement (serve/placement.py drives these) ---------------------
+    def set_placement(self, tenant: str, names) -> None:
+        """Pin one tenant's traffic to a replica subset.  Unknown
+        replica names are rejected (a typo must not silently blackhole
+        a tenant); an empty subset clears the pin."""
+        names = tuple(names or ())
+        known = {r.name for r in self._replicas}
+        bad = [n for n in names if n not in known]
+        if bad:
+            raise ValueError(f"unknown replica(s) {bad} in placement "
+                             f"for tenant {tenant!r}")
+        with self._lock:
+            if names:
+                self._placement[tenant] = names
+            else:
+                self._placement.pop(tenant, None)
+
+    def placement(self) -> Dict[str, tuple]:
+        with self._lock:
+            return dict(self._placement)
+
+    def _pick(self, tried: set,
+              tenant: str = DEFAULT_TENANT) -> Optional[_Replica]:
         """Next candidate: round-robin over healthy untried replicas,
         falling back to unhealthy untried ones (a request with no
         healthy candidate left still deserves a hail-mary — the health
-        view may simply be stale)."""
+        view may simply be stale).  A tenant with a placement pin only
+        sees its pinned subset."""
         with self._lock:
+            allowed = self._placement.get(tenant)
             n = len(self._replicas)
             for healthy_only in (True, False):
                 for k in range(n):
                     rep = self._replicas[(self._rr + k) % n]
                     if rep.name in tried:
+                        continue
+                    if allowed is not None and rep.name not in allowed:
                         continue
                     if healthy_only and not rep.healthy:
                         continue
@@ -235,19 +265,20 @@ class Router:
     # -- request path ----------------------------------------------------
     def _attempt(self, rep: _Replica, rows: np.ndarray,
                  budget_ms: Optional[float], trace_id: Optional[str],
-                 out: "queue.Queue", idx: int) -> None:
+                 tenant: str, out: "queue.Queue", idx: int) -> None:
         try:
             # chaos seams: a dropped or slow link to THIS replica
             faults.fire("rpc_delay", site=rep.name)
             faults.fire("rpc_drop", site=rep.name)
             res = rep.server.submit(rows, timeout_ms=budget_ms,
-                                    trace_id=trace_id)
+                                    trace_id=trace_id, tenant=tenant)
             out.put(("ok", idx, rep, res))
         except BaseException as e:  # noqa: BLE001 — classified by caller
             out.put(("err", idx, rep, e))
 
     def submit(self, rows, timeout_ms: Optional[float] = None,
-               trace_id: Optional[str] = None) -> ServeResult:
+               trace_id: Optional[str] = None,
+               tenant: str = DEFAULT_TENANT) -> ServeResult:
         """Route one request; retries and hedges under the deadline.
         Raises :class:`RequestTimeout` on budget exhaustion (HTTP 504),
         :class:`ServerOverloaded` when every tried replica shed, or the
@@ -284,7 +315,7 @@ class Router:
 
         def launch(is_hedge: bool = False) -> bool:
             nonlocal in_flight, attempts
-            rep = self._pick(tried)
+            rep = self._pick(tried, tenant)
             if rep is None:
                 return False
             tried.add(rep.name)
@@ -292,7 +323,7 @@ class Router:
                 hedge_attempts.add(attempts)
             threading.Thread(
                 target=self._attempt,
-                args=(rep, X, remaining_ms(), trace_id, results,
+                args=(rep, X, remaining_ms(), trace_id, tenant, results,
                       attempts),
                 name=f"router-attempt-{rep.name}", daemon=True).start()
             attempts += 1
@@ -308,8 +339,12 @@ class Router:
             rem = remaining_ms()
             if rem is not None:
                 wait_s = rem / 1e3
+            with self._lock:
+                pinned = self._placement.get(tenant)
+            pool = len(pinned) if pinned is not None \
+                else len(self._replicas)
             can_hedge = (cfg.hedge_ms > 0 and hedges < cfg.max_hedges
-                         and len(tried) < len(self._replicas))
+                         and len(tried) < pool)
             if can_hedge:
                 elapsed_ms = (time.monotonic() - t0) * 1e3
                 hedge_in = max(cfg.hedge_ms * (hedges + 1)
@@ -347,8 +382,10 @@ class Router:
                                 trace_id=res.trace_id)
                 return res
             err: BaseException = payload
-            if isinstance(err, (ValueError, TypeError)):
-                # client input error — identical on every replica
+            if isinstance(err, (ValueError, TypeError, UnknownTenant)):
+                # client input error — identical on every replica (an
+                # unknown tenant is the caller's mistake, not a replica
+                # fault: retrying elsewhere cannot create the lineage)
                 self.metrics.on_error()
                 raise err
             if isinstance(err, RequestTimeout):
@@ -384,9 +421,30 @@ class Router:
             raise ServeError(str(last_err))
 
     # -- Server-compatible surface (ServeHTTP duck-typing) ---------------
-    def version(self) -> Optional[str]:
-        tags = {r.server.registry.current_tag() for r in self._replicas}
+    def version(self, tenant: str = DEFAULT_TENANT) -> Optional[str]:
+        tags = {r.server.tenant_registry(tenant).current_tag()
+                for r in self._replicas}
         return tags.pop() if len(tags) == 1 else None
+
+    def tenant_names(self):
+        return self._replicas[0].server.tenant_names()
+
+    def tenants_snapshot(self) -> Dict[str, Any]:
+        """GET /tenants on a fleet: per-replica tenant views keyed by
+        replica name, the fleet-consensus version per tenant, and the
+        placement map (which replicas each tenant's traffic is pinned
+        to)."""
+        per = {r.name: r.server.tenants_snapshot()["tenants"]
+               for r in self._replicas}
+        versions = {}
+        for t in self.tenant_names():
+            try:
+                versions[t] = self.version(t)
+            except UnknownTenant:
+                versions[t] = None      # mid-add_tenant fan-out
+        return {"replicas": per, "versions": versions,
+                "placement": {t: list(v)
+                              for t, v in self.placement().items()}}
 
     def replica_states(self) -> Dict[str, Dict[str, Any]]:
         return {r.name: {"healthy": r.healthy,
@@ -408,25 +466,40 @@ class Router:
         }
         return snap
 
-    def slo_snapshot(self) -> Dict[str, Any]:
+    def slo_snapshot(self,
+                     tenant: Optional[str] = None) -> Dict[str, Any]:
+        if tenant is not None:
+            # per-tenant burn rates live on the replicas (each tracks
+            # its own traffic slice); the router view is their union
+            per = {r.name: r.server.slo_snapshot(tenant=tenant)
+                   for r in self._replicas}
+            return {"tenant": tenant, "version": self.version(tenant),
+                    "replicas": per}
         out = self.slo.snapshot()
         out["version"] = self.version()
         out["exemplars"] = [
             {"le": le, **ex} for le, ex in self.metrics.exemplars()]
         return out
 
-    def drift_snapshot(self) -> Dict[str, Any]:
+    def drift_snapshot(self,
+                       tenant: Optional[str] = None) -> Dict[str, Any]:
         """GET /drift on a fleet: per-replica skew evaluations (each
         replica samples its own traffic slice against the version's
         reference) keyed by replica name, plus the fleet-level view —
-        armed if ANY replica is, alerting = union."""
-        per = {r.name: r.server.drift_snapshot() for r in self._replicas}
+        armed if ANY replica is, alerting = union.  ``tenant`` narrows
+        every per-replica evaluation to that tenant's detector."""
+        per = {r.name: r.server.drift_snapshot(tenant=tenant)
+               for r in self._replicas}
         alerting = sorted({f for d in per.values()
                            for f in d.get("alerting", [])})
-        return {"armed": any(d.get("armed") for d in per.values()),
-                "version": self.version(),
-                "alerting": alerting,
-                "replicas": per}
+        out = {"armed": any(d.get("armed") for d in per.values()),
+               "version": self.version(tenant if tenant is not None
+                                       else DEFAULT_TENANT),
+               "alerting": alerting,
+               "replicas": per}
+        if tenant is not None:
+            out["tenant"] = tenant
+        return out
 
     def health(self) -> Dict[str, Any]:
         """Fleet-level liveness: ok while ANY replica is healthy (the

@@ -73,6 +73,23 @@ class DispatcherDied(ServeError):
     watchdog restarts the dispatcher and fails the stranded requests."""
 
 
+class UnknownTenant(ServeError):
+    """The request named a tenant this server does not host.  HTTP maps
+    it to 404 — an unknown lineage is a client addressing error, not an
+    overload or a server fault."""
+
+
+# the default tenant: the single-model contract every pre-tenancy caller
+# uses.  Its registry/SLO ARE the server's top-level ``registry``/``slo``
+# attributes, so solo deployments behave bit-identically.
+DEFAULT_TENANT = ""
+
+
+def _tenant_label(name: str) -> str:
+    """Prometheus label value for a tenant ("" reads as 'default')."""
+    return name or "default"
+
+
 @dataclass
 class ServeConfig:
     """Serving policy knobs (mirrored by the ``serve_*`` names in
@@ -110,6 +127,9 @@ class ServeConfig:
     drift_top_k: int = 8
     drift_psi_groups: int = 16
     drift_sample_stride: int = 4    # sample every Nth device batch
+    # -- registry history bound (ISSUE 20 satellite): current + last N
+    # versions retained per registry; rollback depth == keep_versions
+    keep_versions: int = 4
     predictor_kwargs: Dict[str, Any] = field(default_factory=dict)
 
     def __post_init__(self):
@@ -134,6 +154,7 @@ class ServeConfig:
         self.drift_top_k = max(int(self.drift_top_k), 1)
         self.drift_psi_groups = max(int(self.drift_psi_groups), 2)
         self.drift_sample_stride = max(int(self.drift_sample_stride), 1)
+        self.keep_versions = max(int(self.keep_versions), 1)
         if self.slo is None:
             self.slo = SLOConfig()
 
@@ -154,10 +175,10 @@ class ServeResult:
 
 class _Request:
     __slots__ = ("rows", "n", "t_enq", "deadline", "event", "result",
-                 "error", "trace_id")
+                 "error", "trace_id", "state")
 
     def __init__(self, rows: np.ndarray, deadline: Optional[float],
-                 trace_id: Optional[str] = None):
+                 trace_id: Optional[str] = None, state=None):
         self.rows = rows
         self.n = rows.shape[0]
         self.t_enq = time.monotonic()
@@ -169,6 +190,37 @@ class _Request:
         # armed — the X-Trace-Id echo and the latency decomposition in
         # ServeResult are always-on; only SPAN RECORDING is gated
         self.trace_id = trace_id or trace.new_trace_id()
+        # the tenant state that owns this request (_TenantState) —
+        # batches are single-tenant, so the dispatcher reads the model,
+        # SLO tracker and drift detector off the request, never a global
+        self.state = state
+
+
+class _TenantState:
+    """One hosted model lineage: its own registry (versioning/rollback),
+    SLO tracker, drift detector anchor, and queue-row accounting for
+    fair-share admission.  The DEFAULT tenant ("") aliases the server's
+    top-level ``registry``/``slo`` so single-model callers see exactly
+    the pre-tenancy object graph."""
+
+    __slots__ = ("name", "registry", "slo", "weight", "queue_rows",
+                 "share_rows", "drift", "drift_tag",
+                 "submitted", "completed", "shed", "errors")
+
+    def __init__(self, name: str, registry: ModelRegistry,
+                 slo: SLOTracker, weight: float = 1.0):
+        self.name = name
+        self.registry = registry
+        self.slo = slo
+        self.weight = max(float(weight), 0.0)
+        self.queue_rows = 0
+        self.share_rows = 0         # fair-share admission cap (rows)
+        self.drift = None
+        self.drift_tag: Optional[str] = None
+        self.submitted = 0
+        self.completed = 0
+        self.shed = 0
+        self.errors = 0
 
 
 class Server:
@@ -190,7 +242,22 @@ class Server:
         self.registry = registry or ModelRegistry(
             metrics=self.metrics,
             predictor_kwargs=self.config.predictor_kwargs,
-            name=self.name)
+            name=self.name, history=self.config.keep_versions)
+        # tenant table: the default tenant "" aliases the top-level
+        # registry/slo; add_tenant() grows named lineages.  Per-tenant
+        # request outcomes ride one labeled counter (the obs registry's
+        # cardinality cap collapses a tenant explosion into _overflow)
+        self._tenants: Dict[str, _TenantState] = {
+            DEFAULT_TENANT: _TenantState(DEFAULT_TENANT, self.registry,
+                                         self.slo)}
+        self._recompute_shares()
+        self._tenant_requests = self.metrics.registry.counter(
+            "serve_tenant_requests_total",
+            "Per-tenant request outcomes",
+            label_names=("tenant", "outcome"))
+        self._tenant_queue_gauge = self.metrics.registry.gauge(
+            "serve_tenant_queue_rows", "Backlogged rows per tenant",
+            label_names=("tenant",))
         self._cond = threading.Condition()
         self._queue: deque = deque()
         self._queue_rows = 0
@@ -223,35 +290,150 @@ class Server:
                 daemon=True)
             self._watchdog.start()
 
+    # -- tenant lifecycle (ISSUE 20) -------------------------------------
+    def _recompute_shares(self) -> None:
+        """Fair-share admission caps: each tenant owns
+        ``queue_depth_rows * weight / total_weight`` backlog rows
+        (floored at one full batch so every tenant can always make
+        progress).  A single-tenant server's cap equals the full queue
+        depth — pre-tenancy admission behavior bit-identically."""
+        depth = self.config.queue_depth_rows
+        states = list(self._tenants.values())
+        total_w = sum(st.weight for st in states) or 1.0
+        if len(states) == 1:
+            states[0].share_rows = depth
+            return
+        for st in states:
+            st.share_rows = max(int(depth * st.weight / total_w),
+                                self.config.max_batch_rows)
+
+    def add_tenant(self, name: str, *, weight: float = 1.0,
+                   slo: Optional[SLOConfig] = None,
+                   predictor_kwargs: Optional[Dict[str, Any]] = None
+                   ) -> "_TenantState":
+        """Register a named model lineage: its own registry (named
+        ``replica:tenant`` so chaos plans and warm events are tenant-
+        addressable), its own SLO tracker, and a fair-share weight.
+        Idempotent on re-add (weight is updated)."""
+        if not name:
+            raise ValueError("tenant name must be non-empty (the default "
+                             "tenant exists already)")
+        with self._cond:
+            st = self._tenants.get(name)
+            if st is not None:
+                st.weight = max(float(weight), 0.0)
+                self._recompute_shares()
+                return st
+            pk = dict(self.config.predictor_kwargs)
+            pk.update(predictor_kwargs or {})
+            reg = ModelRegistry(
+                metrics=self.metrics, predictor_kwargs=pk,
+                name=(f"{self.name}:{name}" if self.name else name),
+                history=self.config.keep_versions)
+            st = _TenantState(
+                name, reg, SLOTracker(slo or self.config.slo),
+                weight=weight)
+            self._tenants[name] = st
+            self._recompute_shares()
+        obs_events.publish("serve.tenant_added",
+                           f"tenant {name} registered",
+                           tenant=name, weight=st.weight,
+                           replica=self.name or "")
+        return st
+
+    def remove_tenant(self, name: str) -> None:
+        """Drop a named lineage (pending requests for it fail at their
+        next dispatch with UnknownTenant; queued rows are released)."""
+        if not name:
+            raise ValueError("cannot remove the default tenant")
+        with self._cond:
+            st = self._tenants.pop(name, None)
+            if st is None:
+                raise UnknownTenant(f"no tenant {name!r}")
+            stranded = [r for r in self._queue if r.state is st]
+            for r in stranded:
+                self._queue.remove(r)
+            self._queue_rows -= sum(r.n for r in stranded)
+            self._recompute_shares()
+        for r in stranded:
+            r.error = UnknownTenant(f"tenant {name!r} removed")
+            r.event.set()
+        obs_events.publish("serve.tenant_removed",
+                           f"tenant {name} dropped", tenant=name,
+                           replica=self.name or "")
+
+    def tenant_names(self) -> List[str]:
+        with self._cond:
+            return sorted(self._tenants)
+
+    def _tenant_state(self, tenant: str) -> "_TenantState":
+        st = self._tenants.get(tenant)
+        if st is None:
+            raise UnknownTenant(
+                f"no tenant {tenant!r} on this server "
+                f"(hosted: {sorted(self._tenants) or ['<default>']})")
+        return st
+
+    def tenant_registry(self, tenant: str = DEFAULT_TENANT
+                        ) -> ModelRegistry:
+        """The named tenant's registry (fleet.py's two-phase publish
+        drives prepare/commit on it directly)."""
+        return self._tenant_state(tenant).registry
+
+    def _slo_record(self, st: "_TenantState", ok: bool,
+                    latency_ms: Optional[float] = None,
+                    trace_id: str = "") -> None:
+        """Record into the tenant's SLO tracker AND the server-wide one
+        (the default tenant's tracker IS the server-wide tracker — never
+        double-counted)."""
+        st.slo.record(ok, latency_ms=latency_ms, trace_id=trace_id)
+        if st.slo is not self.slo:
+            self.slo.record(ok, latency_ms=latency_ms, trace_id=trace_id)
+
+    def _tenant_outcome(self, st: "_TenantState", outcome: str) -> None:
+        self._tenant_requests.labels(
+            tenant=_tenant_label(st.name), outcome=outcome).inc()
+
     # -- model lifecycle -------------------------------------------------
-    def publish(self, model, **meta) -> str:
+    def publish(self, model, tenant: str = DEFAULT_TENANT, **meta) -> str:
         """Prebin/stack/warm/VALIDATE the new ensemble OFF the serving
         path, then atomically swap it in (registry.py).  In-flight
         batches finish on the old version; the tag is echoed in every
         response.  A candidate that fails validation (structural, finite,
         or golden-probe — see registry.publish) raises
-        ``PublishValidationError`` and never serves a single answer."""
-        return self.registry.publish(
+        ``PublishValidationError`` and never serves a single answer.
+        ``tenant`` publishes into that lineage's registry — other
+        tenants' active versions are untouchable by construction (their
+        registries are separate objects)."""
+        return self._tenant_state(tenant).registry.publish(
             model, degrade_trees=self.config.degrade_trees,
             max_batch_rows=self.config.max_batch_rows, meta=meta or None,
             probe_rows=self.config.probe_rows)
 
-    def rollback(self) -> str:
-        return self.registry.rollback()
+    def rollback(self, tenant: str = DEFAULT_TENANT) -> str:
+        return self._tenant_state(tenant).registry.rollback()
 
-    def version(self) -> Optional[str]:
-        return self.registry.current_tag()
+    def version(self, tenant: str = DEFAULT_TENANT) -> Optional[str]:
+        return self._tenant_state(tenant).registry.current_tag()
 
     # -- request path ----------------------------------------------------
     def submit(self, rows, timeout_ms: Optional[float] = None,
-               trace_id: Optional[str] = None) -> ServeResult:
+               trace_id: Optional[str] = None,
+               tenant: str = DEFAULT_TENANT) -> ServeResult:
         """Block until the rows are scored; raises
         :class:`ServerOverloaded` (queue full), :class:`RequestTimeout`
-        (deadline expired in queue) or :class:`ServerClosed`.
-        ``trace_id`` (e.g. an inbound ``X-Trace-Id`` header) is carried
-        through queue -> batch -> walk and echoed in the result; one is
-        minted when absent."""
-        mv = self.registry.current()          # raises before queueing when
+        (deadline expired in queue), :class:`ServerClosed`, or
+        :class:`UnknownTenant`.  ``trace_id`` (e.g. an inbound
+        ``X-Trace-Id`` header) is carried through queue -> batch -> walk
+        and echoed in the result; one is minted when absent.
+
+        Fair-share admission: a tenant's backlog is capped at ITS share
+        of the queue (``_recompute_shares``) before the global depth is
+        even consulted — an overloaded tenant sheds its OWN traffic
+        first, and a well-behaved tenant's admission headroom is
+        untouched by a noisy neighbor."""
+        st = self._tenant_state(tenant)
+        mv = st.registry.current()            # raises before queueing when
         X = np.asarray(rows, np.float64)      # nothing is published yet
         if X.ndim == 1:
             X = X.reshape(1, -1)
@@ -261,22 +443,39 @@ class Server:
                 f"features; the serving model has {mv.num_features}")
         t_ms = self.config.timeout_ms if timeout_ms is None else timeout_ms
         deadline = (time.monotonic() + t_ms / 1e3) if t_ms > 0 else None
-        req = _Request(X, deadline, trace_id)
+        req = _Request(X, deadline, trace_id, state=st)
         with self._cond:
             if self._closed:
                 raise ServerClosed("server is shut down")
-            if self._queue_rows + req.n > self.config.queue_depth_rows:
+            over_share = st.queue_rows + req.n > st.share_rows
+            over_depth = (self._queue_rows + req.n
+                          > self.config.queue_depth_rows)
+            if over_share or over_depth:
                 self.metrics.on_shed()
-                self.slo.record(False, trace_id=req.trace_id)
+                st.shed += 1
+                self._tenant_outcome(st, "shed")
+                self._slo_record(st, False, trace_id=req.trace_id)
                 obs_events.publish(
-                    "serve.shed", "admission queue full",
+                    "serve.shed",
+                    ("tenant over fair share" if over_share
+                     else "admission queue full"),
                     severity="warning", rows=req.n,
-                    backlog=self._queue_rows, trace_id=req.trace_id)
+                    backlog=self._queue_rows,
+                    tenant=_tenant_label(st.name),
+                    tenant_backlog=st.queue_rows,
+                    trace_id=req.trace_id)
                 raise ServerOverloaded(
-                    f"queue full ({self._queue_rows} rows backlogged, "
-                    f"depth {self.config.queue_depth_rows})")
+                    f"queue full for tenant "
+                    f"{_tenant_label(st.name)!r} ({st.queue_rows} of "
+                    f"{st.share_rows} fair-share rows backlogged; "
+                    f"{self._queue_rows} fleet-wide, depth "
+                    f"{self.config.queue_depth_rows})")
             self._queue.append(req)
             self._queue_rows += req.n
+            st.queue_rows += req.n
+            st.submitted += 1
+            self._tenant_queue_gauge.labels(
+                tenant=_tenant_label(st.name)).set(st.queue_rows)
             self.metrics.on_submit(req.n, self._queue_rows)
             self._cond.notify()
         req.event.wait()
@@ -291,25 +490,68 @@ class Server:
         snap["versions"] = self.registry.versions()
         return snap
 
-    def slo_snapshot(self) -> Dict[str, Any]:
+    def slo_snapshot(self, tenant: Optional[str] = None) -> Dict[str, Any]:
         """The ``GET /slo`` payload: burn-rate evaluation + per-bucket
         worst-tail exemplar trace ids from the latency histogram, so an
         alerting burn rate hands the operator the request ids to grep
-        in an armed trace."""
-        out = self.slo.snapshot()
-        out["version"] = self.registry.current_tag()
-        out["exemplars"] = [
-            {"le": le, **ex} for le, ex in self.metrics.exemplars()]
+        in an armed trace.  ``tenant`` scopes the evaluation to that
+        lineage's own tracker (``GET /slo?tenant=``)."""
+        if tenant is None:
+            out = self.slo.snapshot()
+            out["version"] = self.registry.current_tag()
+            out["exemplars"] = [
+                {"le": le, **ex} for le, ex in self.metrics.exemplars()]
+            return out
+        st = self._tenant_state(tenant)
+        out = st.slo.snapshot()
+        out["tenant"] = _tenant_label(st.name)
+        out["version"] = st.registry.current_tag()
         return out
 
+    def tenants_snapshot(self) -> Dict[str, Any]:
+        """The ``GET /tenants`` payload: every hosted lineage's version
+        lineage, fair-share position, queue occupancy, request outcomes
+        and SLO alert state — the placement controller's per-replica
+        signal read."""
+        with self._cond:
+            states = list(self._tenants.values())
+        tenants = {}
+        for st in states:
+            ev = st.slo.evaluate()
+            alerts = ev.get("alerts", {})
+            burn = max(
+                ev["availability"]["windows"]["fast"]["burn_rate"],
+                ev["latency"]["windows"]["fast"]["burn_rate"])
+            tenants[_tenant_label(st.name)] = {
+                "version": st.registry.current_tag(),
+                "versions": st.registry.versions(),
+                "weight": st.weight,
+                "share_rows": st.share_rows,
+                "queue_rows": st.queue_rows,
+                "occupancy": (round(st.queue_rows / st.share_rows, 4)
+                              if st.share_rows else 0.0),
+                "submitted": st.submitted,
+                "completed": st.completed,
+                "shed": st.shed,
+                "errors": st.errors,
+                "slo_page": bool(alerts.get("availability_page")
+                                 or alerts.get("latency_page")),
+                "slo_warn": bool(alerts.get("availability_warn")
+                                 or alerts.get("latency_warn")),
+                "burn_rate": burn,
+            }
+        return {"replica": self.name or "", "tenants": tenants}
+
     # -- train/serve skew detection (obs/drift.py) -----------------------
-    def _drift_for(self, mv: ModelVersion):
-        """The active version's DriftDetector (dispatcher thread only):
-        rebuilt when the served tag changes, shared otherwise.  A
-        version published without a ``model_reference`` disables
-        detection until the next version that carries one."""
-        if self._drift_tag == mv.tag:
-            return self._drift
+    def _drift_for(self, st: "_TenantState", mv: ModelVersion):
+        """The tenant's active-version DriftDetector (dispatcher thread
+        only): rebuilt when the served tag changes — publish, rollback
+        and breaker swaps RE-ANCHOR the detector to the new version's
+        own reference automatically, per tenant.  A version published
+        without a ``model_reference`` disables detection until the next
+        version that carries one."""
+        if st.drift_tag == mv.tag:
+            return st.drift
         ref = mv.meta.get("model_reference")
         det = None
         if ref is not None:
@@ -325,20 +567,29 @@ class Server:
                             top_k=cfg.drift_top_k,
                             psi_groups=cfg.drift_psi_groups,
                             sample_stride=cfg.drift_sample_stride),
-                registry=self.metrics.registry, version_tag=mv.tag)
-        self._drift = det
-        self._drift_tag = mv.tag
+                registry=self.metrics.registry,
+                version_tag=(f"{_tenant_label(st.name)}:{mv.tag}"
+                             if st.name else mv.tag))
+        st.drift = det
+        st.drift_tag = mv.tag
         return det
 
-    def drift_snapshot(self) -> Dict[str, Any]:
+    def drift_snapshot(self, tenant: Optional[str] = None
+                       ) -> Dict[str, Any]:
         """The ``GET /drift`` payload: arming state + the active
         detector's evaluation (per-feature PSI top-K, skew counters,
-        score drift) — or the reason there is nothing to judge."""
+        score drift) — or the reason there is nothing to judge.
+        ``tenant`` scopes to that lineage's own detector
+        (``GET /drift?tenant=``); default = the default tenant."""
+        st = self._tenant_state(DEFAULT_TENANT if tenant is None
+                                else tenant)
         out: Dict[str, Any] = {
             "armed": self.config.drift_sample_rows > 0,
-            "version": self.registry.current_tag(),
+            "version": st.registry.current_tag(),
         }
-        det = self._drift
+        if tenant is not None:
+            out["tenant"] = _tenant_label(st.name)
+        det = st.drift
         if not out["armed"]:
             out["reason"] = "drift_sample_rows=0 (sampling off)"
         elif det is None:
@@ -406,6 +657,8 @@ class Server:
             pending = list(self._queue)
             self._queue.clear()
             self._queue_rows = 0
+            for st in self._tenants.values():
+                st.queue_rows = 0
             self._cond.notify_all()
         for req in pending:
             req.error = ServerClosed("server shut down with request queued")
@@ -422,7 +675,13 @@ class Server:
     def _collect_batch(self) -> Optional[List[_Request]]:
         """Deadline-aware collection: return a batch when the pending rows
         fill ``max_batch_rows`` or the oldest request's delay budget is
-        spent; otherwise keep waiting on the condition."""
+        spent; otherwise keep waiting on the condition.
+
+        Batches are SINGLE-TENANT: the oldest request's tenant defines
+        the batch and only that tenant's requests ride it (they share one
+        model version and one SLO domain); other tenants' requests keep
+        their queue order for the next collection.  A solo-tenant server
+        collects exactly as before."""
         cfg = self.config
         delay_s = cfg.max_batch_delay_ms / 1e3
         with self._cond:
@@ -434,16 +693,26 @@ class Server:
                     dispatch_at = self._queue[0].t_enq + delay_s
                     if (self._queue_rows >= cfg.max_batch_rows
                             or now >= dispatch_at):
+                        st = self._queue[0].state
                         batch: List[_Request] = []
+                        keep: deque = deque()
                         rows = 0
-                        while self._queue and (
-                                not batch
-                                or rows + self._queue[0].n
-                                <= cfg.max_batch_rows):
+                        while self._queue:
                             r = self._queue.popleft()
-                            batch.append(r)
-                            rows += r.n
+                            if r.state is st and (
+                                    not batch
+                                    or rows + r.n <= cfg.max_batch_rows):
+                                batch.append(r)
+                                rows += r.n
+                            else:
+                                keep.append(r)
+                        self._queue = keep
                         self._queue_rows -= rows
+                        if st is not None:
+                            st.queue_rows = max(st.queue_rows - rows, 0)
+                            self._tenant_queue_gauge.labels(
+                                tenant=_tenant_label(st.name)).set(
+                                    st.queue_rows)
                         return batch
                     self._cond.wait(dispatch_at - now)
                 else:
@@ -472,7 +741,8 @@ class Server:
                 # breaker state that failure produced (the old order
                 # raced clients against the trip)
                 self._consec_failures += 1
-                self._maybe_trip_breaker()
+                self._maybe_trip_breaker(
+                    batch[0].state if batch else None)
                 self._fail_batch(batch, e)
                 log_warning(f"serve: batch failed after retries "
                             f"({type(e).__name__}: {e})")
@@ -482,7 +752,10 @@ class Server:
         for req in batch:
             if not req.event.is_set():
                 self.metrics.on_error()
-                self.slo.record(False, trace_id=req.trace_id)
+                st = req.state or self._tenants[DEFAULT_TENANT]
+                st.errors += 1
+                self._tenant_outcome(st, "error")
+                self._slo_record(st, False, trace_id=req.trace_id)
                 req.error = (err if isinstance(err, Exception)
                              else ServeError(str(err)))
                 req.event.set()
@@ -493,18 +766,22 @@ class Server:
                 f"{type(err).__name__}: {err}", severity="error",
                 requests=n_failed)
 
-    def _maybe_trip_breaker(self) -> None:
+    def _maybe_trip_breaker(self, st: Optional["_TenantState"] = None
+                            ) -> None:
         """Circuit breaker: ``breaker_failures`` CONSECUTIVE failed
         batches auto-roll the registry back to the previous version — a
         bad publish that slipped past validation (or a version whose
         executables started failing) un-ships itself instead of failing
-        every batch forever."""
+        every batch forever.  Batches are single-tenant, so the
+        rollback targets the FAILING tenant's registry — a bad tenant
+        publish un-ships itself without touching its neighbors."""
         bf = self.config.breaker_failures
         if bf <= 0 or self._consec_failures < bf:
             return
         self._consec_failures = 0
+        registry = (st or self._tenants[DEFAULT_TENANT]).registry
         try:
-            tag = self.registry.rollback()
+            tag = registry.rollback()
         except Exception as e:  # noqa: BLE001 — nothing to roll back to
             obs_events.publish(
                 "serve.breaker_trip", "no previous version to roll "
@@ -546,11 +823,14 @@ class Server:
 
     def _run_batch(self, batch: List[_Request]) -> None:
         now = time.monotonic()
+        st = (batch[0].state if batch and batch[0].state is not None
+              else self._tenants[DEFAULT_TENANT])
         live: List[_Request] = []
         for req in batch:
             if req.deadline is not None and now > req.deadline:
                 self.metrics.on_timeout()
-                self.slo.record(False, trace_id=req.trace_id)
+                self._tenant_outcome(st, "timeout")
+                self._slo_record(st, False, trace_id=req.trace_id)
                 req.error = RequestTimeout(
                     f"deadline expired after "
                     f"{(now - req.t_enq) * 1e3:.1f} ms in queue")
@@ -559,7 +839,7 @@ class Server:
                 live.append(req)
         if not live:
             return
-        mv: ModelVersion = self.registry.current()
+        mv: ModelVersion = st.registry.current()
         with self._cond:
             backlog = self._queue_rows
         degraded = (mv.degraded is not None
@@ -585,15 +865,15 @@ class Server:
             # armed skew sampling (one strided row copy per batch; the
             # <= 2% armed-overhead contract is measured by bench.py
             # measure_drift); disarmed cost is this one compare
-            det = self._drift_for(mv)
+            det = self._drift_for(st, mv)
             if det is not None:
                 try:
                     det.offer(X, np.asarray(out))
                 except Exception as e:  # noqa: BLE001 — telemetry must
                     log_warning(f"serve: drift sampling failed "
                                 f"({type(e).__name__}: {e})")  # never
-                    self._drift = None                         # fail a
-                    self._drift_tag = mv.tag                   # batch
+                    st.drift = None                            # fail a
+                    st.drift_tag = mv.tag                      # batch
         done = time.monotonic()
         walk_ms = (done - t_collect) * 1e3
         if trace.enabled():
@@ -631,8 +911,10 @@ class Server:
                 walk_ms=walk_ms)
             self.metrics.on_complete(lat_ms, degraded,
                                      trace_id=req.trace_id)
-            self.slo.record(True, latency_ms=lat_ms,
-                            trace_id=req.trace_id)
+            st.completed += 1
+            self._tenant_outcome(st, "ok")
+            self._slo_record(st, True, latency_ms=lat_ms,
+                             trace_id=req.trace_id)
             req.event.set()
 
     # -- watchdog --------------------------------------------------------
@@ -659,7 +941,10 @@ class Server:
                                 f"{self.config.watchdog_ms:.0f} ms "
                                 "watchdog deadline")
                             req.event.set()
-                            self.slo.record(False, trace_id=req.trace_id)
+                            self._slo_record(
+                                req.state
+                                or self._tenants[DEFAULT_TENANT],
+                                False, trace_id=req.trace_id)
                             n_failed += 1
                     if n_failed:
                         self._last_wedge_unix = time.time()
@@ -716,6 +1001,7 @@ def serve_config_from(config) -> ServeConfig:
         breaker_failures=config.serve_breaker_failures,
         watchdog_ms=config.serve_watchdog_ms,
         probe_rows=config.serve_probe_rows,
+        keep_versions=config.registry_keep_versions,
         slo=SLOConfig(
             availability_target=config.serve_slo_availability_target,
             latency_ms=config.serve_slo_latency_ms,

@@ -1,0 +1,192 @@
+"""Multi-tenant model multiplexing over one server or fleet (ISSUE 20).
+
+The reference's C API hosts many independent ``Booster`` handles in one
+process (PAPER.md layer map: ``c_api.cpp``); the serve stack's analog is
+hundreds of named model LINEAGES sharing one fleet's devices, compile
+cache and admission queue.  :class:`TenantRegistry` is the control-plane
+façade over the per-tenant machinery that already lives in the data
+plane:
+
+* **per-tenant versioning/rollback** — each tenant owns a full
+  :class:`~lightgbmv1_tpu.serve.registry.ModelRegistry` per replica
+  (named ``replica:tenant`` so warm events and chaos plans are
+  tenant-addressable).  Publish rides the SAME two-phase prepare/commit
+  the single-lineage fleet publish uses (fleet.py): a failed tenant
+  publish aborts with ZERO replicas swapped and cannot disturb any
+  other tenant's active version — their registries are separate objects
+  by construction.
+* **cross-tenant compile-bucket sharing** — tenants are registered with
+  ``shared_cache=True`` predictors (models/predict.py): the jit cache
+  is keyed on ``(tree-shape signature, bucket, kind)``, NOT tenant
+  identity, so tenants whose stacked-tree shapes match serve through
+  ONE compiled executable.  ``compile_share_stats()`` exposes the hit
+  rate; PR 12's per-label compile/retrace counters
+  (obs/xla.compile_stats) prove the second tenant's warm added zero
+  compiles.
+* **fair-share admission** — ``weight`` flows to the server's
+  per-tenant row accounting (server.py ``_recompute_shares``): an
+  overloaded tenant sheds its OWN traffic first.
+
+The backend is duck-typed: a :class:`~lightgbmv1_tpu.serve.Server`, a
+:class:`~lightgbmv1_tpu.serve.Fleet`, or anything exposing
+``add_tenant / remove_tenant / tenant_names / publish / rollback /
+version / tenants_snapshot``.
+
+Tenant manifests (CLI ``task=serve tenant_manifest=...``) use the
+compact ``name[:weight][,name[:weight]...]`` grammar —
+``"acme:3,globex"`` is tenant ``acme`` at weight 3 and ``globex`` at
+the default weight 1.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+from ..utils.log import log_info
+from .slo import SLOConfig
+
+
+@dataclass
+class TenantSpec:
+    """One tenant's declaration: identity, fair-share weight, optional
+    per-tenant SLO targets and predictor overrides."""
+
+    name: str
+    weight: float = 1.0
+    slo: Optional[SLOConfig] = None
+    predictor_kwargs: Dict[str, Any] = field(default_factory=dict)
+
+    def __post_init__(self):
+        if not self.name or not isinstance(self.name, str):
+            raise ValueError("tenant name must be a non-empty string")
+        if "," in self.name or ":" in self.name:
+            raise ValueError(
+                f"tenant name {self.name!r} may not contain ',' or ':' "
+                "(manifest grammar delimiters)")
+        self.weight = float(self.weight)
+        if self.weight <= 0:
+            raise ValueError(
+                f"tenant {self.name!r}: weight must be > 0, got "
+                f"{self.weight}")
+
+
+def parse_manifest(spec: str) -> List[TenantSpec]:
+    """``"acme:3,globex"`` -> ``[TenantSpec("acme", 3.0),
+    TenantSpec("globex", 1.0)]``.  Duplicate names are rejected — a
+    manifest that silently last-writer-wins a weight is a config bug."""
+    out: List[TenantSpec] = []
+    seen = set()
+    for entry in (spec or "").split(","):
+        entry = entry.strip()
+        if not entry:
+            continue
+        name, _, w = entry.partition(":")
+        name = name.strip()
+        try:
+            weight = float(w) if w.strip() else 1.0
+        except ValueError:
+            raise ValueError(
+                f"tenant manifest entry {entry!r}: weight {w!r} is not "
+                "a number") from None
+        if name in seen:
+            raise ValueError(f"tenant {name!r} appears twice in the "
+                             "manifest")
+        seen.add(name)
+        out.append(TenantSpec(name, weight))
+    return out
+
+
+def compile_share_stats() -> Dict[str, Any]:
+    """The cross-tenant executable-sharing scoreboard: hit/miss/entry
+    counts of the shape-keyed shared jit cache (models/predict.py) plus
+    ``share_frac`` = hits / lookups — the ``tenant_compile_share_frac``
+    BENCH rate.  A fleet of same-shape tenants converges toward 1.0;
+    0.0 means every tenant compiled privately."""
+    from ..models.predict import shared_cache_stats
+
+    stats = dict(shared_cache_stats())
+    lookups = stats["hits"] + stats["misses"]
+    stats["share_frac"] = (round(stats["hits"] / lookups, 4)
+                           if lookups else 0.0)
+    return stats
+
+
+class TenantRegistry:
+    """Control plane for named model lineages over one backend.
+
+    ``shared_compile=True`` (default) registers every tenant's
+    predictors with the shape-keyed shared jit cache so same-shape
+    tenants reuse one executable; a caller-supplied
+    ``predictor_kwargs`` in the spec still wins (a tenant can opt out
+    of sharing explicitly)."""
+
+    def __init__(self, backend, *, shared_compile: bool = True):
+        self.backend = backend
+        self.shared_compile = bool(shared_compile)
+        self._specs: Dict[str, TenantSpec] = {}
+
+    # -- lifecycle -------------------------------------------------------
+    def add(self, spec, *, weight: Optional[float] = None,
+            slo: Optional[SLOConfig] = None,
+            predictor_kwargs: Optional[Dict[str, Any]] = None
+            ) -> TenantSpec:
+        """Register a tenant (idempotent; re-add updates the weight).
+        ``spec`` is a :class:`TenantSpec` or a bare name."""
+        if not isinstance(spec, TenantSpec):
+            spec = TenantSpec(str(spec),
+                              weight=1.0 if weight is None else weight,
+                              slo=slo,
+                              predictor_kwargs=dict(
+                                  predictor_kwargs or {}))
+        pk = dict(spec.predictor_kwargs)
+        if self.shared_compile:
+            pk.setdefault("shared_cache", True)
+        self.backend.add_tenant(spec.name, weight=spec.weight,
+                                slo=spec.slo, predictor_kwargs=pk)
+        self._specs[spec.name] = spec
+        return spec
+
+    def add_manifest(self, manifest: str) -> List[TenantSpec]:
+        specs = parse_manifest(manifest)
+        for s in specs:
+            self.add(s)
+        if specs:
+            log_info(f"tenants: manifest registered "
+                     f"{[s.name for s in specs]}")
+        return specs
+
+    def remove(self, name: str) -> None:
+        self.backend.remove_tenant(name)
+        self._specs.pop(name, None)
+
+    def names(self) -> List[str]:
+        return [n for n in self.backend.tenant_names() if n]
+
+    def spec(self, name: str) -> Optional[TenantSpec]:
+        return self._specs.get(name)
+
+    # -- model lifecycle (two-phase on a fleet backend) ------------------
+    def publish(self, name: str, model, **meta) -> str:
+        """Publish into ONE tenant's lineage.  On a fleet backend this
+        is the two-phase prepare/commit (fleet.py): any replica's
+        validation failure aborts with zero replicas swapped — and
+        because every tenant's registry is a separate object, a failed
+        publish for tenant A cannot touch tenant B's active version."""
+        return self.backend.publish(model, tenant=name, **meta)
+
+    def rollback(self, name: str) -> str:
+        return self.backend.rollback(tenant=name)
+
+    def version(self, name: str) -> Optional[str]:
+        return self.backend.version(tenant=name)
+
+    # -- observability ---------------------------------------------------
+    def snapshot(self) -> Dict[str, Any]:
+        """The backend's ``GET /tenants`` payload plus the
+        compile-sharing scoreboard."""
+        out = self.backend.tenants_snapshot()
+        out["compile_share"] = compile_share_stats()
+        return out
+
+    compile_share_stats = staticmethod(compile_share_stats)

@@ -527,6 +527,51 @@ def test_label_cardinality_default_is_generous():
         assert len(g._children) == 64
 
 
+def test_tenant_explosion_collapses_at_300_plus_scale():
+    """ISSUE 20 satellite: the multi-tenant serving counter shape
+    (``tenant`` x ``outcome``, exactly what server.py's
+    ``serve_tenant_requests_total`` writes) driven past the default cap
+    by 320 tenants.  The first ``label_cardinality`` combinations keep
+    their own children; every later tenant collapses into ONE shared
+    ``_overflow`` child; the exposition stays bounded; and the
+    top-of-cap tenants' series are NOT poisoned by the tail — they keep
+    counting exactly.  (test_tenants.py proves the same cap inside a
+    live Server, where the SLO/drift/tenants snapshots ride per-tenant
+    state objects and survive the collapse untouched.)"""
+    cap = obs_metrics.DEFAULT_LABEL_CARDINALITY
+    n = 320
+    assert n > cap                       # the test must overflow the cap
+    reg = obs_metrics.Registry()
+    c = reg.counter("serve_tenant_requests_total",
+                    "Per-tenant request outcomes",
+                    label_names=("tenant", "outcome"))
+    for i in range(n):
+        c.labels(tenant=f"t{i:03d}", outcome="ok").inc()
+    with c.lock:
+        assert len(c._children) == cap + 1           # cap real + overflow
+    # a top-of-cap tenant keeps ITS child past the explosion: counting
+    # stays exact, unpoisoned by the 64-tenant overflow tail
+    top = c.labels(tenant="t000", outcome="ok")
+    assert top.get() == 1.0
+    top.inc()
+    assert c.labels(tenant="t000", outcome="ok").get() == 2.0
+    # every post-cap tenant shares ONE overflow child, and each
+    # collapsed write was counted on the overflow meter
+    late = c.labels(tenant=f"t{cap:03d}", outcome="ok")
+    assert late is c.labels(tenant=f"t{n - 1:03d}", outcome="ok")
+    assert late.get() == float(n - cap)
+    ovf = reg.get("obs_label_overflow_total")
+    assert ovf.labels(
+        metric="serve_tenant_requests_total").get() >= n - cap
+    # bounded exposition no matter how many tenants wrote
+    text = reg.prometheus_text()
+    assert text.count("serve_tenant_requests_total{") == cap + 1
+    assert 'tenant="_overflow"' in text
+    snap = reg.snapshot()
+    assert sum(1 for k in snap
+               if k.startswith("serve_tenant_requests_total{")) == cap + 1
+
+
 def test_serve_metrics_adapter_parity_and_exposition(booster):
     """serve/metrics.py is a thin adapter over the registry: the JSON
     snapshot keeps its exact pre-obs key set, and the SAME store renders

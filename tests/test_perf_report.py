@@ -502,6 +502,49 @@ def test_fleet_section_renders_fields():
     assert "No fleet fields" in "\n".join(lines)
 
 
+def test_tenants_section_renders_fields():
+    """The Multi-tenant serving section (ISSUE 20) is generated from
+    the BENCH tenant_* fields (bench.py measure_tenants): the
+    compile-share counters, the isolation probe row and every
+    sub-guard grep to record fields."""
+    import perf_report
+
+    rec = {
+        "tenant_ok": True, "tenant_compile_share_frac": 0.5,
+        "tenant_shared_cache_hits": 4,
+        "tenant_second_warm_compiles": 0, "tenant_mixed_retraces": 0,
+        "tenant_hot_shed": 6, "tenant_cold_shed": 0,
+        "tenant_cold_p99_ms": 8.8,
+        "tenant_isolation_p99_delta_ms": 4.82,
+        "tenant_placement_moves": 1,
+        "tenant_compile_share_ok": True, "tenant_fair_share_ok": True,
+        "tenant_publish_parity_ok": True,
+        "tenant_placement_move_ok": True,
+    }
+    lines = []
+    perf_report.tenants_section(lines.append, rec)
+    txt = "\n".join(lines)
+    assert "## Multi-tenant serving" in txt
+    for needle in ("0.5", "4.82", "8.8", "tenant_ok=True",
+                   "tenant_compile_share_ok=True",
+                   "tenant_fair_share_ok=True",
+                   "tenant_publish_parity_ok=True",
+                   "tenant_placement_move_ok=True",
+                   "`tenant_manifest`", "`registry_keep_versions`",
+                   "placement.move"):
+        assert needle in txt, needle
+    # a record with no tenant capture renders the placeholder
+    lines = []
+    perf_report.tenants_section(lines.append, {})
+    assert "No tenant fields" in "\n".join(lines)
+
+
+def test_perf_md_carries_tenants_section():
+    with open(os.path.join(REPO, "PERF.md")) as fh:
+        txt = fh.read()
+    assert "## Multi-tenant serving" in txt
+
+
 def test_device_truth_section_renders_fields():
     """The Device truth section (ISSUE 12) is generated from the BENCH
     device-truth fields (bench.py measure_obs's device block via

@@ -76,6 +76,13 @@ WATCHED: Tuple[Tuple[str, str, float], ...] = (
     ("predict_h2d_bytes_per_row_packed", "down", 0.10),
     ("serve_qps", "up", 0.10),
     ("serve_p99_ms", "down", 0.10),
+    # multi-tenant serving (ISSUE 20): the shared-jit-cache hit rate —
+    # ANY downward move means tenants stopped adopting each other's
+    # executables — and the noisy-neighbor p99 tax on the cold tenant
+    # under the hot-tenant overload probe (CPU-thread-scheduling noisy,
+    # so the bar is loose); tenant_ok is the boolean guard beside them
+    ("tenant_compile_share_frac", "up", 0.10),
+    ("tenant_isolation_p99_delta_ms", "down", 0.50),
     ("stream_ms_per_iter", "down", 0.10),
     ("pipeline_ms_per_iter", "down", 0.10),
     ("obs_overhead_frac", "down", 0.10),

@@ -70,12 +70,17 @@ import tier1_budget  # noqa: E402
 # walk+accumulate node/bit parity with the host oracle, zero retraces
 # within a bucket, and on device >= 1.5x the scan walk's compute rate
 # with cost_analysis bytes confirming the single-read contract —
-# bench.py measure_predict)
+# bench.py measure_predict); tenant_ok is the multi-tenant serving
+# guard (ISSUE 20: cross-tenant compile-bucket sharing proven by
+# per-label counters — the second tenant's warm adds zero compiles,
+# zero retraces under mixed traffic — plus fair-share isolation under
+# a 2x hot-tenant overload, per-tenant publish/rollback parity and the
+# SLO-driven placement-move drill — bench.py measure_tenants)
 REQUIRED_GUARDS = ("obs_ok", "slo_ok", "forensics_ok", "chaos_ok",
                    "fleet_ok", "chaos_fleet_ok", "obs_device_ok",
                    "fused_ok", "drift_ok", "fused_round_ok",
                    "hier_comm_ok", "fused_loop_ok", "packed_ok",
-                   "predict_fused_ok")
+                   "predict_fused_ok", "tenant_ok")
 
 
 def check_required_guards(records_dir: str, guards, out=print) -> bool:

@@ -20,9 +20,10 @@ metrics snapshot.  Optional mid-run hooks drive a hot-swap under live
 traffic (``swap_at_frac`` + ``swap_fn``).
 
 CLI: ``python tools/loadgen.py input_model=<model.txt> [rate=500]
-[duration=5] [rows=1] [features from the model]`` — builds an
-in-process server on the model and prints one JSON line of ``serve_*``
-fields.
+[duration=5] [rows=1] [tenants=acme:3,globex] [features from the
+model]`` — builds an in-process server on the model (standing up the
+named tenant lineages when ``tenants=`` is given) and prints one JSON
+line of ``serve_*`` fields.
 """
 
 from __future__ import annotations
@@ -46,7 +47,8 @@ def run_loadgen(server, X: np.ndarray, *, rate_qps: float,
                 swap_fn: Optional[Callable[[], None]] = None,
                 tail_requests_after_swap: int = 0,
                 check_fn: Optional[Callable] = None,
-                export_artifacts_to: str = "") -> Dict[str, object]:
+                export_artifacts_to: str = "",
+                tenants=None) -> Dict[str, object]:
     """Drive ``server.submit`` with open-loop Poisson arrivals.
 
     ``X`` is the row pool (requests sample ``rows_per_req`` consecutive
@@ -69,7 +71,17 @@ def run_loadgen(server, X: np.ndarray, *, rate_qps: float,
     ``"client_metrics"``.  ``export_artifacts_to`` (or the
     ``LGBMV1_OBS_DIR`` env var) additionally writes the registry as a
     loadgen-role per-process artifact for ``tools/obs_aggregate.py`` to
-    merge next to the server's (ISSUE 10)."""
+    merge next to the server's (ISSUE 10).
+
+    ``tenants`` (ISSUE 20) arms a weighted multi-tenant mix: a manifest
+    string (``"acme:3,globex"``, serve/tenants.py grammar) or
+    ``[(name, weight), ...]``.  Each arrival is tagged with a tenant
+    drawn weight-proportionally from a SEPARATE seed-derived stream —
+    the arrival schedule and row starts are drawn first from the
+    primary stream, so a single-tenant run's schedule is bit-identical
+    with the mix on or off.  Client telemetry gains the tenant
+    dimension (``loadgen_requests_total{tenant,outcome}``) and the
+    result carries a ``per_tenant`` outcome block."""
     from lightgbmv1_tpu.obs.metrics import Registry
     from lightgbmv1_tpu.serve.server import (RequestTimeout,
                                              ServerOverloaded)
@@ -80,14 +92,49 @@ def run_loadgen(server, X: np.ndarray, *, rate_qps: float,
     arrivals = np.cumsum(gaps)
     starts = rng.randint(0, max(X.shape[0] - rows_per_req, 1),
                          size=n_arrivals)
+    # tenant mix AFTER (and from a separate stream than) the arrival
+    # schedule: the offered-load timeline never depends on the mix
+    tenant_names: List[str] = []
+    tenant_assign = None
+    if tenants:
+        if isinstance(tenants, str):
+            from lightgbmv1_tpu.serve.tenants import parse_manifest
+
+            pairs = [(s.name, s.weight) for s in parse_manifest(tenants)]
+        else:
+            pairs = [(str(n), float(w)) for n, w in tenants]
+        if not pairs:
+            raise ValueError(f"tenants={tenants!r} named no tenants")
+        tenant_names = [n for n, _ in pairs]
+        w = np.asarray([p[1] for p in pairs], np.float64)
+        tenant_probs = w / w.sum()
+        trng = np.random.RandomState((seed ^ 0x7e5a17) & 0x7fffffff)
+        tenant_assign = trng.choice(len(pairs), size=n_arrivals,
+                                    p=tenant_probs)
 
     reg = Registry()
-    outcomes = reg.counter("loadgen_requests_total",
-                           "Client-side request outcomes",
-                           label_names=("outcome",))
-    for oc in ("ok", "shed", "timeout", "error", "check_failure",
-               "degraded"):
-        outcomes.labels(outcome=oc)   # pre-touch: zeros render in snapshots
+    _OUTCOMES = ("ok", "shed", "timeout", "error", "check_failure",
+                 "degraded")
+    if tenant_assign is None:
+        outcomes = reg.counter("loadgen_requests_total",
+                               "Client-side request outcomes",
+                               label_names=("outcome",))
+        for oc in _OUTCOMES:
+            outcomes.labels(outcome=oc)   # pre-touch: zeros render in
+            #                               snapshots
+    else:
+        outcomes = reg.counter("loadgen_requests_total",
+                               "Client-side request outcomes",
+                               label_names=("tenant", "outcome"))
+        for tn in tenant_names:
+            for oc in _OUTCOMES:
+                outcomes.labels(tenant=tn, outcome=oc)
+
+    def count(oc: str, tenant: str = "") -> None:
+        if tenant_assign is None:
+            outcomes.labels(outcome=oc).inc()
+        else:
+            outcomes.labels(tenant=tenant, outcome=oc).inc()
     lat_hist = reg.histogram(
         "loadgen_latency_ms", "Client-measured request latency (ms)",
         sample_window=n_arrivals + max(int(tail_requests_after_swap), 0)
@@ -100,19 +147,22 @@ def run_loadgen(server, X: np.ndarray, *, rate_qps: float,
     idx_lock = threading.Lock()
     t0 = time.monotonic()
 
-    def do_one(s: int):
+    def do_one(s: int, tenant: str = ""):
         rows = X[s: s + rows_per_req]
         t_req = time.monotonic()
         try:
-            res = server.submit(rows)
+            if tenant_assign is None:
+                res = server.submit(rows)
+            else:
+                res = server.submit(rows, tenant=tenant)
         except ServerOverloaded:
-            outcomes.labels(outcome="shed").inc()
+            count("shed", tenant)
             return
         except RequestTimeout:
-            outcomes.labels(outcome="timeout").inc()
+            count("timeout", tenant)
             return
         except Exception:  # noqa: BLE001 — counted, run continues
-            outcomes.labels(outcome="error").inc()
+            count("error", tenant)
             return
         lat = (time.monotonic() - t_req) * 1e3
         ok = True
@@ -121,11 +171,11 @@ def run_loadgen(server, X: np.ndarray, *, rate_qps: float,
                 ok = bool(check_fn(s, rows_per_req, res))
             except Exception:  # noqa: BLE001
                 ok = False
-        outcomes.labels(outcome="ok").inc()
+        count("ok", tenant)
         if res.degraded:
-            outcomes.labels(outcome="degraded").inc()
+            count("degraded", tenant)
         if not ok:
-            outcomes.labels(outcome="check_failure").inc()
+            count("check_failure", tenant)
         lat_hist.observe(lat)
         version_counts.labels(version=res.version).inc()
 
@@ -139,7 +189,9 @@ def run_loadgen(server, X: np.ndarray, *, rate_qps: float,
             delay = t0 + arrivals[i] - time.monotonic()
             if delay > 0:
                 time.sleep(delay)
-            do_one(int(starts[i]))
+            do_one(int(starts[i]),
+                   tenant_names[tenant_assign[i]]
+                   if tenant_assign is not None else "")
 
     threads = [threading.Thread(target=client, daemon=True)
                for _ in range(max(int(n_threads), 1))]
@@ -161,10 +213,16 @@ def run_loadgen(server, X: np.ndarray, *, rate_qps: float,
         t.join()
     if swapper is not None:
         swapper.join()
+        n_tail = max(int(tail_requests_after_swap), 0)
         tail_starts = rng.randint(0, max(X.shape[0] - rows_per_req, 1),
-                                  size=max(int(tail_requests_after_swap), 0))
-        for s in tail_starts:
-            do_one(int(s))
+                                  size=n_tail)
+        tail_tenants = (trng.choice(len(tenant_names), size=n_tail,
+                                    p=tenant_probs)
+                        if tenant_assign is not None else None)
+        for j, s in enumerate(tail_starts):
+            do_one(int(s),
+                   tenant_names[tail_tenants[j]]
+                   if tail_tenants is not None else "")
     wall = time.monotonic() - t0
 
     export_dir = export_artifacts_to or os.environ.get("LGBMV1_OBS_DIR",
@@ -183,11 +241,17 @@ def run_loadgen(server, X: np.ndarray, *, rate_qps: float,
             label=f"loadgen-{ident['host']}-{ident['pid']}",
             registry=reg)
 
-    stats = {oc: int(outcomes.labels(outcome=oc).get())
-             for oc in ("ok", "shed", "timeout", "error")}
-    stats["check_failures"] = int(
-        outcomes.labels(outcome="check_failure").get())
-    stats["degraded"] = int(outcomes.labels(outcome="degraded").get())
+    if tenant_assign is None:
+        def _count_of(oc: str) -> int:
+            return int(outcomes.labels(outcome=oc).get())
+    else:
+        def _count_of(oc: str) -> int:
+            return sum(int(c.get()) for key, c in outcomes.children()
+                       if key[1] == oc)
+    stats = {oc: _count_of(oc) for oc in ("ok", "shed", "timeout",
+                                          "error")}
+    stats["check_failures"] = _count_of("check_failure")
+    stats["degraded"] = _count_of("degraded")
     versions = {key[0]: int(child.get())
                 for key, child in version_counts.children()}
     total = sum(stats[k] for k in ("ok", "shed", "timeout", "error"))
@@ -197,7 +261,13 @@ def run_loadgen(server, X: np.ndarray, *, rate_qps: float,
         v = lat_hist.quantile(p)
         return None if v is None else round(v, 3)
 
-    return {
+    per_tenant = None
+    if tenant_assign is not None:
+        per_tenant = {
+            tn: {oc: int(outcomes.labels(tenant=tn, outcome=oc).get())
+                 for oc in ("ok", "shed", "timeout", "error")}
+            for tn in tenant_names}
+    out = {
         "offered_qps": round(rate_qps, 1),
         "achieved_qps": round(stats["ok"] / wall, 1) if wall > 0 else None,
         "duration_s": round(wall, 2),
@@ -213,6 +283,9 @@ def run_loadgen(server, X: np.ndarray, *, rate_qps: float,
         # loadgen_requests_total{outcome="ok"}) — same store, flat dump
         "client_metrics": reg.snapshot(),
     }
+    if per_tenant is not None:
+        out["per_tenant"] = per_tenant
+    return out
 
 
 def serve_record_fields(lg: Dict[str, object]) -> Dict[str, object]:
@@ -252,15 +325,25 @@ def main(argv: List[str]) -> int:
     duration = float(kv.pop("duration", 5.0))
     rows_per_req = int(kv.pop("rows", 1))
     seed = int(kv.pop("seed", 0))
+    tenants = kv.pop("tenants", "")
     config = Config.from_dict(kv)
     booster = Booster(params={"verbosity": config.verbosity},
                       model_file=model_path)
     server = build_server(booster, config)
+    if tenants:
+        # stand the named lineages up on the in-process server, each
+        # seeded with the model under test (serve/tenants.py)
+        from lightgbmv1_tpu.serve.tenants import TenantRegistry
+
+        tenreg = TenantRegistry(server)
+        for spec in tenreg.add_manifest(tenants):
+            tenreg.publish(spec.name, booster)
     rng = np.random.RandomState(seed + 1)
     X = rng.randn(8192, booster.num_feature())
     try:
         lg = run_loadgen(server, X, rate_qps=rate, duration_s=duration,
-                         rows_per_req=rows_per_req, seed=seed)
+                         rows_per_req=rows_per_req, seed=seed,
+                         tenants=tenants or None)
     finally:
         server.close()
     print(json.dumps({**serve_record_fields(lg), "loadgen": lg}))

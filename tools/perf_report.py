@@ -761,6 +761,57 @@ def fleet_section(w, rec):
     w("")
 
 
+def tenants_section(w, rec):
+    """Multi-tenant serving (ISSUE 20 — bench.py measure_tenants): the
+    compile-bucket-sharing counters, the fair-share isolation probe,
+    per-tenant publish/rollback parity and the placement-move drill.
+    Placeholder until the first capture that carries the fields."""
+    w("## Multi-tenant serving (serve/tenants.py + serve/placement.py)")
+    w("")
+    if rec.get("tenant_ok") is None:
+        w("No tenant fields in this record yet — the next driver "
+          "capture runs bench.py's measure_tenants (two same-shape "
+          "tenants sharing ONE compiled executable proven by per-label "
+          "compile counters, a 2x hot-tenant overload with the cold "
+          "tenant's p99 held inside its SLO, per-tenant "
+          "publish/rollback bit-parity, and a burn-rate-triggered "
+          "placement move) and this section renders "
+          "`tenant_compile_share_frac`, the isolation p99 tax and the "
+          "four probe guards.")
+        w("")
+        return
+    w("| share frac | cache hits | 2nd-warm compiles | mixed retraces "
+      "| hot sheds | cold sheds | cold p99 ms | isolation Δp99 ms | "
+      "placement moves |")
+    w("|---|---|---|---|---|---|---|---|---|")
+    w(f"| {get(rec, 'tenant_compile_share_frac', 4)} | "
+      f"{get(rec, 'tenant_shared_cache_hits', 0)} | "
+      f"{get(rec, 'tenant_second_warm_compiles', 0)} | "
+      f"{get(rec, 'tenant_mixed_retraces', 0)} | "
+      f"{get(rec, 'tenant_hot_shed', 0)} | "
+      f"{get(rec, 'tenant_cold_shed', 0)} | "
+      f"{get(rec, 'tenant_cold_p99_ms', 2)} | "
+      f"{get(rec, 'tenant_isolation_p99_delta_ms', 2)} | "
+      f"{get(rec, 'tenant_placement_moves', 0)} |")
+    w("")
+    w(f"Guard `tenant_ok={rec.get('tenant_ok')}`: the second tenant's "
+      "warm adopted the first tenant's executables — zero new "
+      "per-label compiles, zero retraces under mixed-tenant traffic "
+      f"(`tenant_compile_share_ok={rec.get('tenant_compile_share_ok')}"
+      "`); the hot tenant shed its OWN traffic while the cold tenant "
+      "kept zero sheds and a p99 inside its SLO bound "
+      f"(`tenant_fair_share_ok={rec.get('tenant_fair_share_ok')}`); "
+      "publishing v2 into tenant A left tenant B bit-identical and "
+      "A's rollback restored v1 bit-exactly "
+      f"(`tenant_publish_parity_ok={rec.get('tenant_publish_parity_ok')}"
+      "`); the burn-rate signal moved the hot tenant with a fully "
+      "attributed `placement.move` event "
+      f"(`tenant_placement_move_ok={rec.get('tenant_placement_move_ok')}"
+      "`).  Knobs: `tenant_manifest`, `registry_keep_versions`, "
+      "`placement_*` — BASELINE.md \"Multi-tenant serving\".")
+    w("")
+
+
 def trend_section(w, root=ROOT):
     """Trend: the regression sentinel's view of the whole BENCH record
     trajectory (tools/bench_trend.py — the same comparator that gates
@@ -1076,6 +1127,8 @@ def generate(rec, name, prev=None, prev_name=None):
     model_quality_section(w, rec)
 
     fleet_section(w, rec)
+
+    tenants_section(w, rec)
 
     mc_name, mc = load_multichip()
     comm_section(w, mc_name, mc)
